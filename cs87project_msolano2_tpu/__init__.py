@@ -1,0 +1,25 @@
+"""cs87project_msolano2_tpu — a TPU-native framework with the capabilities of
+``elenasolano/CS87Project-msolano2`` ("Parallelizing the Fourier Transform
+with no communication").
+
+The reference implements one algorithm — a radix-2 Cooley-Tukey FFT
+decomposed into a replicated "funnel" phase and a segment-local "tube"
+phase so P processors need zero inter-processor communication — three
+times, once per hardware target (pthreads / CUDA / Xeon Phi OpenMP).
+This package implements it once, behind a backend-dispatch boundary:
+
+* ``cpu`` / ``serial`` / ``pthreads`` — the native C core
+  (``native/libpifft.so``) via ctypes;
+* ``jax`` — vectorized butterfly stages under ``jax.jit`` (XLA on TPU);
+* ``pallas`` — a hand-written TPU VMEM kernel for the butterfly stages;
+* multi-chip — ``parallel/``: ``shard_map`` over a ``jax.sharding.Mesh``
+  (zero-collective pi-FFT, DP-batched FFT, all-to-all 2D/3D FFT).
+
+Layer map (mirrors SURVEY.md §1): ``ops/`` = L0/L1 primitives, ``models/``
+= L2 transforms, ``backends/`` + ``parallel/`` = L2/L3 runtimes,
+``cli`` = L3, ``harness/`` + ``analysis/`` (repo root) = L4/L5.
+"""
+
+__version__ = "0.1.0"
+
+from .backends.registry import get_backend, list_backends  # noqa: F401

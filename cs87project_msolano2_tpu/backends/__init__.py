@@ -1,0 +1,8 @@
+"""Backend-dispatch boundary (the `fourier_backend_t` table of the north
+star): every backend exposes the same run contract — pi-layout output plus
+total/funnel/tube timers — so the harness and analysis layers are
+backend-agnostic, exactly what the reference's triplicated design lacked.
+"""
+
+from .base import Backend, RunResult  # noqa: F401
+from .registry import get_backend, list_backends  # noqa: F401

@@ -1,0 +1,35 @@
+"""Backend registry — name -> Backend, the dispatch boundary's front door.
+
+Imports are lazy so the native-only CLI path never pays the jax import
+and the JAX path works where the C toolchain is absent.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+_NAMES = ("serial", "pthreads", "cpu", "jax", "pallas")
+
+
+def list_backends() -> List[str]:
+    return list(_NAMES)
+
+
+def get_backend(name: str):
+    if name in ("cpu", "pthreads"):
+        from .cpu import NativeBackend
+
+        return NativeBackend("pthreads")
+    if name == "serial":
+        from .cpu import NativeBackend
+
+        return NativeBackend("serial")
+    if name == "jax":
+        from .jax_backend import JaxBackend
+
+        return JaxBackend("jnp")
+    if name == "pallas":
+        from .jax_backend import JaxBackend
+
+        return JaxBackend("pallas")
+    raise ValueError(f"unknown backend '{name}' (have: {', '.join(_NAMES)})")

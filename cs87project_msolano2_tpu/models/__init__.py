@@ -1,0 +1,4 @@
+"""L2 transforms: the pi-FFT decomposition and the natural-order FFT APIs."""
+
+from .pi_fft import funnel, tube, pi_fft_pi_layout  # noqa: F401
+from .fft import fft, ifft, fft2, fftn  # noqa: F401

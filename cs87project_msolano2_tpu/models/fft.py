@@ -1,0 +1,63 @@
+"""Natural-order FFT APIs on top of the pi decomposition.
+
+These are the user-facing transforms (complex64 in, complex64 out, natural
+frequency order) — what ``jnp.fft`` users reach for, built on the same
+funnel/tube stages the benchmarks measure.  The bit-reversal gather lives
+here, at the API boundary, never inside the timed phases.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.bits import bit_reverse_indices
+from .pi_fft import pi_fft_pi_layout
+
+
+def fft(x, p: int = 1, tables=None):
+    """1-D DFT over the trailing axis (complex in/out, natural order).
+
+    `p` chooses the virtual-processor decomposition; the result is
+    p-invariant (that is the paper's claim, and tests assert it).
+    """
+    x = jnp.asarray(x)
+    if not jnp.iscomplexobj(x):
+        x = x.astype(jnp.complex64)
+    n = x.shape[-1]
+    yr, yi = pi_fft_pi_layout(
+        jnp.real(x).astype(jnp.float32), jnp.imag(x).astype(jnp.float32), p, tables
+    )
+    idx = jnp.asarray(bit_reverse_indices(n))
+    yr = jnp.take(yr, idx, axis=-1)
+    yi = jnp.take(yi, idx, axis=-1)
+    return jax_complex(yr, yi)
+
+
+def ifft(x, p: int = 1, tables=None):
+    """Inverse DFT via conjugation: ifft(x) = conj(fft(conj(x))) / n."""
+    x = jnp.asarray(x)
+    n = x.shape[-1]
+    return jnp.conj(fft(jnp.conj(x), p, tables)) / n
+
+
+def fft2(x, p: int = 1):
+    """2-D DFT over the trailing two axes via row then column 1-D passes."""
+    y = fft(x, p)
+    y = jnp.swapaxes(y, -1, -2)
+    y = fft(y, p)
+    return jnp.swapaxes(y, -1, -2)
+
+
+def fftn(x, axes=None, p: int = 1):
+    """N-D DFT over `axes` (default: all) via successive 1-D passes."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = range(x.ndim)
+    y = x
+    for ax in axes:
+        y = jnp.moveaxis(fft(jnp.moveaxis(y, ax, -1), p), -1, ax)
+    return y
+
+
+def jax_complex(re, im):
+    return re.astype(jnp.complex64) + 1j * im.astype(jnp.complex64)

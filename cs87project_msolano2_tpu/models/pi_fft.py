@@ -1,0 +1,98 @@
+"""The pi-FFT: the communication-free funnel/tube decomposition as pure,
+jittable JAX functions.
+
+Semantics (identical to the native core, see native/pifft_core.c and the
+reference algorithm …pthreads.c:388-512): for N = 2^m inputs and P = 2^k
+virtual processors,
+
+* ``funnel``: log2(P) replicated half-butterfly stages.  Processor Pi
+  keeps, at stage i, the half of its current working set selected by bit
+  (k-1-i) of Pi, halving the working set N -> N/2 -> ... -> N/P.  Here
+  all P processors are materialized as rows of one array, so the funnel
+  is a dense (P, len) computation — on one TPU core this expresses the
+  paper's *redundant-compute-instead-of-communication* trade literally;
+  across chips the same code runs with a scalar Pi per device
+  (parallel/pi_shard.py) and needs no collectives at all.
+* ``tube``: log2(N/P) full DIF stages confined to each row's segment.
+
+The concatenation of the P segments is the global DIF output = the DFT in
+bit-reversed index order ("pi layout").  Unscrambling is a separate
+``jnp.take`` gather, kept off the hot path exactly like the reference's
+test-mode-only gather (…pthreads.c:496-499).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.bits import ilog2
+from ..ops.butterfly import stage_full, stage_half
+
+
+def _tables_for(n, tables):
+    if tables is None:
+        from ..ops.twiddle import twiddle_tables
+
+        return twiddle_tables(n)
+    return tables
+
+
+def funnel(xr, xi, p, tables=None):
+    """Replicated funnel phase.  xr/xi: (..., n) -> (..., p, n // p)."""
+    n = xr.shape[-1]
+    k = ilog2(p)
+    tables = _tables_for(n, tables)
+    cr = jnp.broadcast_to(xr[..., None, :], (*xr.shape[:-1], p, n))
+    ci = jnp.broadcast_to(xi[..., None, :], (*xi.shape[:-1], p, n))
+    pis = jnp.arange(p, dtype=jnp.int32)[:, None]  # (p, 1)
+    for i in range(k):
+        wr, wi = tables[i]
+        bottom = (pis >> (k - 1 - i)) & 1
+        cr, ci = stage_half(cr, ci, jnp.asarray(wr), jnp.asarray(wi), bottom)
+    return cr, ci
+
+
+def funnel_single(xr, xi, pi, p, tables=None):
+    """Funnel for ONE processor with traced scalar id `pi` (shard_map path).
+
+    xr/xi: (..., n) -> (..., n // p).  Identical math to `funnel` but the
+    half choice is a scalar select, so each device touches only its own
+    shrinking chain — zero inter-device communication.
+    """
+    n = xr.shape[-1]
+    k = ilog2(p)
+    tables = _tables_for(n, tables)
+    cr, ci = xr, xi
+    pi = jnp.asarray(pi, dtype=jnp.int32)
+    for i in range(k):
+        wr, wi = tables[i]
+        bottom = (pi >> (k - 1 - i)) & 1
+        cr, ci = stage_half(cr, ci, jnp.asarray(wr), jnp.asarray(wi), bottom)
+    return cr, ci
+
+
+def tube(sr, si, n, p, tables=None):
+    """Segment-local tube phase: full DIF FFT over the trailing axis.
+
+    sr/si: (..., s) with s = n // p; the trailing axis is one processor's
+    segment.  Twiddle levels continue where the funnel stopped (level
+    log2(p) of the n-point plan — segment-local butterflies of an n-point
+    transform use the same tables as a standalone s-point transform, which
+    is why zero communication works).
+    """
+    k = ilog2(p)
+    s = sr.shape[-1]
+    tables = _tables_for(n, tables)
+    for i in range(ilog2(s)):
+        wr, wi = tables[k + i]
+        sr, si = stage_full(sr, si, jnp.asarray(wr), jnp.asarray(wi))
+    return sr, si
+
+
+def pi_fft_pi_layout(xr, xi, p, tables=None):
+    """Full pi-FFT, output in pi layout.  xr/xi: (..., n) -> (..., n)."""
+    n = xr.shape[-1]
+    tables = _tables_for(n, tables)
+    fr, fi = funnel(xr, xi, p, tables)
+    tr, ti = tube(fr, fi, n, p, tables)
+    return tr.reshape(*xr.shape[:-1], n), ti.reshape(*xi.shape[:-1], n)

@@ -59,6 +59,7 @@ int main(int argc, char **argv) {
       case 'b': backend = optarg; break;
       case 't': test_mode = 1; break;
       case 'o': no_header = 1; break;
+      case 'h': show_usage(argv[0]); return 0;
       default: show_usage(argv[0]); return 2;
     }
   }

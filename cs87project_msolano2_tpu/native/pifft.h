@@ -63,7 +63,7 @@ int pifft_run(const char *backend, int64_t n, int32_t p, const pif_c32 *in,
               pif_c32 *out, double *timers3);
 
 /* Max sensible p for a backend (e.g. online cores for "pthreads").
- * Returns <= 0 if the backend imposes no limit, -1 if unknown backend. */
+ * Returns 0 if the backend imposes no limit, -1 for an unknown backend. */
 int pifft_capacity(const char *backend);
 
 /* Number of online CPU cores (the reference's how-many-cpu-cores probe,

@@ -130,9 +130,10 @@ static int pthreads_run(int64_t n, int32_t p, const pif_c32 *in, pif_c32 *out,
      * differing in a high bit) get cores differing in a low bit and vice
      * versa, spreading siblings across the physical topology. */
     if (ncores > 1) {
-      /* bit-reverse within the largest power-of-two core subset, then walk
-       * the remaining cores with an offset so non-power-of-two machines
-       * still use every core. */
+      /* bit-reverse within the largest power-of-two core subset; threads
+       * beyond that subset spill onto the remaining cores via the offset
+       * (full-core coverage is not guaranteed when ncores is not a power
+       * of two — siblings-apart placement is what matters here). */
       int64_t mask = (1 << corebits) - 1;
       int core = (int)((pif_bit_reverse(pi & mask, corebits) +
                         (int64_t)(pi >> corebits)) %

@@ -1,0 +1,4 @@
+"""L0/L1 primitives: bit utilities, twiddle tables, butterfly stage ops."""
+
+from .bits import bit_reverse, bit_reverse_indices, ilog2, is_power_of_two  # noqa: F401
+from .twiddle import twiddle_tables  # noqa: F401

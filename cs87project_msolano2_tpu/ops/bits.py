@@ -1,0 +1,39 @@
+"""Bit utilities (the reference's L0 misc layer: is_power_of_two / ilog2 /
+bit_reverse, cf. …pthreads.c:758-829 — reimplemented plainly; the gather
+indices are vectorized so the unscramble is a single ``take``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_power_of_two(v: int) -> bool:
+    return v > 0 and (v & (v - 1)) == 0
+
+
+def ilog2(v: int) -> int:
+    """log2 of a power of two."""
+    if not is_power_of_two(v):
+        raise ValueError(f"{v} is not a positive power of two")
+    return v.bit_length() - 1
+
+
+def bit_reverse(v: int, bits: int) -> int:
+    """Reverse the low `bits` bits of v."""
+    r = 0
+    for _ in range(bits):
+        r = (r << 1) | (v & 1)
+        v >>= 1
+    return r
+
+
+def bit_reverse_indices(n: int) -> np.ndarray:
+    """idx such that x_natural = x_dif_order[idx]; idx[k] = bit_reverse(k).
+
+    Vectorized O(n log n) construction (no per-element Python loop).
+    """
+    bits = ilog2(n)
+    idx = np.zeros(n, dtype=np.int64)
+    for b in range(bits):
+        idx = (idx << 1) | ((np.arange(n, dtype=np.int64) >> b) & 1)
+    return idx

@@ -1,0 +1,68 @@
+"""Radix-2 decimation-in-frequency butterfly stage ops (L1), as vectorized
+JAX functions over split re/im float32 planes.
+
+TPU-first design notes (vs the reference's scalar loops,
+…pthreads.c:522-576 and …cuda.cu:517-558):
+
+* complex values travel as separate re/im float32 arrays — Pallas has no
+  native complex dtype and the VPU operates on float planes anyway;
+* the funnel's left/right half-butterfly choice is branchless — the
+  select folds into a sign and a twiddle factor, the same trick the
+  reference's CUDA backend uses to avoid warp divergence
+  (``convex_comb``, …cuda.cu:646-653) and the reason TPU vector lanes
+  like it too;
+* every stage is a full-array reshape + elementwise op, so XLA sees
+  static shapes and fuses each stage into one VPU pass.
+
+All functions operate on the trailing axis and broadcast over any
+leading axes (rows of virtual processors, batches, ...).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def stage_full(xr, xi, wr, wi):
+    """One full DIF stage over the trailing axis.
+
+    Butterfly size L = 2 * wr.shape[-1]; for each size-L block with halves
+    (a, b): top half -> a + b, bottom half -> (a - b) * w.
+    xr/xi: (..., len) with len % L == 0.  Returns same shape.
+    """
+    half = wr.shape[-1]
+    shape = xr.shape
+    xr = xr.reshape(*shape[:-1], -1, 2, half)
+    xi = xi.reshape(*shape[:-1], -1, 2, half)
+    ar, br = xr[..., 0, :], xr[..., 1, :]
+    ai, bi = xi[..., 0, :], xi[..., 1, :]
+    tr, ti = ar + br, ai + bi
+    dr, di = ar - br, ai - bi
+    ur = dr * wr - di * wi
+    ui = dr * wi + di * wr
+    outr = jnp.stack((tr, ur), axis=-2).reshape(shape)
+    outi = jnp.stack((ti, ui), axis=-2).reshape(shape)
+    return outr, outi
+
+
+def stage_half(xr, xi, wr, wi, bottom):
+    """One funnel half-butterfly: keep only the half selected by `bottom`.
+
+    xr/xi: (..., len) — exactly one size-len butterfly.  bottom is an int32
+    array broadcastable against (..., half) (e.g. shape (p, 1) when
+    vectorizing over p virtual processors, or a scalar inside shard_map):
+    0 -> top half a + b, 1 -> bottom half (a - b) * w.  Branchless:
+    out = (a + s*b) * f  with  s = 1 - 2*bottom,  f = bottom ? w : 1.
+    Returns (..., len // 2).
+    """
+    half = xr.shape[-1] // 2
+    ar, br = xr[..., :half], xr[..., half:]
+    ai, bi = xi[..., :half], xi[..., half:]
+    s = (1 - 2 * bottom).astype(xr.dtype)
+    dr = ar + s * br
+    di = ai + s * bi
+    fr = jnp.where(bottom, wr, jnp.ones_like(wr))
+    fi = jnp.where(bottom, wi, jnp.zeros_like(wi))
+    outr = dr * fr - di * fi
+    outi = dr * fi + di * fr
+    return outr, outi

@@ -1,0 +1,32 @@
+"""Twiddle-factor tables.
+
+One table per butterfly level: level l of an n-point transform has
+butterfly size L = n >> l and L/2 entries w[j] = exp(-2*pi*i*j/L).
+
+The reference recomputes cos/sin per element inside the hot loop
+(…pthreads.c:644-651); on TPU that would put the transform on the
+transcendental unit instead of HBM bandwidth, so tables are precomputed
+host-side (float64 trig, rounded to float32) and fed to the kernels as
+constants (SURVEY.md §7 "twiddle tables mandatory")."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from .bits import ilog2
+
+
+@lru_cache(maxsize=64)
+def twiddle_tables(n: int) -> tuple[tuple[np.ndarray, np.ndarray], ...]:
+    """((wr, wi), ...) per level, level l sized (n >> l) / 2, float32."""
+    levels = []
+    for l in range(ilog2(n)):
+        L = n >> l
+        j = np.arange(L // 2, dtype=np.float64)
+        ang = -2.0 * np.pi * j / L
+        levels.append(
+            (np.cos(ang).astype(np.float32), np.sin(ang).astype(np.float32))
+        )
+    return tuple(levels)

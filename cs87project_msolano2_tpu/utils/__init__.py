@@ -1,0 +1,1 @@
+"""Shared utilities: native-lib loading, timing, verification oracles, TSV."""

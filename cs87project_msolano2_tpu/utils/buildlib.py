@@ -1,0 +1,71 @@
+"""Locate (and if needed build) the native pi-FFT shared library.
+
+The reference's Makefiles degrade to a friendly message when the target
+compiler is absent (gpu/cuda/Makefile:28-33); we keep that spirit — if
+`make` or a C compiler is missing, loading raises a clear error and the
+pure-JAX backends keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from functools import lru_cache
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "libpifft.so")
+_SOURCES = ("pifft_core.c", "pifft_backends.c", "pifft.h", "pifft_internal.h")
+
+
+def _stale() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(NATIVE_DIR, s)) > lib_mtime
+        for s in _SOURCES
+        if os.path.exists(os.path.join(NATIVE_DIR, s))
+    )
+
+
+def build_native(force: bool = False) -> str:
+    """Build libpifft.so if missing/stale; returns its path."""
+    if force or _stale():
+        try:
+            subprocess.run(
+                ["make", "-C", NATIVE_DIR, "libpifft.so"],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "`make` not available; build the native core manually: "
+                f"make -C {NATIVE_DIR}"
+            ) from e
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed:\n{e.stdout}\n{e.stderr}"
+            ) from e
+    return LIB_PATH
+
+
+@lru_cache(maxsize=1)
+def load_native() -> ctypes.CDLL:
+    """Load (building if needed) and type the flat pifft_* C API."""
+    lib = ctypes.CDLL(build_native())
+    c = ctypes
+    lib.pifft_run.restype = c.c_int
+    lib.pifft_run.argtypes = [
+        c.c_char_p, c.c_int64, c.c_int32, c.c_void_p, c.c_void_p,
+        c.POINTER(c.c_double),
+    ]
+    lib.pifft_capacity.restype = c.c_int
+    lib.pifft_capacity.argtypes = [c.c_char_p]
+    lib.pifft_num_cores.restype = c.c_int
+    lib.pifft_bit_reverse_permute.restype = None
+    lib.pifft_bit_reverse_permute.argtypes = [c.c_int64, c.c_void_p, c.c_void_p]
+    lib.pifft_golden_test.restype = c.c_int
+    lib.pifft_golden_test.argtypes = [c.c_char_p, c.c_int32]
+    return lib

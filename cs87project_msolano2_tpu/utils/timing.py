@@ -1,0 +1,32 @@
+"""Phase timing (the reference's tmr_t layer, …pthreads.c:714-732, done the
+JAX way: block_until_ready around perf_counter, with warm-up so compile
+time never pollutes a measurement)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+
+def block(x: Any) -> Any:
+    """block_until_ready on any pytree of jax arrays; no-op otherwise."""
+    try:
+        import jax
+
+        return jax.block_until_ready(x)
+    except Exception:
+        return x
+
+
+def time_ms(fn: Callable, *args, reps: int = 1, warmup: int = 1, **kw):
+    """Run fn reps times (after `warmup` unmeasured calls); return
+    (best_ms, last_result)."""
+    result = None
+    for _ in range(warmup):
+        result = block(fn(*args, **kw))
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        result = block(fn(*args, **kw))
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return best, result

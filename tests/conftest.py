@@ -1,0 +1,31 @@
+"""Test configuration: force JAX onto 8 virtual CPU devices.
+
+Multi-device code paths (shard_map over a Mesh, pmap, collectives) are
+exercised on a virtual CPU mesh so the whole suite runs anywhere —
+SURVEY.md §4's "multi-device test path using CPU
+XLA_FLAGS=--xla_force_host_platform_device_count".
+
+Note: this environment's sitecustomize registers the remote-TPU "axon"
+platform at interpreter startup and overrides JAX_PLATFORMS, so the env
+var alone is not enough — we also set jax.config after import.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs[:8]

@@ -1,0 +1,42 @@
+"""L0 bit utilities (counterpart of the reference's misc layer tests —
+the reference had none; SURVEY.md §4 calls for adding them)."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.ops.bits import (
+    bit_reverse,
+    bit_reverse_indices,
+    ilog2,
+    is_power_of_two,
+)
+
+
+def test_is_power_of_two():
+    assert all(is_power_of_two(1 << i) for i in range(31))
+    assert not any(is_power_of_two(v) for v in (0, -1, 3, 6, 12, 1023))
+
+
+def test_ilog2():
+    for i in range(24):
+        assert ilog2(1 << i) == i
+    with pytest.raises(ValueError):
+        ilog2(12)
+
+
+def test_bit_reverse():
+    assert bit_reverse(0b001, 3) == 0b100
+    assert bit_reverse(0b110, 3) == 0b011
+    assert bit_reverse(1, 1) == 1
+    for v in range(64):
+        assert bit_reverse(bit_reverse(v, 6), 6) == v
+
+
+def test_bit_reverse_indices_matches_scalar():
+    for n in (1, 2, 8, 64, 1024):
+        idx = bit_reverse_indices(n)
+        bits = ilog2(n)
+        expect = np.array([bit_reverse(k, bits) for k in range(n)])
+        assert np.array_equal(idx, expect)
+        # a bit-reversal is an involution: applying twice is identity
+        assert np.array_equal(idx[idx], np.arange(n))

@@ -1,0 +1,55 @@
+"""CLI contract tests (flag parity with the reference executables and the
+5-column TSV the harness consumes)."""
+
+import numpy as np
+
+from cs87project_msolano2_tpu.cli import main, make_input
+
+
+def test_tsv_contract(capsys):
+    rc = main(["-n", "256", "-p", "4", "-b", "serial"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines[0].split("\t") == ["n", "p", "total_ms", "funnel_ms", "tube_ms"]
+    row = lines[1].split("\t")
+    assert row[0] == "256" and row[1] == "4"
+    assert all(float(v) >= 0 for v in row[2:])
+
+
+def test_no_header_flag(capsys):
+    rc = main(["-n", "64", "-p", "2", "-b", "serial", "-o"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1 and lines[0].startswith("64\t2\t")
+
+
+def test_golden_mode(capsys):
+    rc = main(["-t", "-b", "serial"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("PASSED") == 4 and "FAILED" not in out
+
+
+def test_verify_flag(capsys):
+    rc = main(["-n", "512", "-p", "8", "-b", "serial", "--verify", "-o"])
+    assert rc == 0
+
+
+def test_missing_args_usage():
+    assert main([]) == 2
+
+
+def test_capacity_clamp():
+    # pthreads capacity on this box is small; a huge p must be rejected
+    from cs87project_msolano2_tpu.backends.cpu import num_cores
+
+    cap = num_cores()
+    rc = main(["-n", "65536", "-p", str(max(cap * 4, 4)), "-b", "cpu"])
+    assert rc == 2
+
+
+def test_make_input_deterministic():
+    a = make_input(128, seed=5)
+    b = make_input(128, seed=5)
+    assert np.array_equal(a, b)
+    assert a.dtype == np.complex64

@@ -1,0 +1,44 @@
+"""Cross-backend numerical agreement: the native C core and the JAX/XLA
+path must produce the same pi-layout output (max abs < 1e-5, per the
+north-star acceptance bound) on identical inputs — the dual-backend
+discipline BASELINE.json's harness requires."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.backends.registry import get_backend
+from cs87project_msolano2_tpu.cli import make_input
+from cs87project_msolano2_tpu.utils.verify import pi_layout_to_natural, rel_err
+
+
+@pytest.mark.parametrize("n", [64, 4096])
+@pytest.mark.parametrize("p", [1, 4, 32])
+def test_cpu_vs_jax(n, p):
+    x = make_input(n, seed=11)
+    ref = get_backend("serial").run(x, p).out
+    jx = get_backend("jax").run(x, p).out
+    # same decomposition, same op order, same float32 -> near-bit-equal
+    assert rel_err(jx, ref.astype(np.complex128)) < 1e-6
+
+
+@pytest.mark.parametrize("p", [1, 8])
+def test_pthreads_vs_serial(p):
+    x = make_input(1024, seed=12)
+    a = get_backend("serial").run(x, p).out
+    b = get_backend("pthreads").run(x, p).out
+    assert np.array_equal(a, b), "same core, same order: must be bit-identical"
+
+
+def test_natural_order_agreement_vs_numpy():
+    n, p = 8192, 16
+    x = make_input(n, seed=13)
+    ref = np.fft.fft(x.astype(np.complex128))
+    for backend in ("serial", "jax"):
+        nat = pi_layout_to_natural(get_backend(backend).run(x, p).out)
+        assert rel_err(nat, ref) < 1e-5, backend
+
+
+def test_reps_best_of():
+    x = make_input(256, seed=14)
+    res = get_backend("serial").run(x, 4, reps=3)
+    assert res.total_ms > 0

@@ -1,0 +1,35 @@
+"""The reference's built-in golden test (-t mode), run through every
+backend at every p: fixed 8-point input, exact expected DFT, exact float
+equality (…pthreads.c:689-705)."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.backends.registry import get_backend
+from cs87project_msolano2_tpu.utils import verify
+
+BACKENDS = ["serial", "pthreads", "jax"]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("p", [1, 2, 4, 8])
+def test_golden_exact(backend, p):
+    b = get_backend(backend)
+    res = b.run(verify.golden_input(), p)
+    nat = verify.pi_layout_to_natural(res.out)
+    assert verify.golden_check_exact(nat), f"got {nat}"
+
+
+def test_golden_expected_is_correct():
+    # the golden vector itself against the O(N^2) oracle
+    ref = verify.naive_dft(verify.golden_input())
+    assert np.allclose(ref, verify.golden_expected(), atol=1e-6)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_timers_populated(backend):
+    b = get_backend(backend)
+    res = b.run(verify.golden_input(), 2)
+    assert res.total_ms >= 0
+    assert res.funnel_ms >= 0
+    assert res.tube_ms >= 0
