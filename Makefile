@@ -1,0 +1,33 @@
+# Top-level Makefile — target parity with the reference build discipline
+# (cpu/pthreads/Makefile:16-46: all / clean / recompile /
+# run-experiments-and-analyze-results / replicate), one level up from the
+# native core's own Makefile.
+
+.PHONY: all clean recompile test bench replicate \
+        run-experiments run-experiments-and-analyze-results analyze
+
+all:
+	$(MAKE) -C cs87project_msolano2_tpu/native all
+
+clean:
+	$(MAKE) -C cs87project_msolano2_tpu/native clean
+	rm -rf results
+
+recompile: clean all
+
+test: all
+	python3 -m pytest tests/ -q
+
+run-experiments: all
+	./harness/run-experiments
+
+analyze:
+	./analysis/analyze-results results/fourier-parallel-pi-*-results.tsv
+
+run-experiments-and-analyze-results: run-experiments analyze
+
+bench: all
+	python3 bench.py
+
+# the reference's one-command replication entry (make replicate)
+replicate: recompile run-experiments-and-analyze-results

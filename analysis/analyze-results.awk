@@ -1,0 +1,60 @@
+# analyze-results.awk — limited law-fit analysis for machines without
+# python/numpy (the reference keeps an awk fallback for machines without R,
+# gpu/cuda/analyze-results.awk — this is a fresh implementation of the same
+# idea: zero-intercept least squares of total time against the predicted
+# complexity law, a t-statistic for the slope, and a normal-tail
+# significance approximation).
+#
+# Input: 5-column TSV  n  p  total_ms  funnel_ms  tube_ms
+# Usage: awk -f analyze-results.awk results.tsv
+
+function log2(v) { return log(v) / log(2) }
+
+# law(n, p) = n(p-1)/p + (n/p) log2(n/p)
+function law(n, p,    s) {
+    s = n / p
+    return n * (p - 1) / p + (s > 1 ? s * log2(s) : 0)
+}
+
+# upper normal tail via Abramowitz-Stegun 7.1.26 erfc approximation
+function normal_sf(z,    t, y) {
+    if (z > 12) return 1e-30
+    t = 1.0 / (1.0 + 0.3275911 * z / sqrt(2))
+    y = t * (0.254829592 + t * (-0.284496736 + t * (1.421413741 \
+        + t * (-1.453152027 + t * 1.061405429)))) * exp(-z * z / 2)
+    return y / 2
+}
+
+$1 ~ /^[0-9]+$/ && NF == 5 {
+    x = law($1, $2); y = $3
+    sxx += x * x; sxy += x * y; syy += y * y
+    m += 1
+    key = $1 "|" $2
+    cnt[key] += 1; sum[key] += y
+    if (!($1 in seen_n)) { seen_n[$1] = 1; ns[++nn] = $1 }
+    if ($2 > maxp) maxp = $2
+}
+
+END {
+    if (m < 2 || sxx == 0) { print "error: not enough data"; exit 1 }
+    beta = sxy / sxx
+    ssr = syy - beta * sxy           # sum of squared residuals (zero-intercept)
+    if (ssr < 0) ssr = 0
+    df = m - 1
+    se = sqrt(ssr / df / sxx)
+    t = (se > 0) ? beta / se : 1e9
+    alpha = normal_sf(t)
+    r2 = (syy > 0) ? 1 - ssr / syy : 0
+
+    printf "limited analysis (awk fallback; install numpy for the full one)\n"
+    printf "runs: %d   fit: total_ms ~ %.3e * law   R^2=%.4f  t=%.1f  alpha~%.2e\n", \
+        m, beta, r2, t, alpha
+    printf "law holds: %s\n", (alpha < 0.01 && beta > 0) ? "Yes" : "No"
+    printf "\navg total_ms at max p per n (measured vs beta*law):\n"
+    for (i = 1; i <= nn; i++) {
+        n = ns[i]; key = n "|" maxp
+        if (key in cnt)
+            printf "  n=%9d p=%d: %10.3f ms  (law: %10.3f ms)\n", \
+                n, maxp, sum[key] / cnt[key], beta * law(n, maxp)
+    }
+}

@@ -1,0 +1,190 @@
+#!/usr/bin/env python3
+"""Statistical analysis (L5): does the measured time obey the predicted
+complexity law?
+
+The reference's R scripts (cpu/pthreads/analyze-results.R:23-157) fit
+    total ~ 0 + I(funnel_law + tube_law)     (zero-intercept regression)
+with funnel_law = n(p-1)/p and tube_law = (n/p) log2(n/p), report the
+significance of the fit, and plot empirical + fitted speedup.  This is
+the project's integration test: "the implementation scales as designed".
+
+This is a from-scratch Python port of that *discipline* (R is absent in
+the image): zero-intercept OLS per phase, t-statistic and its tail
+probability (scipy if present, else a normal approximation), empirical
+and fitted speedup tables, and optional matplotlib PDFs mirroring the
+reference's per-n figure layout.  The awk fallback (analyze-results.awk)
+covers machines without numpy, keeping the reference's R -> awk fallback
+philosophy (gpu/cuda/analyze-results:26-36).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+import numpy as np
+
+
+def t_sf(t: float, df: int) -> float:
+    """P(T > t) for Student's t; scipy when available, else normal tail."""
+    try:
+        from scipy import stats
+
+        return float(stats.t.sf(t, df))
+    except Exception:
+        return 0.5 * math.erfc(t / math.sqrt(2.0))
+
+
+def load_tsv(path: str) -> np.ndarray:
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            parts = line.strip().split("\t")
+            if len(parts) == 5 and parts[0] and parts[0][0].isdigit():
+                rows.append([float(v) for v in parts])
+    if not rows:
+        raise SystemExit(f"no data rows in {path}")
+    return np.asarray(rows)  # n p total funnel tube
+
+
+def laws(n: np.ndarray, p: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    funnel_law = n * (p - 1) / p
+    s = n / p
+    tube_law = s * np.where(s > 1, np.log2(np.maximum(s, 2)), 0.0)
+    return funnel_law, tube_law
+
+
+def zero_intercept_fit(x: np.ndarray, y: np.ndarray):
+    """y ~ 0 + beta*x: returns (beta, r2, tstat, alpha, df)."""
+    sxx = float(np.sum(x * x))
+    if sxx == 0:
+        return 0.0, 0.0, 0.0, 1.0, 0
+    beta = float(np.sum(x * y)) / sxx
+    resid = y - beta * x
+    df = max(len(y) - 1, 1)
+    sigma2 = float(np.sum(resid * resid)) / df
+    se = math.sqrt(sigma2 / sxx) if sigma2 > 0 else 0.0
+    tstat = beta / se if se > 0 else float("inf")
+    ss_tot = float(np.sum(y * y))  # zero-intercept R^2 convention
+    r2 = 1.0 - float(np.sum(resid * resid)) / ss_tot if ss_tot > 0 else 0.0
+    alpha = t_sf(tstat, df) if math.isfinite(tstat) else 0.0
+    return beta, r2, tstat, alpha, df
+
+
+def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None):
+    data = load_tsv(path)
+    n, p, total, funnel, tube = data.T
+    funnel_law, tube_law = laws(n, p)
+
+    report = {}
+    print(f"== {os.path.basename(path)}: {len(n)} runs, "
+          f"n in {sorted(int(v) for v in set(n))}, "
+          f"p in {sorted(int(v) for v in set(p))} ==")
+    for name, y, x in (
+        ("total", total, funnel_law + tube_law),
+        ("funnel", funnel, funnel_law),
+        ("tube", tube, tube_law),
+    ):
+        beta, r2, tstat, a, df = zero_intercept_fit(x, y)
+        verdict = "Yes" if a < alpha_level and beta > 0 else "No"
+        print(f"{name:>6}: time ~ {beta:.3e} * law   R^2={r2:.4f}  "
+              f"t={tstat:.1f} (df={df})  alpha={a:.3e}  "
+              f"law holds: {verdict}")
+        report[name] = dict(beta=beta, r2=r2, t=tstat, alpha=a,
+                            holds=verdict == "Yes")
+
+    # speedup tables (reference: empirical + fitted, per n)
+    beta_f = report["funnel"]["beta"]
+    beta_t = report["tube"]["beta"]
+    print("\nspeedup (empirical vs fitted-law):")
+    for nn in sorted(set(n.astype(int))):
+        sel1 = (n == nn) & (p == 1)
+        if not sel1.any():
+            continue
+        t1 = float(np.mean(total[sel1]))
+        for pp in sorted(set(p[n == nn].astype(int))):
+            sel = (n == nn) & (p == pp)
+            tp = float(np.mean(total[sel]))
+            fl, tl = laws(np.array([nn]), np.array([pp]))
+            fitted = (beta_f * 0 + beta_t * nn * np.log2(nn)) / max(
+                beta_f * fl[0] + beta_t * tl[0], 1e-30
+            )
+            print(f"  n={nn:>9} p={pp:>4}: {t1 / tp:7.2f}x  "
+                  f"(law predicts {float(fitted):7.2f}x)")
+
+    if plot_dir:
+        try:
+            plot_results(data, report, plot_dir, os.path.basename(path))
+        except Exception as e:  # plots are best-effort, like the awk path
+            print(f"# plotting skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return report
+
+
+def plot_results(data, report, plot_dir: str, stem: str):
+    """Per-n PDF: speedup scatter + fitted curve, stacked phase times —
+    mirroring the reference figure layout (analyze-results.R:119-151)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(plot_dir, exist_ok=True)
+    n, p, total, funnel, tube = data.T
+    beta_f = report["funnel"]["beta"]
+    beta_t = report["tube"]["beta"]
+
+    for nn in sorted(set(n.astype(int))):
+        sel1 = (n == nn) & (p == 1)
+        if not sel1.any():
+            continue
+        t1 = float(np.mean(total[sel1]))
+        ps = np.array(sorted(set(p[n == nn].astype(int))))
+        emp = np.array([t1 / float(np.mean(total[(n == nn) & (p == pp)]))
+                        for pp in ps])
+        grid = np.array([2**k for k in range(0, int(np.log2(ps.max())) + 1)])
+        fl, tl = laws(np.full_like(grid, nn, dtype=float), grid.astype(float))
+        fit = (beta_t * nn * np.log2(nn)) / np.maximum(
+            beta_f * fl + beta_t * tl, 1e-30)
+
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9, 3.6))
+        ax1.plot(ps, emp, "o", label="measured")
+        ax1.plot(grid, fit, "-", label="fitted law")
+        ax1.set_xscale("log", base=2)
+        ax1.set_xlabel("p")
+        ax1.set_ylabel("speedup")
+        ax1.set_title(f"n = {nn}")
+        ax1.legend()
+
+        fmean = [float(np.mean(funnel[(n == nn) & (p == pp)])) for pp in ps]
+        tmean = [float(np.mean(tube[(n == nn) & (p == pp)])) for pp in ps]
+        ax2.bar([str(v) for v in ps], fmean, label="funnel")
+        ax2.bar([str(v) for v in ps], tmean, bottom=fmean, label="tube")
+        ax2.set_xlabel("p")
+        ax2.set_ylabel("phase time (ms)")
+        ax2.legend()
+        fig.tight_layout()
+        out = os.path.join(plot_dir, f"{stem}-n{nn}.pdf")
+        fig.savefig(out)
+        plt.close(fig)
+        print(f"# wrote {out}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tsv", nargs="+")
+    ap.add_argument("--alpha", type=float, default=0.01)
+    ap.add_argument("--plots", default=None,
+                    help="directory for per-n PDF figures")
+    args = ap.parse_args(argv)
+    ok = True
+    for path in args.tsv:
+        report = analyze(path, args.alpha, args.plots)
+        ok &= report["total"]["holds"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Headline benchmark: 1-D complex FFT, N = 2^20, single TPU chip.
+
+Measures the framework's flagship path (XLA long-range stages + Pallas
+VMEM tile kernel, pi layout — gather excluded exactly as the reference
+excludes it from timing) against the native C baseline running on this
+host, and prints ONE JSON line:
+
+    {"metric": ..., "value": GFLOP/s, "unit": ..., "vs_baseline": speedup}
+
+vs_baseline is wall-clock speedup over the C backend at the same N
+(BASELINE.md north star: >= 10x; GFLOP/s uses the standard 5 N log2 N
+FFT flop count).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N = 1 << 20
+TILES = (1 << 14, 1 << 15, 1 << 16)
+REPS = 10
+
+
+def measure_tpu_ms() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas
+
+    rng = np.random.default_rng(0)
+    xr = jax.device_put(jnp.asarray(rng.standard_normal(N).astype(np.float32)))
+    xi = jax.device_put(jnp.asarray(rng.standard_normal(N).astype(np.float32)))
+
+    best = float("inf")
+    for tile in TILES:
+        try:
+            f = jax.jit(lambda a, b, t=tile: fft_pi_layout_pallas(a, b, tile=t))
+            jax.block_until_ready(f(xr, xi))  # compile + warm
+            for _ in range(REPS):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(xr, xi))
+                best = min(best, (time.perf_counter() - t0) * 1e3)
+        except Exception as e:  # a tile config failing to compile is not fatal
+            print(f"# tile={tile} failed: {type(e).__name__}", file=sys.stderr)
+    if not np.isfinite(best):
+        raise RuntimeError("no tile configuration compiled")
+    return best
+
+
+def measure_c_baseline_ms() -> float:
+    from cs87project_msolano2_tpu.backends.cpu import num_cores
+    from cs87project_msolano2_tpu.backends.registry import get_backend
+    from cs87project_msolano2_tpu.cli import make_input
+
+    p = 1
+    while p * 2 <= num_cores():
+        p *= 2
+    x = make_input(N, seed=0)
+    return get_backend("cpu").run(x, p, reps=3).total_ms
+
+
+def main() -> int:
+    tpu_ms = measure_tpu_ms()
+    c_ms = measure_c_baseline_ms()
+    gflops = 5.0 * N * np.log2(N) / (tpu_ms * 1e-3) / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "fft1d_n2^20_complex64_gflops",
+                "value": round(gflops, 1),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(c_ms / tpu_ms, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

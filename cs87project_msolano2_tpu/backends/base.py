@@ -11,9 +11,10 @@ import numpy as np
 @dataclass
 class RunResult:
     """One pi-FFT run: output in pi layout (global DIF bit-reversed order,
-    processor Pi owning [Pi*n/p, (Pi+1)*n/p)) + phase timers in ms."""
+    processor Pi owning [Pi*n/p, (Pi+1)*n/p)) + phase timers in ms.
+    `out` is None when the run was timing-only (fetch=False)."""
 
-    out: np.ndarray  # complex64, pi layout
+    out: Optional[np.ndarray]  # complex64, pi layout
     total_ms: float
     funnel_ms: float
     tube_ms: float
@@ -26,10 +27,18 @@ class Backend(Protocol):
         """Max sensible p on this hardware, or None if unlimited."""
         ...
 
-    def run(self, x: np.ndarray, p: int, reps: int = 1) -> RunResult:
+    def run(self, x: np.ndarray, p: int, reps: int = 1,
+            fetch: bool = True) -> RunResult:
         """pi-DFT of complex64 `x` (power-of-two length) with p virtual
         processors.  `reps`: timed repetitions (best-of); the output is
-        from the last rep."""
+        from the last rep.
+
+        fetch=False skips materializing the output on the host.  This
+        matters for remote-accelerator timing: on the axon TPU tunnel the
+        FIRST device->host result transfer permanently degrades the
+        process to ~100 ms/dispatch (measured; fresh executables stay
+        slow too), so timing sweeps must run entirely fetch-free and
+        fetch results only afterwards — the harness does exactly that."""
         ...
 
 

@@ -29,7 +29,10 @@ class NativeBackend:
             raise ValueError(f"unknown native backend '{self.name}'")
         return cap if cap > 0 else None
 
-    def run(self, x: np.ndarray, p: int, reps: int = 1) -> RunResult:
+    def run(self, x: np.ndarray, p: int, reps: int = 1,
+            fetch: bool = True) -> RunResult:
+        # `fetch` is part of the backend contract for remote accelerators;
+        # the native output is already host-resident, so it is ignored.
         x = check_run_args(x, p)
         lib = load_native()
         n = x.shape[-1]

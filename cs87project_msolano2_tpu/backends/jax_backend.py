@@ -22,14 +22,15 @@ from .base import RunResult, check_run_args
 @lru_cache(maxsize=32)
 def _compiled(n: int, p: int, impl: str):
     import jax
-    import jax.numpy as jnp
 
     from ..models.pi_fft import funnel, pi_fft_pi_layout, tube
     from ..ops.twiddle import twiddle_tables
 
-    tables = tuple(
-        (jnp.asarray(wr), jnp.asarray(wi)) for wr, wi in twiddle_tables(n)
-    )
+    # keep the tables as NUMPY arrays: jnp.asarray at trace time folds them
+    # into the executable as constants.  Pre-converting to device arrays
+    # makes them closure-captured runtime buffers, which the axon remote
+    # relay re-uploads on EVERY call (~100 ms/call observed at n=2^16).
+    tables = twiddle_tables(n)
 
     if impl == "pallas":
         from ..ops.pallas_fft import pi_fft_pi_layout_pallas
@@ -51,7 +52,8 @@ class JaxBackend:
     def capacity(self) -> Optional[int]:
         return None  # virtual processors: any power of two <= n
 
-    def run(self, x: np.ndarray, p: int, reps: int = 1) -> RunResult:
+    def run(self, x: np.ndarray, p: int, reps: int = 1,
+            fetch: bool = True) -> RunResult:
         import jax
         import jax.numpy as jnp
 
@@ -62,12 +64,17 @@ class JaxBackend:
         xr = jax.device_put(jnp.asarray(np.real(x), dtype=jnp.float32))
         xi = jax.device_put(jnp.asarray(np.imag(x), dtype=jnp.float32))
 
+        # All timing strictly BEFORE any device->host fetch: on the axon
+        # tunnel the first result transfer permanently drops the process
+        # into a ~100 ms/dispatch mode (see Backend.run docstring).
         funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=reps)
         tube_ms, _ = time_ms(tube_f, fr, fi, reps=reps)
         total_ms, (yr, yi) = time_ms(full_f, xr, xi, reps=reps)
 
-        out = np.asarray(yr).astype(np.complex64)
-        out.imag = np.asarray(yi)
+        out = None
+        if fetch:
+            out = np.asarray(yr).astype(np.complex64)
+            out.imag = np.asarray(yi)
         return RunResult(
             out=out, total_ms=total_ms, funnel_ms=funnel_ms, tube_ms=tube_ms
         )

@@ -1,0 +1,203 @@
+"""Pallas TPU kernel for the butterfly hot loop.
+
+The reference's hot loop is the per-processor butterfly sweep
+(…pthreads.c:544-573, …cuda.cu:442-507).  On TPU the equivalent is a
+VMEM-resident segment FFT, designed around the three constraints
+SURVEY.md §7 flags as the hard parts:
+
+* (a) no complex dtype in Pallas → separate re/im float32 planes;
+* (b) the last log2(128) stages have butterfly strides below the lane
+  width → they are collapsed into ONE dense (128, 128) constant matrix
+  applied on the MXU (a 128-point DIF *is* a linear map; matmul is the
+  lane-friendly way to apply it);
+* (d) twiddles come from precomputed tables shaped (half/128, 128), so
+  every elementwise stage is a pure VPU pass with stride ≥ one lane row.
+
+A segment of `tile` elements lives in VMEM as (tile/128, 128) float32
+planes: elementwise DIF stages run while half >= 128 (log2(tile) - 7
+stages), then the MXU tail finishes the remaining 7 levels.  Transforms
+longer than one tile run their first log2(n/tile) levels as XLA-fused
+full butterfly stages (ops.butterfly.stage_full) and then grid this
+kernel over the tiles — i.e. the paper's funnel/tube decomposition
+reused as a VMEM tiling strategy.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bits import bit_reverse_indices, ilog2
+from .butterfly import stage_full
+from .twiddle import twiddle_tables
+
+LANE = 128
+# 256 KiB of re+im per program. Measured on TPU v5e at n=2^20: 2^15 runs at
+# ~3 TFLOP/s, 2^16 ~2.1, and >=2^17 overflows VMEM (remote-compile failure).
+DEFAULT_TILE = 1 << 15
+
+
+@lru_cache(maxsize=8)
+def dif_tail_matrix_t() -> tuple[np.ndarray, np.ndarray]:
+    """B^T for the 128-point DIF as (re, im) float32.
+
+    B[j, k] = W_128^{k * bitrev7(j)} maps a 128-vector to its 128-point
+    DIF (DFT in bit-reversed order); the kernel computes x2d @ B^T.
+    """
+    j = bit_reverse_indices(LANE)  # bitrev7(j) for each output row j
+    k = np.arange(LANE)
+    bt = np.exp(-2j * np.pi * np.outer(k, j) / LANE)  # Bt[k, j] = B[j, k]
+    return bt.real.astype(np.float32), bt.imag.astype(np.float32)
+
+
+def _tile_tables(tile: int) -> list[np.ndarray]:
+    """Flat [wr0, wi0, wr1, wi1, ...] for the elementwise levels of a
+    standalone tile-point plan, each shaped (half/128, 128)."""
+    out = []
+    for l, (wr, wi) in enumerate(twiddle_tables(tile)):
+        half = tile >> (l + 1)
+        if half < LANE:
+            break
+        out.append(wr.reshape(half // LANE, LANE))
+        out.append(wi.reshape(half // LANE, LANE))
+    return out
+
+
+def _tile_fft_kernel(nlev: int, *refs):
+    """Pallas kernel body: full DIF FFT of one (tile/128, 128) block.
+
+    refs = (xr, xi, wr0, wi0, ..., btr, bti, or_, oi) block refs.
+    """
+    xr_ref, xi_ref = refs[0], refs[1]
+    tw = refs[2 : 2 + 2 * nlev]
+    btr_ref, bti_ref = refs[2 + 2 * nlev], refs[3 + 2 * nlev]
+    or_ref, oi_ref = refs[4 + 2 * nlev], refs[5 + 2 * nlev]
+
+    xr = xr_ref[:, :]
+    xi = xi_ref[:, :]
+    rows = xr.shape[0]
+
+    # elementwise DIF stages while half >= one lane row
+    for l in range(nlev):
+        half_rows = rows >> (l + 1)
+        wr = tw[2 * l][:, :]
+        wi = tw[2 * l + 1][:, :]
+        xr4 = xr.reshape(-1, 2, half_rows, LANE)
+        xi4 = xi.reshape(-1, 2, half_rows, LANE)
+        ar, br = xr4[:, 0], xr4[:, 1]
+        ai, bi = xi4[:, 0], xi4[:, 1]
+        tr, ti = ar + br, ai + bi
+        dr, di = ar - br, ai - bi
+        ur = dr * wr - di * wi
+        ui = dr * wi + di * wr
+        xr = jnp.stack((tr, ur), axis=1).reshape(rows, LANE)
+        xi = jnp.stack((ti, ui), axis=1).reshape(rows, LANE)
+
+    # MXU tail: the 7 sub-lane levels of every 128-chunk as one matmul
+    btr = btr_ref[:, :]
+    bti = bti_ref[:, :]
+    dot = partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    or_ref[:, :] = dot(xr, btr) - dot(xi, bti)
+    oi_ref[:, :] = dot(xr, bti) + dot(xi, btr)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None):
+    """Grid the tile kernel over rows: (R, tile//128*...)  Input planes
+    shaped (total_rows, 128) with total_rows % (tile/128) == 0; each
+    consecutive group of tile/128 rows is one independent tile-point DIF.
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = _use_interpret()
+
+    trows = tile // LANE
+    total_rows = xr2d.shape[0]
+    ntiles = total_rows // trows
+    nlev = max(ilog2(tile) - 7, 0)
+
+    tables = [jnp.asarray(t) for t in _tile_tables(tile)]
+    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t())
+
+    in_specs = [pl.BlockSpec((trows, LANE), lambda i: (i, 0))] * 2
+    in_specs += [
+        pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables
+    ]
+    in_specs += [pl.BlockSpec((LANE, LANE), lambda i: (0, 0))] * 2
+
+    out = pl.pallas_call(
+        partial(_tile_fft_kernel, nlev),
+        grid=(ntiles,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((trows, LANE), lambda i: (i, 0))] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((total_rows, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((total_rows, LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr2d, xi2d, *tables, btr, bti)
+    return out[0], out[1]
+
+
+def _choose_tile(seg: int, tile: int | None) -> int:
+    if tile is None:
+        tile = min(seg, DEFAULT_TILE)
+    if tile < LANE or seg % tile:
+        raise ValueError(f"tile={tile} must be >=128 and divide segment {seg}")
+    return tile
+
+
+def fft_pi_layout_pallas(xr, xi, tile: int | None = None, interpret=None):
+    """Full n-point DIF FFT (pi layout) of 1-D planes: XLA-fused long-range
+    stages down to `tile`, then the Pallas VMEM kernel over tiles."""
+    n = xr.shape[-1]
+    tile = _choose_tile(n, tile)
+    tables = twiddle_tables(n)
+    for l in range(ilog2(n // tile)):
+        wr, wi = tables[l]
+        xr, xi = stage_full(xr, xi, jnp.asarray(wr), jnp.asarray(wi))
+    yr, yi = tile_fft_grid(
+        xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret
+    )
+    return yr.reshape(n), yi.reshape(n)
+
+
+def pi_fft_pi_layout_pallas(xr, xi, p: int, tile: int | None = None,
+                            interpret=None):
+    """The pi-FFT (funnel + tube) with the tube's segment FFTs on the
+    Pallas kernel.  Matches models.pi_fft.pi_fft_pi_layout semantics;
+    requires segment n/p >= 128 (falls back to the jnp path below that).
+    """
+    from ..models.pi_fft import funnel, pi_fft_pi_layout
+
+    n = xr.shape[-1]
+    s = n // p
+    if s < LANE:
+        return pi_fft_pi_layout(xr, xi, p)
+
+    tile = _choose_tile(s, tile)
+    tables = twiddle_tables(n)
+    fr, fi = funnel(xr, xi, p, tables)  # (p, s)
+
+    # remaining long-range tube levels until segments fit one tile
+    k = ilog2(p)
+    for l in range(ilog2(s // tile)):
+        wr, wi = tables[k + l]
+        fr, fi = stage_full(fr, fi, jnp.asarray(wr), jnp.asarray(wi))
+
+    yr, yi = tile_fft_grid(
+        fr.reshape(-1, LANE), fi.reshape(-1, LANE), tile, interpret
+    )
+    return yr.reshape(n), yi.reshape(n)

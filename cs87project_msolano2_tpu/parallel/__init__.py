@@ -1,0 +1,8 @@
+"""Multi-chip layer: meshes, the zero-collective sharded pi-FFT, DP-batched
+FFT, and the all_to_all 2-D FFT / 3-D Poisson configs."""
+
+from .mesh import how_many_devices, make_mesh, make_mesh2d  # noqa: F401
+from .pi_shard import pi_fft_sharded, pi_fft_sharded_batched  # noqa: F401
+from .batched import fft_batched_sharded  # noqa: F401
+from .fft2d import fft2_sharded  # noqa: F401
+from .poisson3d import poisson_solve_sharded  # noqa: F401

@@ -1,0 +1,33 @@
+"""Batched FFT, pure data parallelism over the mesh: BASELINE.json config 3
+("Batched 1D FFT, batch x N over TPU cores").  Each device transforms its
+own batch shard locally — like the pi funnel, this needs no collectives;
+it is the honest multi-chip analogue of the paper's claim for the batched
+workload."""
+
+from __future__ import annotations
+
+import jax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.fft import fft, ifft
+
+
+def fft_batched_sharded(x, mesh, axis: str = "data", inverse: bool = False):
+    """1-D FFT along the trailing axis of complex (B, n), batch-sharded
+    over `axis`.  Natural frequency order output, same sharding."""
+    f = ifft if inverse else fft
+
+    fn = shard_map(
+        lambda xb: f(xb),
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    return fn(x)
+
+
+def jit_fft_batched(mesh, axis: str = "data"):
+    import functools
+
+    return jax.jit(functools.partial(fft_batched_sharded, mesh=mesh, axis=axis))

@@ -1,0 +1,42 @@
+"""Distributed 2-D FFT over ICI (BASELINE.json config 4): row FFTs local,
+`lax.all_to_all` transpose, column FFTs local, transpose back.
+
+This is the one place the framework genuinely needs communication — the
+2-D transform's data dependencies span both axes — and per SURVEY.md §2.3
+it uses the XLA collective over ICI (tiled all_to_all), not a
+point-to-point port of anything in the reference (which has no multi-node
+path at all)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.fft import fft, ifft
+
+
+def fft2_sharded(x, mesh, axis: str = "p", inverse: bool = False):
+    """2-D FFT of complex (R, C), rows sharded over the mesh axis.
+    Returns the full 2-D transform, rows still sharded.  R and C must be
+    divisible by the axis size."""
+    p = mesh.shape[axis]
+    f = ifft if inverse else fft
+
+    def device_fn(xb):  # (R/p, C)
+        y = f(xb)  # row transforms
+        # ICI transpose: (R/p, C) -> (R, C/p)
+        y = jax.lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
+                               tiled=True)
+        # column transforms (axis 0 is now fully local)
+        y = jnp.swapaxes(f(jnp.swapaxes(y, 0, 1)), 0, 1)
+        # transpose back: (R, C/p) -> (R/p, C)
+        return jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+    fn = shard_map(
+        device_fn, mesh=mesh, in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    return fn(x)

@@ -1,0 +1,43 @@
+"""Device meshes (the TPU equivalent of the reference's processor-topology
+layer: thread→core pinning becomes shard→device placement over ICI).
+
+The reference pins threads to bit-reversed core ids (…pthreads.c:339-344)
+to spread funnel siblings; on TPU the funnel needs no placement trick at
+all — every device computes its own chain on a replicated copy — so the
+mesh here is plain: a 1-D axis for the pi decomposition ("p"), optionally
+a leading data axis for batch parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "p") -> Mesh:
+    """1-D mesh over the first n_devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n_devices]), (axis,))
+
+
+def make_mesh2d(
+    dp: int, p: int, axes: Sequence[str] = ("data", "p")
+) -> Mesh:
+    """(dp x p) mesh: data-parallel batches x pi-decomposition segments."""
+    devs = jax.devices()
+    if dp * p > len(devs):
+        raise ValueError(f"need {dp * p} devices, have {len(devs)}")
+    return Mesh(np.array(devs[: dp * p]).reshape(dp, p), tuple(axes))
+
+
+def how_many_devices() -> int:
+    """Device-capacity probe (parity with the reference probes N4/N5:
+    how-many-cpu-cores / how-many-concurrent-blocks)."""
+    return len(jax.devices())

@@ -1,0 +1,75 @@
+"""3-D spectral Poisson solver with slab decomposition (BASELINE.json
+config 5): solve lap(u) = f on a periodic [0, 2*pi)^3 grid.
+
+Slabs are sharded along axis 0.  Per slab: local FFT over axes 1-2, one
+all_to_all transpose to localize axis 0, FFT over axis 0, multiply by
+-1/|k|^2 (zero mode -> 0: the mean-free solution), then invert the
+pipeline.  Two ICI transposes per solve — the textbook slab pattern."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..models.fft import fft, ifft
+
+
+def _wavenumbers(m: int) -> np.ndarray:
+    """Integer wavenumbers for an m-point periodic axis (fftfreq * m)."""
+    k = np.arange(m)
+    k[k > m // 2] -= m
+    return k.astype(np.float32)
+
+
+def _fft_axis(x, ax: int, inverse: bool):
+    f = ifft if inverse else fft
+    return jnp.moveaxis(f(jnp.moveaxis(x, ax, -1)), -1, ax)
+
+
+def poisson_solve_sharded(f, mesh, axis: str = "p"):
+    """u with lap(u) = f, zero-mean; f real (n1, n2, n3) sharded on axis 0.
+
+    Returns real u, same sharding.  n1 and n2 must be divisible by the
+    mesh axis size.
+    """
+    p = mesh.shape[axis]
+    n1, n2, n3 = f.shape
+    k1 = jnp.asarray(_wavenumbers(n1))
+    k2 = jnp.asarray(_wavenumbers(n2))
+    k3 = jnp.asarray(_wavenumbers(n3))
+
+    def device_fn(fb):  # (n1/p, n2, n3)
+        g = fb.astype(jnp.complex64)
+        g = _fft_axis(g, 2, False)
+        g = _fft_axis(g, 1, False)
+        # localize axis 0: (n1/p, n2, n3) -> (n1, n2/p, n3)
+        g = jax.lax.all_to_all(g, axis, split_axis=1, concat_axis=0,
+                               tiled=True)
+        g = _fft_axis(g, 0, False)
+
+        # spectral inverse Laplacian on the (n1, n2/p, n3) block
+        i = jax.lax.axis_index(axis)
+        k2_loc = jax.lax.dynamic_slice_in_dim(k2, i * (n2 // p), n2 // p)
+        ksq = (
+            k1[:, None, None] ** 2
+            + k2_loc[None, :, None] ** 2
+            + k3[None, None, :] ** 2
+        )
+        inv = jnp.where(ksq > 0, -1.0 / jnp.maximum(ksq, 1e-30), 0.0)
+        g = g * inv.astype(jnp.complex64)
+
+        g = _fft_axis(g, 0, True)
+        g = jax.lax.all_to_all(g, axis, split_axis=0, concat_axis=1,
+                               tiled=True)
+        g = _fft_axis(g, 1, True)
+        g = _fft_axis(g, 2, True)
+        return jnp.real(g)
+
+    fn = shard_map(
+        device_fn, mesh=mesh, in_specs=(P(axis, None, None),),
+        out_specs=P(axis, None, None),
+    )
+    return fn(f)

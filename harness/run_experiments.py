@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Experiment harness (L4): sweep (T reps x n grid x p grid) per backend,
+append TSV rows, estimate remaining time, optionally cross-verify.
+
+Parity with the reference drivers (cpu/pthreads/run-experiments-and-
+analyze-results:27-69, gpu/cuda/run-experiments:15-73) plus what they
+lacked: resume (append-only TSV is scanned and completed (n, p) cells are
+skipped — the reference's interrupted sweeps kept completed rows, we also
+skip re-running them), per-config cross-backend verification, and a
+--backend list so one sweep drives the dual-backend agreement story.
+
+TSV contract: `n  p  total_ms  funnel_ms  tube_ms` (5 columns, exactly
+the reference's …pthreads.c:487-491), one file per backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from cs87project_msolano2_tpu.backends.registry import get_backend  # noqa: E402
+from cs87project_msolano2_tpu.cli import make_input  # noqa: E402
+from cs87project_msolano2_tpu.utils.verify import (  # noqa: E402
+    pi_layout_to_natural,
+    rel_err,
+)
+
+
+def parse_grid(spec: str) -> list[int]:
+    """'1024,2048' or '1024..8192' (powers-of-two range, inclusive)."""
+    if ".." in spec:
+        lo, hi = (int(v) for v in spec.split(".."))
+        out = []
+        v = lo
+        while v <= hi:
+            out.append(v)
+            v *= 2
+        return out
+    return [int(v) for v in spec.split(",")]
+
+
+def result_path(outdir: str, backend: str) -> str:
+    return os.path.join(outdir, f"fourier-parallel-pi-{backend}-results.tsv")
+
+
+def done_counts(path: str) -> Counter:
+    """(n, p) -> completed replication count, from an existing TSV."""
+    done: Counter = Counter()
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                parts = line.split("\t")
+                if len(parts) == 5 and parts[0].isdigit():
+                    done[(int(parts[0]), int(parts[1]))] += 1
+    return done
+
+
+def grid_cells(backend_name: str, ns: list[int], ps: list[int]):
+    backend = get_backend(backend_name)
+    cap = backend.capacity()
+    ps_eff = [p for p in ps if cap is None or p <= cap]
+    if len(ps_eff) < len(ps):
+        print(f"# {backend_name}: capacity {cap} clips p-grid to {ps_eff}",
+              file=sys.stderr)
+    return backend, [(n, p) for n in ns for p in ps_eff if p <= n]
+
+
+def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
+          outdir: str, resume: bool, seed: int) -> str:
+    """Timing pass: append TSV rows, NO result fetches (on remote
+    accelerators the first device->host transfer permanently inflates
+    per-dispatch latency — see Backend.run; verification is a separate
+    pass that runs after ALL timing)."""
+    os.makedirs(outdir, exist_ok=True)
+    backend, cells = grid_cells(backend_name, ns, ps)
+    path = result_path(outdir, backend_name)
+    done = done_counts(path) if resume else Counter()
+
+    todo = sum(max(reps - done[c], 0) for c in cells)
+    t_start = time.perf_counter()
+    completed = 0
+
+    with open(path, "a") as fh:
+        for n, p in cells:
+            x = make_input(n, seed)
+            for rep in range(done[(n, p)], reps):
+                res = backend.run(x, p, fetch=False)
+                fh.write(f"{n}\t{p}\t{res.total_ms:.6f}\t{res.funnel_ms:.6f}"
+                         f"\t{res.tube_ms:.6f}\n")
+                fh.flush()
+                completed += 1
+                if completed % 10 == 0 or completed == todo:
+                    elapsed = time.perf_counter() - t_start
+                    eta = elapsed / completed * (todo - completed)
+                    print(f"# {backend_name} {completed}/{todo} "
+                          f"(n={n} p={p}) eta {eta:5.0f}s", file=sys.stderr)
+    return path
+
+
+def verify_pass(backend_name: str, ns: list[int], ps: list[int],
+                seed: int) -> None:
+    """Correctness pass: one fetched run per cell, checked against numpy."""
+    backend, cells = grid_cells(backend_name, ns, ps)
+    for n, p in cells:
+        x = make_input(n, seed)
+        ref = np.fft.fft(x.astype(np.complex128))
+        res = backend.run(x, p)
+        err = rel_err(pi_layout_to_natural(res.out), ref)
+        if err > 1e-5:
+            raise AssertionError(
+                f"{backend_name} n={n} p={p}: rel err {err:.2e}"
+            )
+    print(f"# {backend_name}: all {len(cells)} cells verified vs numpy fft",
+          file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--backends", default="serial",
+                    help="comma-separated backend list")
+    ap.add_argument("--n-grid", default="1024..8192",
+                    help="'a,b,c' or 'lo..hi' powers of two")
+    ap.add_argument("--p-grid", default="1..32")
+    ap.add_argument("-T", "--reps", type=int, default=10,
+                    help="replications per cell (reference default)")
+    ap.add_argument("--out", default=os.path.join(REPO, "results"))
+    ap.add_argument("--no-resume", action="store_true",
+                    help="re-run cells already present in the TSV")
+    ap.add_argument("--verify", action="store_true",
+                    help="check every config against numpy's FFT")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    ns = parse_grid(args.n_grid)
+    ps = parse_grid(args.p_grid)
+    backends = [b.strip() for b in args.backends.split(",")]
+    # ALL timing before ANY verification fetch (see sweep docstring)
+    for b in backends:
+        path = sweep(b, ns, ps, args.reps, args.out,
+                     not args.no_resume, args.seed)
+        print(path)
+    if args.verify:
+        for b in backends:
+            verify_pass(b, ns, ps, args.seed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,3 +42,14 @@ def test_reps_best_of():
     x = make_input(256, seed=14)
     res = get_backend("serial").run(x, 4, reps=3)
     assert res.total_ms > 0
+
+
+@pytest.mark.parametrize("backend", ["serial", "jax"])
+def test_fetch_false_times_without_output(backend):
+    """The timing-only contract: no host output, finite timers (guards the
+    axon D2H-poison protection — see Backend.run)."""
+    x = make_input(512, seed=15)
+    res = get_backend(backend).run(x, 4, fetch=False)
+    assert res.total_ms >= 0 and np.isfinite(res.total_ms)
+    if backend == "jax":
+        assert res.out is None

@@ -1,0 +1,126 @@
+"""L4/L5 tests: harness sweep (append-only TSV, resume) and the law-fit
+analysis (the reference's statistical integration test, SURVEY.md §4.2)."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_module(relpath, name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def sweep_tsv(tmp_path_factory):
+    out = tmp_path_factory.mktemp("sweep")
+    he = load_module("harness/run_experiments.py", "run_experiments")
+    path = he.sweep("serial", [256, 1024], [1, 2, 4, 8], reps=3,
+                    outdir=str(out), resume=True, seed=0)
+    he.verify_pass("serial", [256, 1024], [1, 2, 4, 8], seed=0)
+    return path
+
+
+def test_sweep_rows_and_contract(sweep_tsv):
+    rows = [l.split("\t") for l in open(sweep_tsv).read().strip().splitlines()]
+    assert len(rows) == 2 * 4 * 3  # n-grid x p-grid x reps
+    assert all(len(r) == 5 for r in rows)
+
+
+def test_sweep_resume_skips_done(sweep_tsv):
+    he = load_module("harness/run_experiments.py", "run_experiments")
+    before = open(sweep_tsv).read()
+    path = he.sweep("serial", [256, 1024], [1, 2, 4, 8], reps=3,
+                    outdir=os.path.dirname(sweep_tsv), resume=True, seed=0)
+    assert path == sweep_tsv
+    assert open(sweep_tsv).read() == before  # nothing re-run
+
+
+def test_capacity_clipping(tmp_path):
+    he = load_module("harness/run_experiments.py", "run_experiments")
+    from cs87project_msolano2_tpu.backends.cpu import num_cores
+
+    path = he.sweep("pthreads", [256], [1, 2 * num_cores() * 64], reps=1,
+                    outdir=str(tmp_path), resume=False, seed=0)
+    rows = open(path).read().strip().splitlines()
+    assert len(rows) == 1  # the over-capacity p was clipped
+
+
+def test_parse_grid():
+    he = load_module("harness/run_experiments.py", "run_experiments")
+    assert he.parse_grid("1..32") == [1, 2, 4, 8, 16, 32]
+    assert he.parse_grid("1024,4096") == [1024, 4096]
+
+
+def test_law_fit_on_synthetic_data(tmp_path):
+    """Data generated exactly from the law (+noise) must pass; data from a
+    different law (constant time) must fail the funnel fit."""
+    an = load_module("analysis/analyze_results.py", "analyze_results")
+    rng = np.random.default_rng(0)
+    good = tmp_path / "good.tsv"
+    with open(good, "w") as fh:
+        for n in (1024, 4096, 16384):
+            for p in (1, 2, 4, 8, 16):
+                for _ in range(5):
+                    fl, tl = an.laws(np.array([float(n)]), np.array([float(p)]))
+                    noise = 1 + 0.05 * rng.standard_normal()
+                    total = (2e-6 * fl[0] + 3e-6 * tl[0]) * noise + 1e-4
+                    fh.write(f"{n}\t{p}\t{total:.6f}\t"
+                             f"{2e-6 * fl[0] * noise:.6f}\t"
+                             f"{3e-6 * tl[0] * noise:.6f}\n")
+    rep = an.analyze(str(good))
+    assert all(rep[k]["holds"] for k in ("total", "funnel", "tube"))
+    assert abs(rep["funnel"]["beta"] - 2e-6) / 2e-6 < 0.05
+    assert abs(rep["tube"]["beta"] - 3e-6) / 3e-6 < 0.05
+
+
+def test_law_fit_on_real_sweep(sweep_tsv):
+    """The serial backend's per-processor phase timers must obey the law
+    (the project's own 'scales as designed' verification)."""
+    an = load_module("analysis/analyze_results.py", "analyze_results")
+    rep = an.analyze(sweep_tsv)
+    assert rep["funnel"]["holds"] and rep["tube"]["holds"]
+    assert rep["funnel"]["r2"] > 0.9
+    assert rep["tube"]["r2"] > 0.9
+
+
+def test_dispatcher_and_awk_fallback(sweep_tsv):
+    """The bash dispatcher runs the full analysis; the awk fallback must
+    agree with the python fit to ~3 significant digits."""
+    full = subprocess.run(
+        [os.path.join(REPO, "analysis", "analyze-results"), sweep_tsv],
+        capture_output=True, text=True,
+    )
+    assert full.returncode == 0, full.stderr
+    assert "law holds: Yes" in full.stdout
+
+    awk = subprocess.run(
+        ["awk", "-f", os.path.join(REPO, "analysis", "analyze-results.awk"),
+         sweep_tsv],
+        capture_output=True, text=True,
+    )
+    assert awk.returncode == 0
+    an = load_module("analysis/analyze_results.py", "analyze_results")
+    rep = an.analyze(sweep_tsv)
+    awk_beta = float(awk.stdout.split("~")[1].split("*")[0])
+    assert abs(awk_beta - rep["total"]["beta"]) / rep["total"]["beta"] < 1e-3
+
+
+def test_missing_results_guard():
+    r = subprocess.run(
+        [os.path.join(REPO, "analysis", "analyze-results"),
+         "/nonexistent/results.tsv"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "run the experiments first" in r.stderr

@@ -1,0 +1,80 @@
+"""Pallas kernel tests (interpret mode on the CPU backend; the same code
+compiles for TPU — bench.py exercises that on hardware)."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+from cs87project_msolano2_tpu.ops.pallas_fft import (
+    dif_tail_matrix_t,
+    fft_pi_layout_pallas,
+    pi_fft_pi_layout_pallas,
+)
+from cs87project_msolano2_tpu.utils.verify import pi_layout_to_natural, rel_err
+
+
+def rand_planes(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal(n).astype(np.float32),
+        rng.standard_normal(n).astype(np.float32),
+    )
+
+
+def to_complex(yr, yi):
+    return np.asarray(yr).astype(np.complex128) + 1j * np.asarray(yi)
+
+
+def test_tail_matrix_is_dif128():
+    """B must equal seven elementwise DIF stages applied to the identity."""
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.ops.butterfly import stage_full
+    from cs87project_msolano2_tpu.ops.twiddle import twiddle_tables
+
+    eye_r = np.eye(128, dtype=np.float32)
+    eye_i = np.zeros((128, 128), dtype=np.float32)
+    xr, xi = jnp.asarray(eye_r), jnp.asarray(eye_i)
+    for wr, wi in twiddle_tables(128):
+        xr, xi = stage_full(xr, xi, jnp.asarray(wr), jnp.asarray(wi))
+    btr, bti = dif_tail_matrix_t()
+    # rows of the staged result are DIF(e_k) == columns of B == rows of B^T
+    assert rel_err(to_complex(xr, xi), to_complex(btr, bti)) < 1e-6
+
+
+@pytest.mark.parametrize("n,tile", [(128, None), (1024, None), (4096, 512),
+                                    (1 << 14, None)])
+def test_fft_pallas_vs_numpy(n, tile):
+    xr, xi = rand_planes(n, seed=1)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas(xr, xi, tile=tile)
+    nat = pi_layout_to_natural(to_complex(yr, yi))
+    assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
+@pytest.mark.parametrize("p", [1, 4, 64])
+def test_pi_fft_pallas_matches_jnp(p):
+    from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
+
+    n = 1 << 13
+    xr, xi = rand_planes(n, seed=2)
+    yr, yi = pi_fft_pi_layout_pallas(xr, xi, p)
+    rr, ri = pi_fft_pi_layout(xr, xi, p)
+    assert rel_err(to_complex(yr, yi), to_complex(rr, ri)) < 1e-6
+
+
+def test_pi_fft_pallas_small_segment_fallback():
+    n, p = 512, 16  # s = 32 < 128 -> jnp fallback
+    xr, xi = rand_planes(n, seed=3)
+    yr, yi = pi_fft_pi_layout_pallas(xr, xi, p)
+    x = xr.astype(np.complex128) + 1j * xi
+    nat = pi_layout_to_natural(to_complex(yr, yi))
+    assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
+def test_backend_pallas_golden():
+    from cs87project_msolano2_tpu.backends.registry import get_backend
+    from cs87project_msolano2_tpu.utils import verify
+
+    res = get_backend("pallas").run(verify.golden_input(), 2)
+    assert verify.golden_check_exact(verify.pi_layout_to_natural(res.out))
