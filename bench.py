@@ -2,50 +2,71 @@
 
 Measures the framework's flagship path (XLA long-range stages + Pallas
 VMEM tile kernel, pi layout — gather excluded exactly as the reference
-excludes it from timing) against the native C baseline running on this
-host, and prints ONE JSON line:
+excludes it from timing) against the native C baseline on this host, and
+prints ONE JSON line:
 
     {"metric": ..., "value": GFLOP/s, "unit": ..., "vs_baseline": speedup}
 
 vs_baseline is wall-clock speedup over the C backend at the same N
 (BASELINE.md north star: >= 10x; GFLOP/s uses the standard 5 N log2 N
 FFT flop count).
+
+Measurement method: loop-slope (utils/timing.py) — on the axon TPU relay
+block_until_ready is not a real barrier, so the FFT is iterated K times
+inside one jitted fori_loop ending in a scalar fetch, at two K values;
+the per-FFT time is the slope and the ~100 ms relay overhead cancels.
+On hardware where block_until_ready is honest the same method simply
+measures with less noise.
 """
 
 import json
 import sys
-import time
 
 import numpy as np
 
 N = 1 << 20
-TILES = (1 << 14, 1 << 15, 1 << 16)
-REPS = 10
+# (impl, tile, cb): two-kernel first (fastest measured: ~0.11 ms at
+# tile=2^16 cb=2^14 = ~930 GFLOP/s), hybrid as fallback configs
+CONFIGS = (
+    ("two-kernel", 1 << 16, 1 << 14),
+    ("two-kernel", 1 << 16, 1 << 16),
+    ("hybrid", 1 << 16, None),
+    ("hybrid", 1 << 15, None),
+)
 
 
 def measure_tpu_ms() -> float:
     import jax
     import jax.numpy as jnp
 
-    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas
+    from cs87project_msolano2_tpu.ops.pallas_fft import (
+        fft_pi_layout_pallas,
+        fft_pi_layout_pallas2,
+    )
+    from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
 
-    rng = np.random.default_rng(0)
-    xr = jax.device_put(jnp.asarray(rng.standard_normal(N).astype(np.float32)))
-    xi = jax.device_put(jnp.asarray(rng.standard_normal(N).astype(np.float32)))
+    key = jax.random.PRNGKey(0)
+    xr = jax.random.normal(key, (N,), jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(key, 1), (N,), jnp.float32)
 
+    inv_rn = np.float32(1.0 / np.sqrt(N))  # keep loop iterates in range
     best = float("inf")
-    for tile in TILES:
+    for impl, tile, cb in CONFIGS:
         try:
-            f = jax.jit(lambda a, b, t=tile: fft_pi_layout_pallas(a, b, tile=t))
-            jax.block_until_ready(f(xr, xi))  # compile + warm
-            for _ in range(REPS):
-                t0 = time.perf_counter()
-                jax.block_until_ready(f(xr, xi))
-                best = min(best, (time.perf_counter() - t0) * 1e3)
-        except Exception as e:  # a tile config failing to compile is not fatal
-            print(f"# tile={tile} failed: {type(e).__name__}", file=sys.stderr)
+            def body(c, impl=impl, t=tile, cb=cb):
+                if impl == "two-kernel":
+                    yr, yi = fft_pi_layout_pallas2(c[0], c[1], tile=t, cb=cb)
+                else:
+                    yr, yi = fft_pi_layout_pallas(c[0], c[1], tile=t)
+                return yr * inv_rn, yi * inv_rn
+
+            ms = loop_slope_ms(body, (xr, xi), k1=32, k2=512, reps=3)
+            best = min(best, ms)
+        except Exception as e:  # a config failing to compile is not fatal
+            print(f"# {impl} tile={tile} cb={cb} failed: {type(e).__name__}",
+                  file=sys.stderr)
     if not np.isfinite(best):
-        raise RuntimeError("no tile configuration compiled")
+        raise RuntimeError("no benchmark configuration compiled")
     return best
 
 

@@ -1,0 +1,149 @@
+"""All five BASELINE.json configs, measured end-to-end (bench.py is the
+driver's one-line headline; this is the full evidence table, written to
+datasets/bench_configs.json).
+
+Device timing uses the loop-slope method (utils/timing.py): on the axon
+relay block_until_ready is not a real barrier, so each config is iterated
+inside one jitted fori_loop ending in a scalar fetch and the per-op time
+is the slope between two iteration counts.  Inputs for large configs are
+generated on-device so no bulk H2D rides the relay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+
+def config1_direct_dft_f64():
+    """1D complex DFT, N=1024, float64 (CPU reference run)."""
+    from cs87project_msolano2_tpu.models.direct_dft import dft_direct
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(1024) + 1j * rng.standard_normal(1024)
+    t0 = time.perf_counter()
+    y = dft_direct(x, dtype=np.complex128)
+    ms = (time.perf_counter() - t0) * 1e3
+    err = float(np.max(np.abs(y - np.fft.fft(x))) / np.max(np.abs(y)))
+    return {"config": "1D DFT N=1024 float64 (CPU einsum reference)",
+            "ms": round(ms, 3), "rel_err_vs_numpy": err}
+
+
+def config2_pallas_2e20():
+    """1D radix-2 FFT, N=2^20, complex64, single-chip Pallas."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas
+
+    n = 1 << 20
+    key = jax.random.PRNGKey(0)
+    xr = jax.random.normal(key, (n,), jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(key, 1), (n,), jnp.float32)
+    inv = np.float32(1.0 / np.sqrt(n))
+
+    def body(c):
+        yr, yi = fft_pi_layout_pallas(c[0], c[1])
+        return yr * inv, yi * inv
+
+    ms = loop_slope_ms(body, (xr, xi))
+    return {"config": "1D FFT N=2^20 complex64 (single-chip Pallas)",
+            "ms": round(ms, 4),
+            "gflops": round(5 * n * 20 / (ms * 1e-3) / 1e9, 1)}
+
+
+def config3_batched():
+    """Batched 1D FFT, batch=4096 x N=4096, mesh-sharded batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.parallel import fft_batched_planes, make_mesh
+
+    mesh = make_mesh(min(len(jax.devices()), 4), axis="data")
+    b, n = 4096, 4096
+    key = jax.random.PRNGKey(2)
+    xr = jax.random.normal(key, (b, n), jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(key, 1), (b, n), jnp.float32)
+    inv = np.float32(1.0 / 64.0)
+
+    def body(c):
+        yr, yi = fft_batched_planes(c[0], c[1], mesh)
+        return yr * inv, yi * inv
+
+    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64)
+    flops = 5 * b * n * np.log2(n)
+    return {"config": f"batched FFT {b}x{n} (DP over {mesh.devices.size} devices)",
+            "ms": round(ms, 3),
+            "gflops": round(flops / (ms * 1e-3) / 1e9, 1)}
+
+
+def config4_fft2d():
+    """2D FFT 4096x4096 via row/col passes (+ all_to_all when mesh > 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.parallel import fft2_sharded_planes, make_mesh
+
+    mesh = make_mesh(min(len(jax.devices()), 8))
+    r = c = 4096
+    key = jax.random.PRNGKey(3)
+    xr = jax.random.normal(key, (r, c), jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(key, 1), (r, c), jnp.float32)
+    inv = np.float32(1.0 / 4096.0)
+
+    def body(v):
+        yr, yi = fft2_sharded_planes(v[0], v[1], mesh)
+        return yr * inv, yi * inv
+
+    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64)
+    flops = 5 * r * c * (np.log2(r) + np.log2(c))
+    return {"config": f"2D FFT {r}x{c} ({mesh.devices.size}-device slab)",
+            "ms": round(ms, 3),
+            "gflops": round(flops / (ms * 1e-3) / 1e9, 1)}
+
+
+def config5_poisson():
+    """3D spectral Poisson solve, slab decomposition.  512^3 needs the
+    multi-chip config; on fewer chips the grid shrinks to fit (reported)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.parallel import make_mesh, poisson_solve_sharded
+
+    ndev = min(len(jax.devices()), 8)
+    mesh = make_mesh(ndev)
+    side = 512 if ndev >= 8 else 256
+    key = jax.random.PRNGKey(4)
+    fsrc = jax.random.normal(key, (side, side, side), jnp.float32)
+    ms = loop_slope_ms(
+        lambda v: (poisson_solve_sharded(v[0], mesh),), (fsrc,), k1=4, k2=32
+    )
+    return {"config": f"3D Poisson {side}^3 slab solve ({ndev} device(s))",
+            "ms": round(ms, 2)}
+
+
+def main() -> int:
+    results = []
+    for fn in (config1_direct_dft_f64, config2_pallas_2e20, config3_batched,
+               config4_fft2d, config5_poisson):
+        try:
+            r = fn()
+        except Exception as e:
+            r = {"config": fn.__doc__.splitlines()[0],
+                 "error": f"{type(e).__name__}: {e}"}
+        results.append(r)
+        print(json.dumps(r))
+    os.makedirs("datasets", exist_ok=True)
+    with open("datasets/bench_configs.json", "w") as fh:
+        json.dump(results, fh, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,10 +2,15 @@
 
 Compilation is cached per (n, p) shape; twiddle tables are baked into the
 compiled program as constants (they are the "weights" of this model).
-Phase timers follow the reference's contract (funnel / tube / total) but
-the TPU way: separately-jitted phases timed with block_until_ready, plus
-a fused whole-transform program for the honest total (XLA fuses across
-the phase boundary, and the fused number is what bench.py reports).
+Phase timers follow the reference's contract (funnel / tube / total).
+
+Timing method depends on the platform: on CPU (tests, local runs)
+block_until_ready is a real barrier and phases are timed directly; on
+remote accelerators (the axon TPU relay) block_until_ready does NOT wait
+for the device, so each phase is measured with the loop-slope method
+(utils/timing.py::loop_slope_ms) — K-iteration in-jit loops with a
+scalar-fetch barrier, per-op time recovered as the slope between two K
+values so the ~100 ms relay overhead cancels exactly.
 """
 
 from __future__ import annotations
@@ -15,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..utils.timing import time_ms
+from ..utils.timing import loop_slope_ms, needs_loop_slope, time_ms
 from .base import RunResult, check_run_args
 
 
@@ -36,12 +41,86 @@ def _compiled(n: int, p: int, impl: str):
         from ..ops.pallas_fft import pi_fft_pi_layout_pallas
 
         full = jax.jit(partial(pi_fft_pi_layout_pallas, p=p))
+    elif impl == "einsum":
+        import jax.numpy as jnp
+
+        from ..models.direct_dft import dft_direct_pi
+
+        def _einsum_full(xr, xi):
+            y = dft_direct_pi(xr + 1j * xi.astype(jnp.complex64), p)
+            return jnp.real(y), jnp.imag(y)
+
+        full = jax.jit(_einsum_full)
     else:
         full = jax.jit(lambda xr, xi: pi_fft_pi_layout(xr, xi, p, tables))
 
     funnel_f = jax.jit(lambda xr, xi: funnel(xr, xi, p, tables))
-    tube_f = jax.jit(lambda sr, si: tube(sr, si, n, p, tables))
+    if impl == "pallas":
+        # pallas tube for the phase timer too: the fully-unrolled jnp tube
+        # takes minutes of XLA compile at n=2^20; the kernel takes seconds
+        from ..ops.pallas_fft import tube_pallas
+
+        tube_raw = partial(tube_pallas, n=n, p=p)
+    else:
+        tube_raw = lambda sr, si: tube(sr, si, n, p, tables)  # noqa: E731
+    tube_f = jax.jit(tube_raw)
     return funnel_f, tube_f, full
+
+
+@lru_cache(maxsize=32)
+def _loop_bodies(n: int, p: int, impl: str):
+    """Shape-closed raw bodies for loop-slope timing.
+
+    funnel body folds the (p, n/p) result back to (n,) planes (a free
+    reshape) so it can iterate; the tube body iterates on (p, n/p)."""
+    from ..models.pi_fft import funnel, pi_fft_pi_layout, tube
+
+    from ..ops.twiddle import twiddle_tables
+
+    tables = twiddle_tables(n)
+    # amplitude renormalization so hundreds of loop iterations neither
+    # overflow nor denormalize; per application, random data grows by
+    # ~sqrt(len) through a full transform but only ~sqrt(p) through the
+    # funnel's log2(p) half-stages
+    inv_rn = np.float32(1.0 / np.sqrt(n))
+    inv_rs = np.float32(1.0 / np.sqrt(n // p))
+    inv_rp = np.float32(1.0 / np.sqrt(p))
+
+    def funnel_body(c):
+        fr, fi = funnel(c[0], c[1], p, tables)
+        return fr.reshape(n) * inv_rp, fi.reshape(n) * inv_rp
+
+    if impl == "pallas":
+        from ..ops.pallas_fft import pi_fft_pi_layout_pallas, tube_pallas
+
+        def tube_body(c):
+            tr, ti = tube_pallas(c[0], c[1], n, p)
+            return tr * inv_rs, ti * inv_rs
+
+        def full_body(c):
+            yr, yi = pi_fft_pi_layout_pallas(c[0], c[1], p)
+            return yr * inv_rn, yi * inv_rn
+    elif impl == "einsum":
+        # plane-level einsum: the loop body must stay all-float (the axon
+        # relay cannot lower complex inside While bodies)
+        from ..models.direct_dft import dft_direct_pi_planes
+
+        def tube_body(c):
+            return c
+
+        def full_body(c):
+            yr, yi = dft_direct_pi_planes(c[0], c[1], p)
+            return yr * inv_rn, yi * inv_rn
+    else:
+        def tube_body(c):
+            tr, ti = tube(c[0], c[1], n, p, tables)
+            return tr * inv_rs, ti * inv_rs
+
+        def full_body(c):
+            yr, yi = pi_fft_pi_layout(c[0], c[1], p, tables)
+            return yr * inv_rn, yi * inv_rn
+
+    return funnel_body, tube_body, full_body
 
 
 class JaxBackend:
@@ -64,12 +143,34 @@ class JaxBackend:
         xr = jax.device_put(jnp.asarray(np.real(x), dtype=jnp.float32))
         xi = jax.device_put(jnp.asarray(np.imag(x), dtype=jnp.float32))
 
-        # All timing strictly BEFORE any device->host fetch: on the axon
-        # tunnel the first result transfer permanently drops the process
-        # into a ~100 ms/dispatch mode (see Backend.run docstring).
-        funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=reps)
-        tube_ms, _ = time_ms(tube_f, fr, fi, reps=reps)
-        total_ms, (yr, yi) = time_ms(full_f, xr, xi, reps=reps)
+        if needs_loop_slope():
+            # remote accelerator: loop-slope with scalar-fetch barriers
+            # (block_until_ready does not wait on the relay — see module
+            # docstring).  Tube iterates on (p, s) planes; its input
+            # content is irrelevant to its cost, so reshaped input works.
+            funnel_body, tube_body, full_body = _loop_bodies(
+                n, p, self._impl
+            )
+            total_ms = loop_slope_ms(full_body, (xr, xi), reps=reps)
+            if self._impl == "einsum":
+                funnel_ms, tube_ms = 0.0, total_ms
+            else:
+                funnel_ms = loop_slope_ms(funnel_body, (xr, xi), reps=reps)
+                tube_ms = loop_slope_ms(
+                    tube_body,
+                    (xr.reshape(p, n // p), xi.reshape(p, n // p)),
+                    reps=reps,
+                )
+            yr, yi = full_f(xr, xi) if fetch else (None, None)
+        elif self._impl == "einsum":
+            # the direct einsum has no funnel/tube phase split (its law is
+            # Theta(n^2/p) per processor, not the butterfly law)
+            total_ms, (yr, yi) = time_ms(full_f, xr, xi, reps=reps)
+            funnel_ms, tube_ms = 0.0, total_ms
+        else:
+            funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=reps)
+            tube_ms, _ = time_ms(tube_f, fr, fi, reps=reps)
+            total_ms, (yr, yi) = time_ms(full_f, xr, xi, reps=reps)
 
         out = None
         if fetch:

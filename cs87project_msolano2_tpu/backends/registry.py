@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from typing import List
 
-_NAMES = ("serial", "pthreads", "cpu", "jax", "pallas")
+_NAMES = ("serial", "pthreads", "cpu", "jax", "pallas", "einsum")
 
 
 def list_backends() -> List[str]:
@@ -32,4 +32,8 @@ def get_backend(name: str):
         from .jax_backend import JaxBackend
 
         return JaxBackend("pallas")
+    if name == "einsum":
+        from .jax_backend import JaxBackend
+
+        return JaxBackend("einsum")
     raise ValueError(f"unknown backend '{name}' (have: {', '.join(_NAMES)})")

@@ -56,6 +56,8 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--verify", action="store_true",
                     help="also check the result against numpy's FFT")
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="write a jax.profiler trace of the run to DIR")
     args = ap.parse_args(argv)
 
     if args.t:
@@ -74,7 +76,10 @@ def main(argv=None) -> int:
 
     x = make_input(args.n, args.seed)
     try:
-        res = b.run(x, args.p, reps=args.reps)
+        from .utils.tracing import trace
+
+        with trace(args.trace):
+            res = b.run(x, args.p, reps=args.reps)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,87 @@
+"""Direct DFT as a (vmap'd) complex einsum — the north star's second
+expression of the no-communication property.
+
+Every output bin is an independent partial sum X[k] = sum_j x[j] W^(jk):
+no bin needs any other bin, so processor Pi can compute exactly its own
+pi-layout segment of bins with one einsum against its replicated input —
+zero communication, now in dense-matmul form, which is the formulation
+the MXU natively wants (BASELINE.json north_star; config 1 is the N=1024
+float64 CPU reference run of this model).
+
+Quadratic in n, so it is an oracle / small-n model, not the hot path:
+`capacity`-style guard at MAX_N (the O(n log n) butterfly models take
+over beyond it).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bits import bit_reverse_indices
+
+MAX_N = 1 << 13  # W is n^2 complex entries; 8192^2 * 8 B = 512 MB
+
+
+@lru_cache(maxsize=16)
+def dft_matrix(n: int, dtype=np.complex64) -> np.ndarray:
+    """W[k, j] = exp(-2 pi i j k / n), float64 trig then cast."""
+    if n > MAX_N:
+        raise ValueError(f"direct DFT capped at n={MAX_N} (O(n^2) memory)")
+    k = np.arange(n)
+    return np.exp(-2j * np.pi * np.outer(k, k) / n).astype(dtype)
+
+
+def dft_direct(x, dtype=np.complex64):
+    """X = W @ x over the trailing axis (natural order).
+
+    dtype=np.complex128 is BASELINE.json config 1 (the N=1024 float64 CPU
+    reference run) and is computed with numpy on the host — JAX defaults
+    to 32-bit and this path is an oracle, not a device hot path."""
+    if dtype == np.complex128:
+        x = np.asarray(x, dtype=np.complex128)
+        return np.einsum("kj,...j->...k", dft_matrix(x.shape[-1], dtype), x)
+    x = jnp.asarray(x)
+    n = x.shape[-1]
+    w = jnp.asarray(dft_matrix(n, dtype))
+    return jnp.einsum("kj,...j->...k", w, x.astype(w.dtype))
+
+
+def dft_direct_pi(x, p: int = 1, dtype=np.complex64):
+    """The pi-decomposed einsum: processor Pi computes only the bins of
+    its pi-layout segment.  Returns the pi-layout result (..., n) —
+    identical layout to the butterfly models', so the whole verification
+    stack applies unchanged.
+
+    Internally a vmap-style batched einsum: W's rows are gathered into
+    (p, n/p, n) so row block Pi holds exactly Pi's bins — each block's
+    contraction touches only the (replicated) input.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[-1]
+    w = dft_matrix(n, dtype)[bit_reverse_indices(n)]  # pi-layout bin order
+    w_blocks = jnp.asarray(w.reshape(p, n // p, n))
+    y = jnp.einsum("psj,...j->...ps", w_blocks, x.astype(w_blocks.dtype))
+    return y.reshape(*x.shape[:-1], n)
+
+
+def dft_direct_pi_planes(xr, xi, p: int = 1):
+    """dft_direct_pi on split float32 planes — all-float einsums (four
+    real contractions), so it composes with lax loops on backends whose
+    While lowering lacks complex support (the axon relay)."""
+    n = xr.shape[-1]
+    w = dft_matrix(n, np.complex64)[bit_reverse_indices(n)].reshape(p, n // p, n)
+    wr = jnp.asarray(np.ascontiguousarray(w.real))
+    wi = jnp.asarray(np.ascontiguousarray(w.imag))
+    yr = jnp.einsum("psj,...j->...ps", wr, xr) - jnp.einsum(
+        "psj,...j->...ps", wi, xi
+    )
+    yi = jnp.einsum("psj,...j->...ps", wr, xi) + jnp.einsum(
+        "psj,...j->...ps", wi, xr
+    )
+    return (
+        yr.reshape(*xr.shape[:-1], n),
+        yi.reshape(*xi.shape[:-1], n),
+    )

@@ -61,3 +61,25 @@ def fftn(x, axes=None, p: int = 1):
 
 def jax_complex(re, im):
     return re.astype(jnp.complex64) + 1j * im.astype(jnp.complex64)
+
+
+def fft_planes(xr, xi, p: int = 1, tables=None):
+    """Natural-order DFT on split re/im float32 planes (trailing axis).
+
+    The plane-level core the complex `fft` wraps.  Exposed because (a)
+    float planes are the TPU-native representation end-to-end, and (b)
+    the axon relay's While-loop lowering lacks complex support, so
+    anything that must run inside `lax.fori_loop` (loop-slope timing,
+    iterative solvers) uses these.
+    """
+    n = xr.shape[-1]
+    yr, yi = pi_fft_pi_layout(xr, xi, p, tables)
+    idx = jnp.asarray(bit_reverse_indices(n))
+    return jnp.take(yr, idx, axis=-1), jnp.take(yi, idx, axis=-1)
+
+
+def ifft_planes(xr, xi, p: int = 1, tables=None):
+    """Inverse DFT on planes: conj trick, all-float."""
+    n = xr.shape[-1]
+    yr, yi = fft_planes(xr, -xi, p, tables)
+    return yr / n, -yi / n

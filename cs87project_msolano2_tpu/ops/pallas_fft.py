@@ -128,6 +128,10 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None):
     ntiles = total_rows // trows
     nlev = max(ilog2(tile) - 7, 0)
 
+    from ..utils.debug import assert_disjoint_cover
+
+    assert_disjoint_cover(total_rows, trows, ntiles)
+
     tables = [jnp.asarray(t) for t in _tile_tables(tile)]
     btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t())
 
@@ -149,6 +153,105 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None):
         interpret=interpret,
     )(xr2d, xi2d, *tables, btr, bti)
     return out[0], out[1]
+
+
+def _long_range_kernel(levels: int, *refs):
+    """Pallas kernel body: the first `levels` DIF stages of an n = R*C
+    transform, on one (R, CB) column block.
+
+    Viewing x row-major as (R, C), stage l pairs rows (r, r + R/2^(l+1))
+    within each group of R/2^l rows — entirely inside any column slice,
+    so a column grid needs no cross-program data.  The bottom-half
+    twiddle index is j' = (r mod R/2^(l+1)) * C + c, which is exactly the
+    n-plan level-l table reshaped to (R/2^(l+1), C) — passed here sliced
+    to the program's columns.
+    """
+    xr_ref, xi_ref = refs[0], refs[1]
+    tw = refs[2 : 2 + 2 * levels]
+    or_ref, oi_ref = refs[2 + 2 * levels], refs[3 + 2 * levels]
+
+    xr = xr_ref[:, :]
+    xi = xi_ref[:, :]
+    rows, cb = xr.shape
+    for l in range(levels):
+        half = rows >> (l + 1)
+        wr = tw[2 * l][:, :]
+        wi = tw[2 * l + 1][:, :]
+        xr4 = xr.reshape(-1, 2, half, cb)
+        xi4 = xi.reshape(-1, 2, half, cb)
+        ar, br = xr4[:, 0], xr4[:, 1]
+        ai, bi = xi4[:, 0], xi4[:, 1]
+        tr, ti = ar + br, ai + bi
+        dr, di = ar - br, ai - bi
+        ur = dr * wr - di * wi
+        ui = dr * wi + di * wr
+        xr = jnp.stack((tr, ur), axis=1).reshape(rows, cb)
+        xi = jnp.stack((ti, ui), axis=1).reshape(rows, cb)
+    or_ref[:, :] = xr
+    oi_ref[:, :] = xi
+
+
+def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None):
+    """First log2(R) DIF stages of an (R, C)-viewed transform as one
+    Pallas pass gridded over column blocks of width `cb`."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = _use_interpret()
+
+    R, C = xr2d.shape
+    levels = ilog2(R)
+    if cb is None:
+        cb = min(C, 4096)
+    if C % cb or cb % LANE:
+        raise ValueError(f"cb={cb} must divide C={C} and be a multiple of {LANE}")
+    n = R * C
+    tables = []
+    for l, (wr, wi) in enumerate(twiddle_tables(n)[:levels]):
+        half = R >> (l + 1)
+        tables.append(jnp.asarray(wr.reshape(half, C)))
+        tables.append(jnp.asarray(wi.reshape(half, C)))
+
+    in_specs = [pl.BlockSpec((R, cb), lambda i: (0, i))] * 2
+    in_specs += [
+        pl.BlockSpec((t.shape[0], cb), lambda i: (0, i)) for t in tables
+    ]
+    out = pl.pallas_call(
+        partial(_long_range_kernel, levels),
+        grid=(C // cb,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((R, cb), lambda i: (0, i))] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((R, C), jnp.float32),
+            jax.ShapeDtypeStruct((R, C), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr2d, xi2d, *tables)
+    return out[0], out[1]
+
+
+def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
+                          cb: int | None = None, interpret=None):
+    """Two-kernel whole-FFT: long-range stages as a column-grid kernel,
+    tile-local FFTs as the row-grid kernel — exactly two HBM round trips,
+    no XLA elementwise passes in between."""
+    n = xr.shape[-1]
+    tile = _choose_tile(n, tile)
+    if cb is not None and (cb % LANE or tile % cb):
+        # validate even when R == 1 skips the long-range kernel, so a
+        # typo'd cb fails at every n, not only once n grows past tile
+        raise ValueError(f"cb={cb} must divide tile={tile} and be a "
+                         f"multiple of {LANE}")
+    R = n // tile
+    if R > 1:
+        xr2, xi2 = long_range_grid(
+            xr.reshape(R, tile), xi.reshape(R, tile), cb, interpret
+        )
+        xr, xi = xr2.reshape(n), xi2.reshape(n)
+    yr, yi = tile_fft_grid(
+        xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret
+    )
+    return yr.reshape(n), yi.reshape(n)
 
 
 def _choose_tile(seg: int, tile: int | None) -> int:
@@ -174,6 +277,34 @@ def fft_pi_layout_pallas(xr, xi, tile: int | None = None, interpret=None):
     return yr.reshape(n), yi.reshape(n)
 
 
+def tube_pallas(sr, si, n: int, p: int, tile: int | None = None,
+                interpret=None):
+    """Tube phase on the Pallas kernel: segment-local DIF FFT over the
+    trailing axis of (..., s) planes, s = n/p.  XLA-fused full stages
+    bring segments down to `tile`, the VMEM kernel finishes.  Compiles in
+    seconds where the fully-unrolled jnp tube takes minutes at n=2^20
+    (log2(tile) levels live inside one kernel instead of the HLO graph).
+    Falls back to the jnp tube when s < 128."""
+    from ..models.pi_fft import tube
+
+    s = sr.shape[-1]
+    if s < LANE:
+        return tube(sr, si, n, p)
+
+    tile = _choose_tile(s, tile)
+    tables = twiddle_tables(n)
+    k = ilog2(p)
+    for l in range(ilog2(s // tile)):
+        wr, wi = tables[k + l]
+        sr, si = stage_full(sr, si, jnp.asarray(wr), jnp.asarray(wi))
+
+    shape = sr.shape
+    yr, yi = tile_fft_grid(
+        sr.reshape(-1, LANE), si.reshape(-1, LANE), tile, interpret
+    )
+    return yr.reshape(shape), yi.reshape(shape)
+
+
 def pi_fft_pi_layout_pallas(xr, xi, p: int, tile: int | None = None,
                             interpret=None):
     """The pi-FFT (funnel + tube) with the tube's segment FFTs on the
@@ -183,21 +314,10 @@ def pi_fft_pi_layout_pallas(xr, xi, p: int, tile: int | None = None,
     from ..models.pi_fft import funnel, pi_fft_pi_layout
 
     n = xr.shape[-1]
-    s = n // p
-    if s < LANE:
+    if n // p < LANE:
         return pi_fft_pi_layout(xr, xi, p)
 
-    tile = _choose_tile(s, tile)
     tables = twiddle_tables(n)
     fr, fi = funnel(xr, xi, p, tables)  # (p, s)
-
-    # remaining long-range tube levels until segments fit one tile
-    k = ilog2(p)
-    for l in range(ilog2(s // tile)):
-        wr, wi = tables[k + l]
-        fr, fi = stage_full(fr, fi, jnp.asarray(wr), jnp.asarray(wi))
-
-    yr, yi = tile_fft_grid(
-        fr.reshape(-1, LANE), fi.reshape(-1, LANE), tile, interpret
-    )
-    return yr.reshape(n), yi.reshape(n)
+    tr, ti = tube_pallas(fr, fi, n, p, tile, interpret)
+    return tr.reshape(*xr.shape[:-1], n), ti.reshape(*xi.shape[:-1], n)

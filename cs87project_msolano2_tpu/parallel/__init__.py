@@ -6,3 +6,5 @@ from .pi_shard import pi_fft_sharded, pi_fft_sharded_batched  # noqa: F401
 from .batched import fft_batched_sharded  # noqa: F401
 from .fft2d import fft2_sharded  # noqa: F401
 from .poisson3d import poisson_solve_sharded  # noqa: F401
+from .batched import fft_batched_planes  # noqa: F401
+from .fft2d import fft2_sharded_planes  # noqa: F401

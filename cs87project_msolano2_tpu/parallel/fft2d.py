@@ -5,7 +5,12 @@ This is the one place the framework genuinely needs communication — the
 2-D transform's data dependencies span both axes — and per SURVEY.md §2.3
 it uses the XLA collective over ICI (tiled all_to_all), not a
 point-to-point port of anything in the reference (which has no multi-node
-path at all)."""
+path at all).
+
+Internals run on split re/im float32 planes (the TPU-native
+representation; also required because the axon relay cannot lower
+complex64 inside While loops); complex64 only at the API edge.
+"""
 
 from __future__ import annotations
 
@@ -14,29 +19,44 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..models.fft import fft, ifft
+from ..models.fft import fft_planes, ifft_planes, jax_complex
+
+
+def _a2a(v, axis, split_axis, concat_axis):
+    return jax.lax.all_to_all(v, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def fft2_sharded_planes(xr, xi, mesh, axis: str = "p",
+                        inverse: bool = False):
+    """2-D FFT on (R, C) re/im planes, rows sharded over the mesh axis.
+    Returns planes with the same sharding.  R and C must be divisible by
+    the axis size."""
+    f = ifft_planes if inverse else fft_planes
+
+    def device_fn(br, bi):  # (R/p, C) planes
+        yr, yi = f(br, bi)  # row transforms
+        # ICI transpose: (R/p, C) -> (R, C/p)
+        yr, yi = _a2a(yr, axis, 1, 0), _a2a(yi, axis, 1, 0)
+        # column transforms (axis 0 now fully local)
+        cr, ci = f(jnp.swapaxes(yr, 0, 1), jnp.swapaxes(yi, 0, 1))
+        yr, yi = jnp.swapaxes(cr, 0, 1), jnp.swapaxes(ci, 0, 1)
+        # transpose back: (R, C/p) -> (R/p, C)
+        return _a2a(yr, axis, 0, 1), _a2a(yi, axis, 0, 1)
+
+    fn = shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None), P(axis, None)),
+    )
+    return fn(xr, xi)
 
 
 def fft2_sharded(x, mesh, axis: str = "p", inverse: bool = False):
-    """2-D FFT of complex (R, C), rows sharded over the mesh axis.
-    Returns the full 2-D transform, rows still sharded.  R and C must be
-    divisible by the axis size."""
-    p = mesh.shape[axis]
-    f = ifft if inverse else fft
-
-    def device_fn(xb):  # (R/p, C)
-        y = f(xb)  # row transforms
-        # ICI transpose: (R/p, C) -> (R, C/p)
-        y = jax.lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
-                               tiled=True)
-        # column transforms (axis 0 is now fully local)
-        y = jnp.swapaxes(f(jnp.swapaxes(y, 0, 1)), 0, 1)
-        # transpose back: (R, C/p) -> (R/p, C)
-        return jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=1,
-                                  tiled=True)
-
-    fn = shard_map(
-        device_fn, mesh=mesh, in_specs=(P(axis, None),),
-        out_specs=P(axis, None),
+    """Complex-API wrapper over fft2_sharded_planes."""
+    x = jnp.asarray(x)
+    yr, yi = fft2_sharded_planes(
+        jnp.real(x).astype(jnp.float32), jnp.imag(x).astype(jnp.float32),
+        mesh, axis, inverse,
     )
-    return fn(x)
+    return jax_complex(yr, yi)

@@ -1,0 +1,52 @@
+"""Multi-host scaling (SURVEY.md §5 last row: 'DCN only for the v4-32
+slab config').
+
+The reference's answer to multi-node was "the design makes it
+unnecessary" — P shared-nothing threads in one address space represent it
+fully (SURVEY.md §4).  The same argument holds here across ICI, but a
+real v4-32-class slab run spans hosts, so this module wraps the JAX
+multi-process runtime: call `init_distributed()` once per process (it
+no-ops outside a launcher environment), then `global_mesh()` builds a
+mesh over every chip in the job; shard_map code from this package runs on
+it unchanged — XLA routes the pi-FFT with zero collectives regardless of
+DCN, and the 2-D/3-D transposes ride ICI within a slice and DCN across.
+
+Single-process validation path: the driver's dryrun_multichip and the
+test suite use XLA_FLAGS=--xla_force_host_platform_device_count instead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Initialize the JAX distributed runtime if this looks like (or is
+    explicitly configured as) a multi-process job.  Returns True if
+    initialization happened."""
+    coordinator = coordinator or os.environ.get("PIFFT_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("PIFFT_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid = os.environ.get("PIFFT_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if not coordinator or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh(axis: str = "p") -> Mesh:
+    """1-D mesh over every device in the (possibly multi-host) job."""
+    return Mesh(np.array(jax.devices()), (axis,))

@@ -4,7 +4,12 @@ config 5): solve lap(u) = f on a periodic [0, 2*pi)^3 grid.
 Slabs are sharded along axis 0.  Per slab: local FFT over axes 1-2, one
 all_to_all transpose to localize axis 0, FFT over axis 0, multiply by
 -1/|k|^2 (zero mode -> 0: the mean-free solution), then invert the
-pipeline.  Two ICI transposes per solve — the textbook slab pattern."""
+pipeline.  Two ICI transposes per solve — the textbook slab pattern.
+
+All spectral arithmetic runs on split re/im float32 planes: the
+multiplier is real, so the whole pipeline is float ops — TPU-native and
+loop-compatible (the axon relay cannot lower complex in While bodies).
+"""
 
 from __future__ import annotations
 
@@ -14,7 +19,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..models.fft import fft, ifft
+from ..models.fft import fft_planes, ifft_planes
 
 
 def _wavenumbers(m: int) -> np.ndarray:
@@ -24,9 +29,10 @@ def _wavenumbers(m: int) -> np.ndarray:
     return k.astype(np.float32)
 
 
-def _fft_axis(x, ax: int, inverse: bool):
-    f = ifft if inverse else fft
-    return jnp.moveaxis(f(jnp.moveaxis(x, ax, -1)), -1, ax)
+def _fft_axis(vr, vi, ax: int, inverse: bool):
+    f = ifft_planes if inverse else fft_planes
+    yr, yi = f(jnp.moveaxis(vr, ax, -1), jnp.moveaxis(vi, ax, -1))
+    return jnp.moveaxis(yr, -1, ax), jnp.moveaxis(yi, -1, ax)
 
 
 def poisson_solve_sharded(f, mesh, axis: str = "p"):
@@ -37,36 +43,41 @@ def poisson_solve_sharded(f, mesh, axis: str = "p"):
     """
     p = mesh.shape[axis]
     n1, n2, n3 = f.shape
-    k1 = jnp.asarray(_wavenumbers(n1))
-    k2 = jnp.asarray(_wavenumbers(n2))
-    k3 = jnp.asarray(_wavenumbers(n3))
+    k1 = _wavenumbers(n1)
+    k2 = _wavenumbers(n2)
+    k3 = _wavenumbers(n3)
 
-    def device_fn(fb):  # (n1/p, n2, n3)
-        g = fb.astype(jnp.complex64)
-        g = _fft_axis(g, 2, False)
-        g = _fft_axis(g, 1, False)
+    def a2a(v, split_axis, concat_axis):
+        return jax.lax.all_to_all(v, axis, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    def device_fn(fb):  # (n1/p, n2, n3) real
+        gr, gi = fb, jnp.zeros_like(fb)
+        gr, gi = _fft_axis(gr, gi, 2, False)
+        gr, gi = _fft_axis(gr, gi, 1, False)
         # localize axis 0: (n1/p, n2, n3) -> (n1, n2/p, n3)
-        g = jax.lax.all_to_all(g, axis, split_axis=1, concat_axis=0,
-                               tiled=True)
-        g = _fft_axis(g, 0, False)
+        gr, gi = a2a(gr, 1, 0), a2a(gi, 1, 0)
+        gr, gi = _fft_axis(gr, gi, 0, False)
 
-        # spectral inverse Laplacian on the (n1, n2/p, n3) block
+        # spectral inverse Laplacian on the (n1, n2/p, n3) block —
+        # a REAL multiplier, so planes never recombine
         i = jax.lax.axis_index(axis)
-        k2_loc = jax.lax.dynamic_slice_in_dim(k2, i * (n2 // p), n2 // p)
+        k2_loc = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(k2), i * (n2 // p), n2 // p
+        )
         ksq = (
-            k1[:, None, None] ** 2
+            jnp.asarray(k1)[:, None, None] ** 2
             + k2_loc[None, :, None] ** 2
-            + k3[None, None, :] ** 2
+            + jnp.asarray(k3)[None, None, :] ** 2
         )
         inv = jnp.where(ksq > 0, -1.0 / jnp.maximum(ksq, 1e-30), 0.0)
-        g = g * inv.astype(jnp.complex64)
+        gr, gi = gr * inv, gi * inv
 
-        g = _fft_axis(g, 0, True)
-        g = jax.lax.all_to_all(g, axis, split_axis=0, concat_axis=1,
-                               tiled=True)
-        g = _fft_axis(g, 1, True)
-        g = _fft_axis(g, 2, True)
-        return jnp.real(g)
+        gr, gi = _fft_axis(gr, gi, 0, True)
+        gr, gi = a2a(gr, 0, 1), a2a(gi, 0, 1)
+        gr, gi = _fft_axis(gr, gi, 1, True)
+        gr, gi = _fft_axis(gr, gi, 2, True)
+        return gr
 
     fn = shard_map(
         device_fn, mesh=mesh, in_specs=(P(axis, None, None),),

@@ -1,0 +1,39 @@
+"""Debug checks (SURVEY.md §5 'race detection' row).
+
+The reference is correct-by-construction — disjoint write segments, no
+locks anywhere — and so is this framework: every Pallas output BlockSpec
+maps grid step i to disjoint row blocks, and shard_map out_specs place
+each device's segment disjointly.  What the TPU stack adds on top:
+
+* `enable_checks()` — jax_debug_nans / jax_debug_infs, so a bad twiddle
+  or overflow faults at the op that produced it instead of corrupting a
+  benchmark;
+* `assert_disjoint_cover(...)` — a static check that a 1-D Pallas row
+  grid tiles its output exactly (used by the tile kernel's tests).
+"""
+
+from __future__ import annotations
+
+
+def enable_checks() -> None:
+    import jax
+
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_debug_infs", True)
+
+
+def disable_checks() -> None:
+    import jax
+
+    jax.config.update("jax_debug_nans", False)
+    jax.config.update("jax_debug_infs", False)
+
+
+def assert_disjoint_cover(total_rows: int, block_rows: int, ntiles: int):
+    """A grid of `ntiles` contiguous blocks of `block_rows` rows must
+    cover [0, total_rows) exactly once.  Contiguous blocks cannot
+    overlap, so the product check is the whole assertion."""
+    if block_rows * ntiles != total_rows:
+        raise AssertionError(
+            f"grid does not tile output: {ntiles} x {block_rows} != {total_rows}"
+        )

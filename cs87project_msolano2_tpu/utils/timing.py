@@ -1,6 +1,14 @@
 """Phase timing (the reference's tmr_t layer, …pthreads.c:714-732, done the
-JAX way: block_until_ready around perf_counter, with warm-up so compile
-time never pollutes a measurement)."""
+JAX way — plus the loop-slope method remote accelerators require).
+
+On the axon TPU relay, `jax.block_until_ready` returns before the device
+finishes (a 27-TFLOP program "completes" in 0.1 ms), so wall-clock around
+a single dispatch measures the RPC, not the chip.  The only reliable
+synchronization is fetching a scalar result; after the first fetch every
+dispatch carries a ~100 ms fixed overhead.  `loop_slope_ms` therefore
+times a K-iteration `lax.fori_loop` of the op (ending in a scalar fetch)
+at two K values and reports the slope — the overhead cancels exactly and
+what remains is true device time per iteration."""
 
 from __future__ import annotations
 
@@ -20,7 +28,8 @@ def block(x: Any) -> Any:
 
 def time_ms(fn: Callable, *args, reps: int = 1, warmup: int = 1, **kw):
     """Run fn reps times (after `warmup` unmeasured calls); return
-    (best_ms, last_result)."""
+    (best_ms, last_result).  Honest on CPU/local backends only — for
+    remote accelerators use loop_slope_ms."""
     result = None
     for _ in range(warmup):
         result = block(fn(*args, **kw))
@@ -30,3 +39,67 @@ def time_ms(fn: Callable, *args, reps: int = 1, warmup: int = 1, **kw):
         result = block(fn(*args, **kw))
         best = min(best, (time.perf_counter() - t0) * 1e3)
     return best, result
+
+
+def needs_loop_slope() -> bool:
+    """True on backends where block_until_ready is not a real barrier.
+
+    Currently that is the axon remote-TPU relay (detected via the
+    configured platform list); directly-attached TPUs/GPUs have honest
+    barriers and get the cheap direct-timing path.  Set
+    PIFFT_FORCE_LOOP_SLOPE=1 to force the slope method anywhere.
+    """
+    import os
+
+    if os.environ.get("PIFFT_FORCE_LOOP_SLOPE") == "1":
+        return True
+    import jax
+
+    platforms = jax.config.jax_platforms or ""
+    return "axon" in platforms
+
+
+def _timed_fetch(fn: Callable, *args, reps: int) -> float:
+    """Best-of wall time of a scalar-returning jit fn, fetch included."""
+    float(fn(*args))  # compile + warm (and, on axon, enter sync mode)
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return best
+
+
+def loop_slope_ms(body: Callable, args: tuple, k1: int = 32,
+                  k2: int = 512, reps: int = 3,
+                  min_delta_ms: float = 40.0, max_k: int = 1 << 15) -> float:
+    """True device ms per application of `body`.
+
+    `body(pytree) -> pytree` must be shape-closed (output feeds back as
+    input).  Builds jitted K-iteration fori_loops ending in a scalar, so
+    the fetch is a hard barrier; returns (T(k2) - T(k1)) / (k2 - k1).
+    If the delta is below `min_delta_ms` (noise floor ~±20 ms on the
+    relay), k2 doubles — one recompile per doubling — up to max_k.
+    """
+    import jax
+
+    def make(k):
+        def run(a):
+            out = jax.lax.fori_loop(0, k, lambda i, c: body(c), a)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            return jax.numpy.real(leaf).ravel()[0]
+
+        return jax.jit(run)
+
+    t1 = _timed_fetch(make(k1), args, reps=reps)
+    while True:
+        t2 = _timed_fetch(make(k2), args, reps=reps)
+        if t2 - t1 >= min_delta_ms:
+            return (t2 - t1) / (k2 - k1)
+        if k2 >= max_k:
+            raise RuntimeError(
+                f"loop-slope below noise floor: T({k1})={t1:.1f}ms "
+                f"T({k2})={t2:.1f}ms delta<{min_delta_ms}ms — op too fast "
+                f"to resolve even at {max_k} iterations"
+            )
+        k2 *= 4

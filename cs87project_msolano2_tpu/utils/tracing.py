@@ -1,0 +1,30 @@
+"""Tracing/profiling (SURVEY.md §5 row 1): the reference's per-stage
+wall-clock timers are utils/timing.py; this adds the TPU-native deep
+profiler — a jax.profiler trace you can open in XProf/TensorBoard —
+behind one context manager, no-op when profiling is unavailable."""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+
+
+@contextlib.contextmanager
+def trace(outdir: str | None):
+    """`with trace("/tmp/trace"):` profiles the block; None disables."""
+    if not outdir:
+        yield
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(outdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            print(f"# profiler trace written to {outdir}", file=sys.stderr)
+    except Exception as e:
+        print(f"# profiling unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        yield

@@ -1,0 +1,90 @@
+"""Direct-DFT einsum model tests (north star: 'each output bin's
+independent partial sum as a vmap'd complex einsum'; BASELINE.json
+config 1 is the N=1024 float64 CPU reference run)."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.models.direct_dft import (
+    MAX_N,
+    dft_direct,
+    dft_direct_pi,
+    dft_matrix,
+)
+from cs87project_msolano2_tpu.utils.verify import pi_layout_to_natural, rel_err
+
+
+def rand_c64(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64
+    )
+
+
+def test_config1_n1024_float64():
+    x = rand_c64(1024, seed=1).astype(np.complex128)
+    y = np.asarray(dft_direct(x, dtype=np.complex128))
+    assert rel_err(y, np.fft.fft(x)) < 1e-12  # float64 path
+
+
+@pytest.mark.parametrize("n", [8, 256, 1024])
+def test_dft_direct_vs_numpy(n):
+    x = rand_c64(n, seed=2)
+    assert rel_err(np.asarray(dft_direct(x)),
+                   np.fft.fft(x.astype(np.complex128))) < 1e-4
+
+
+@pytest.mark.parametrize("p", [1, 4, 64])
+def test_dft_direct_pi_layout_and_p_invariance(p):
+    n = 1024
+    x = rand_c64(n, seed=3)
+    y = np.asarray(dft_direct_pi(x, p))
+    nat = pi_layout_to_natural(y)
+    assert rel_err(nat, np.fft.fft(x.astype(np.complex128))) < 1e-4
+    base = np.asarray(dft_direct_pi(x, 1))
+    assert np.allclose(y, base, atol=1e-5)
+
+
+def test_pi_layout_matches_butterfly_models():
+    """Same pi layout as the funnel/tube models — the whole verification
+    stack (gather, golden, cross-backend) applies unchanged."""
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
+
+    n, p = 512, 8
+    x = rand_c64(n, seed=4)
+    yr, yi = pi_fft_pi_layout(
+        jnp.asarray(x.real), jnp.asarray(x.imag), p
+    )
+    butterfly = np.asarray(yr) + 1j * np.asarray(yi)
+    einsum = np.asarray(dft_direct_pi(x, p))
+    assert rel_err(einsum, butterfly) < 1e-4
+
+
+def test_dft_direct_pi_planes_matches_complex():
+    from cs87project_msolano2_tpu.models.direct_dft import dft_direct_pi_planes
+
+    n, p = 512, 8
+    x = rand_c64(n, seed=5)
+    yr, yi = dft_direct_pi_planes(x.real, x.imag, p)
+    ref = np.asarray(dft_direct_pi(x, p))
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    assert rel_err(got, ref.astype(np.complex128)) < 1e-4
+
+
+def test_max_n_guard():
+    with pytest.raises(ValueError):
+        dft_matrix(MAX_N * 2)
+
+
+def test_einsum_backend_golden():
+    from cs87project_msolano2_tpu.backends.registry import get_backend
+    from cs87project_msolano2_tpu.utils import verify
+
+    res = get_backend("einsum").run(verify.golden_input(), 4)
+    nat = verify.pi_layout_to_natural(res.out)
+    # einsum accumulates differently; golden values are exact integers but
+    # float32 matmul may not hit them bit-exactly -> tolerance check
+    assert verify.max_abs_err(nat, verify.golden_expected()) < 1e-4
+    assert res.funnel_ms == 0.0 and res.tube_ms == res.total_ms

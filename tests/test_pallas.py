@@ -52,6 +52,27 @@ def test_fft_pallas_vs_numpy(n, tile):
     assert rel_err(nat, np.fft.fft(x)) < 1e-5
 
 
+@pytest.mark.parametrize("n,tile,cb", [(1 << 14, None, None),
+                                       (4096, 512, 256),
+                                       (1 << 15, 1 << 15, None)])
+def test_fft_pallas2_two_kernel_vs_numpy(n, tile, cb):
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas2
+
+    xr, xi = rand_planes(n, seed=7)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas2(xr, xi, tile=tile, cb=cb)
+    nat = pi_layout_to_natural(to_complex(yr, yi))
+    assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
+def test_fft_pallas2_bad_cb():
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas2
+
+    xr, xi = rand_planes(1 << 12, seed=8)
+    with pytest.raises(ValueError):
+        fft_pi_layout_pallas2(xr, xi, tile=512, cb=100)
+
+
 @pytest.mark.parametrize("p", [1, 4, 64])
 def test_pi_fft_pallas_matches_jnp(p):
     from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
@@ -70,6 +91,21 @@ def test_pi_fft_pallas_small_segment_fallback():
     x = xr.astype(np.complex128) + 1j * xi
     nat = pi_layout_to_natural(to_complex(yr, yi))
     assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
+def test_tube_pallas_matches_jnp_tube():
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.pi_fft import funnel, tube
+    from cs87project_msolano2_tpu.ops.pallas_fft import tube_pallas
+
+    n, p = 1 << 12, 4
+    xr, xi = rand_planes(n, seed=5)
+    fr, fi = funnel(jnp.asarray(xr), jnp.asarray(xi), p)
+    ar, ai = tube_pallas(fr, fi, n, p)
+    br, bi = tube(fr, fi, n, p)
+    assert rel_err(to_complex(ar, ai), to_complex(br, bi)) < 1e-6
+    assert ar.shape == br.shape  # (p, s) preserved
 
 
 def test_backend_pallas_golden():
