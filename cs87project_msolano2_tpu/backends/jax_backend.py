@@ -20,15 +20,32 @@ from typing import Optional
 
 import numpy as np
 
-from ..utils.timing import loop_slope_ms, needs_loop_slope, time_ms
+from ..utils.timing import (
+    LoopSlopeUnresolved,
+    loop_slope_ms,
+    needs_loop_slope,
+    time_ms,
+)
 from .base import RunResult, check_run_args
+
+# Above this n the jnp impl switches from the fully-unrolled tube to the
+# fori_loop stage scan (models.pi_fft.fft_stages_scan): the unrolled HLO
+# graph's XLA compile time grows with log2(n) (minutes at 2^20, the round-1
+# blocker); the scan graph holds one stage body regardless of n.
+SCAN_MIN_N = 1 << 17
 
 
 @lru_cache(maxsize=32)
 def _compiled(n: int, p: int, impl: str):
     import jax
 
-    from ..models.pi_fft import funnel, pi_fft_pi_layout, tube
+    from ..models.pi_fft import (
+        funnel,
+        pi_fft_pi_layout,
+        pi_fft_pi_layout_scan,
+        tube,
+        tube_scan,
+    )
     from ..ops.twiddle import twiddle_tables
 
     # keep the tables as NUMPY arrays: jnp.asarray at trace time folds them
@@ -38,31 +55,33 @@ def _compiled(n: int, p: int, impl: str):
     tables = twiddle_tables(n)
 
     if impl == "pallas":
-        from ..ops.pallas_fft import pi_fft_pi_layout_pallas
+        from ..ops.pallas_fft import pi_fft_pi_layout_pallas, tube_pallas
 
         full = jax.jit(partial(pi_fft_pi_layout_pallas, p=p))
-    elif impl == "einsum":
-        import jax.numpy as jnp
-
-        from ..models.direct_dft import dft_direct_pi
-
-        def _einsum_full(xr, xi):
-            y = dft_direct_pi(xr + 1j * xi.astype(jnp.complex64), p)
-            return jnp.real(y), jnp.imag(y)
-
-        full = jax.jit(_einsum_full)
-    else:
-        full = jax.jit(lambda xr, xi: pi_fft_pi_layout(xr, xi, p, tables))
-
-    funnel_f = jax.jit(lambda xr, xi: funnel(xr, xi, p, tables))
-    if impl == "pallas":
         # pallas tube for the phase timer too: the fully-unrolled jnp tube
         # takes minutes of XLA compile at n=2^20; the kernel takes seconds
-        from ..ops.pallas_fft import tube_pallas
-
         tube_raw = partial(tube_pallas, n=n, p=p)
+    elif impl == "einsum":
+        # the phased einsum model: funnel = coefficient-tensor einsum,
+        # tube = blockwise DIF-matrix einsum (models.direct_dft)
+        from ..models.direct_dft import (
+            funnel_einsum_planes,
+            pi_dft_einsum_planes,
+            tube_einsum_planes,
+        )
+
+        full = jax.jit(partial(pi_dft_einsum_planes, p=p))
+        tube_raw = partial(tube_einsum_planes, n=n, p=p)
+        funnel_f = jax.jit(partial(funnel_einsum_planes, p=p))
+        return funnel_f, jax.jit(tube_raw), full
+    elif n >= SCAN_MIN_N:
+        full = jax.jit(lambda xr, xi: pi_fft_pi_layout_scan(xr, xi, p, tables))
+        tube_raw = lambda sr, si: tube_scan(sr, si, n, p)  # noqa: E731
     else:
+        full = jax.jit(lambda xr, xi: pi_fft_pi_layout(xr, xi, p, tables))
         tube_raw = lambda sr, si: tube(sr, si, n, p, tables)  # noqa: E731
+
+    funnel_f = jax.jit(lambda xr, xi: funnel(xr, xi, p, tables))
     tube_f = jax.jit(tube_raw)
     return funnel_f, tube_f, full
 
@@ -73,7 +92,13 @@ def _loop_bodies(n: int, p: int, impl: str):
 
     funnel body folds the (p, n/p) result back to (n,) planes (a free
     reshape) so it can iterate; the tube body iterates on (p, n/p)."""
-    from ..models.pi_fft import funnel, pi_fft_pi_layout, tube
+    from ..models.pi_fft import (
+        funnel,
+        pi_fft_pi_layout,
+        pi_fft_pi_layout_scan,
+        tube,
+        tube_scan,
+    )
 
     from ..ops.twiddle import twiddle_tables
 
@@ -101,15 +126,34 @@ def _loop_bodies(n: int, p: int, impl: str):
             yr, yi = pi_fft_pi_layout_pallas(c[0], c[1], p)
             return yr * inv_rn, yi * inv_rn
     elif impl == "einsum":
-        # plane-level einsum: the loop body must stay all-float (the axon
-        # relay cannot lower complex inside While bodies)
-        from ..models.direct_dft import dft_direct_pi_planes
+        # phased einsum model, all-float plane ops (the axon relay cannot
+        # lower complex inside While bodies)
+        from ..models.direct_dft import (
+            funnel_einsum_planes,
+            pi_dft_einsum_planes,
+            tube_einsum_planes,
+        )
+
+        def funnel_body(c):  # noqa: F811 — einsum funnel replaces default
+            fr, fi = funnel_einsum_planes(c[0], c[1], p)
+            return fr.reshape(n) * inv_rp, fi.reshape(n) * inv_rp
 
         def tube_body(c):
-            return c
+            tr, ti = tube_einsum_planes(c[0], c[1], n, p)
+            return tr * inv_rs, ti * inv_rs
 
         def full_body(c):
-            yr, yi = dft_direct_pi_planes(c[0], c[1], p)
+            yr, yi = pi_dft_einsum_planes(c[0], c[1], p)
+            return yr * inv_rn, yi * inv_rn
+
+        return funnel_body, tube_body, full_body
+    elif n >= SCAN_MIN_N:
+        def tube_body(c):
+            tr, ti = tube_scan(c[0], c[1], n, p)
+            return tr * inv_rs, ti * inv_rs
+
+        def full_body(c):
+            yr, yi = pi_fft_pi_layout_scan(c[0], c[1], p, tables)
             return yr * inv_rn, yi * inv_rn
     else:
         def tube_body(c):
@@ -143,6 +187,13 @@ class JaxBackend:
         xr = jax.device_put(jnp.asarray(np.real(x), dtype=jnp.float32))
         xi = jax.device_put(jnp.asarray(np.imag(x), dtype=jnp.float32))
 
+        # Phase timers COMPOSE by construction: total := funnel + tube,
+        # exactly the reference's nested-timer semantics (its total timer
+        # wraps the two phase timers, …pthreads.c:714-732).  Round 1
+        # measured the three as independent fits and got TSV rows with
+        # tube > total; deriving total from the phases removes that
+        # inconsistency without sacrificing honesty (each phase is still
+        # measured on the real compiled phase program).
         if needs_loop_slope():
             # remote accelerator: loop-slope with scalar-fetch barriers
             # (block_until_ready does not wait on the relay — see module
@@ -151,26 +202,32 @@ class JaxBackend:
             funnel_body, tube_body, full_body = _loop_bodies(
                 n, p, self._impl
             )
-            total_ms = loop_slope_ms(full_body, (xr, xi), reps=reps)
-            if self._impl == "einsum":
-                funnel_ms, tube_ms = 0.0, total_ms
-            else:
+            try:
                 funnel_ms = loop_slope_ms(funnel_body, (xr, xi), reps=reps)
                 tube_ms = loop_slope_ms(
                     tube_body,
                     (xr.reshape(p, n // p), xi.reshape(p, n // p)),
                     reps=reps,
                 )
+            except LoopSlopeUnresolved as e:
+                # tiny transforms sit below the relay's noise floor at any
+                # iteration count (ns-scale op vs ±20 ms jitter); report
+                # dispatch-inclusive wall time instead of failing (golden/
+                # test mode needs the output, not honest timers)
+                import sys
+
+                print(f"# loop-slope unresolved (n={n} p={p}): {e}; "
+                      "falling back to dispatch-inclusive timing",
+                      file=sys.stderr)
+                funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=reps)
+                tube_ms, _ = time_ms(tube_f, fr, fi, reps=reps)
+            total_ms = funnel_ms + tube_ms
             yr, yi = full_f(xr, xi) if fetch else (None, None)
-        elif self._impl == "einsum":
-            # the direct einsum has no funnel/tube phase split (its law is
-            # Theta(n^2/p) per processor, not the butterfly law)
-            total_ms, (yr, yi) = time_ms(full_f, xr, xi, reps=reps)
-            funnel_ms, tube_ms = 0.0, total_ms
         else:
             funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=reps)
             tube_ms, _ = time_ms(tube_f, fr, fi, reps=reps)
-            total_ms, (yr, yi) = time_ms(full_f, xr, xi, reps=reps)
+            total_ms = funnel_ms + tube_ms
+            yr, yi = full_f(xr, xi) if fetch else (None, None)
 
         out = None
         if fetch:

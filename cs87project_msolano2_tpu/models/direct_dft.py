@@ -8,9 +8,22 @@ zero communication, now in dense-matmul form, which is the formulation
 the MXU natively wants (BASELINE.json north_star; config 1 is the N=1024
 float64 CPU reference run of this model).
 
-Quadratic in n, so it is an oracle / small-n model, not the hot path:
-`capacity`-style guard at MAX_N (the O(n log n) butterfly models take
-over beyond it).
+Two tiers live here:
+
+* the O(n^2)-memory oracles ``dft_direct`` / ``dft_direct_pi`` (guarded
+  by MAX_N — small-n correctness references, config 1);
+* the PHASED einsum model — ``funnel_einsum_planes`` /
+  ``tube_einsum_planes`` / ``pi_dft_einsum_planes`` — the full third
+  backend (`-b einsum`).  It has the same funnel/tube structure as the
+  butterfly backends, resting on the polyphase identity (verified in
+  tests):  funnel(pi, j) = sum_m x[m*s+j] * W_n^{rev(pi)*(m*s+j)} — the
+  funnel IS a (p, p, s)-coefficient einsum against the blocked input —
+  and the tube is the segment-local DIF matrix  B[k, j] =
+  W_s^{rev_s(k)*j},  generated blockwise on the fly inside a ``lax.scan``
+  (exact integer angle indices, MXU contraction), so memory stays
+  O(block * s) at any n.  Phase timers are honest on both phases —
+  reference parity with the Xeon Phi backend's full phased run
+  (…openmp.c:291-441).
 """
 
 from __future__ import annotations
@@ -23,6 +36,11 @@ import numpy as np
 from ..ops.bits import bit_reverse_indices
 
 MAX_N = 1 << 13  # W is n^2 complex entries; 8192^2 * 8 B = 512 MB
+# funnel coefficient planes hold p*n floats x2; 2^24 = 128 MB — beyond
+# that the (n, p) combination is out of the einsum backend's capacity
+COEF_MAX_ENTRIES = 1 << 24
+# full-period twiddle tables are m floats x2 (host f64 trig, f32 stored)
+FULL_TABLE_MAX = 1 << 20
 
 
 @lru_cache(maxsize=16)
@@ -65,6 +83,120 @@ def dft_direct_pi(x, p: int = 1, dtype=np.complex64):
     w_blocks = jnp.asarray(w.reshape(p, n // p, n))
     y = jnp.einsum("psj,...j->...ps", w_blocks, x.astype(w_blocks.dtype))
     return y.reshape(*x.shape[:-1], n)
+
+
+@lru_cache(maxsize=8)
+def full_twiddle(m: int) -> tuple[np.ndarray, np.ndarray]:
+    """(wr, wi) full-period table W_m^j = exp(-2*pi*i*j/m), j in [0, m)."""
+    if m > FULL_TABLE_MAX:
+        raise ValueError(f"full twiddle table capped at m={FULL_TABLE_MAX}")
+    j = np.arange(m, dtype=np.float64)
+    ang = -2.0 * np.pi * j / m
+    return np.cos(ang).astype(np.float32), np.sin(ang).astype(np.float32)
+
+
+@lru_cache(maxsize=8)
+def funnel_coeff_planes(n: int, p: int) -> tuple[np.ndarray, np.ndarray]:
+    """C[pi, m, j] = W_n^{rev(pi) * (m*s + j)} as (p, p, s) float32 planes.
+
+    The funnel's linear map in closed form (polyphase identity, module
+    docstring).  Exact integer angle indices, float64 host trig.
+    """
+    if p * n > COEF_MAX_ENTRIES:
+        raise ValueError(
+            f"einsum funnel coefficients need p*n <= {COEF_MAX_ENTRIES} "
+            f"(got p={p}, n={n})"
+        )
+    rev = bit_reverse_indices(p).astype(np.int64)
+    i = np.arange(n, dtype=np.int64)
+    idx = (rev[:, None] * i[None, :]) % n  # (p, n), exact in int64
+    wr, wi = full_twiddle(n)
+    s = n // p
+    return wr[idx].reshape(p, p, s), wi[idx].reshape(p, p, s)
+
+
+def funnel_einsum_planes(xr, xi, p: int):
+    """Funnel phase as one coefficient-tensor einsum.
+
+    xr/xi: (..., n) -> (..., p, s) pi-layout funnel planes — numerically
+    the same map as models.pi_fft.funnel (tests assert < 1e-5), computed
+    as four real contractions against the replicated blocked input.
+    """
+    n = xr.shape[-1]
+    cr, ci = (jnp.asarray(t) for t in funnel_coeff_planes(n, p))
+    xbr = xr.reshape(*xr.shape[:-1], p, n // p)
+    xbi = xi.reshape(*xi.shape[:-1], p, n // p)
+    yr = jnp.einsum("pmj,...mj->...pj", cr, xbr) - jnp.einsum(
+        "pmj,...mj->...pj", ci, xbi
+    )
+    yi = jnp.einsum("pmj,...mj->...pj", cr, xbi) + jnp.einsum(
+        "pmj,...mj->...pj", ci, xbr
+    )
+    return yr, yi
+
+
+def tube_einsum_planes(sr, si, n: int, p: int, block: int | None = None):
+    """Tube phase as a blockwise dense einsum: per-segment s-point DIF
+    matrix B[k, j] = W_s^{rev_s(k) * j} applied over the trailing axis.
+
+    sr/si: (..., s) -> (..., s).  B rows are generated on the fly inside
+    a lax.scan over output-row blocks — angle index (rev_k * j) mod s is
+    computed with wrapping int32 multiplies (exact: s is a power of two,
+    so the low bits of the wrapped product ARE the mod), then gathered
+    from the full-period table.  Memory O(block * s) at any n; the
+    contraction itself is MXU work.
+    """
+    import jax
+
+    s = sr.shape[-1]
+    if s == 1:
+        return sr, si
+    wr_t, wi_t = (jnp.asarray(t) for t in full_twiddle(s))
+    revk = jnp.asarray(bit_reverse_indices(s).astype(np.int32))
+    j = jnp.arange(s, dtype=jnp.int32)
+    mask = jnp.int32(s - 1)
+
+    def rows(kb):
+        # (block, s) twiddle planes for output rows kb
+        idx = (kb[:, None] * j[None, :]) & mask
+        return wr_t[idx], wi_t[idx]
+
+    def apply(wr, wi):
+        yr = jnp.einsum("...j,kj->...k", sr, wr) - jnp.einsum(
+            "...j,kj->...k", si, wi
+        )
+        yi = jnp.einsum("...j,kj->...k", sr, wi) + jnp.einsum(
+            "...j,kj->...k", si, wr
+        )
+        return yr, yi
+
+    if block is None:
+        block = max(min(s, (1 << 22) // s), 1)
+    if block >= s:
+        return apply(*rows(revk))
+
+    def step(carry, kb):
+        wr, wi = rows(kb)
+        return carry, apply(wr, wi)
+
+    _, (yrs, yis) = jax.lax.scan(step, None, revk.reshape(s // block, block))
+    # (nsteps, ..., p, block) -> (..., p, s): blocks are consecutive k
+    yr = jnp.moveaxis(yrs, 0, -2).reshape(*sr.shape[:-1], s)
+    yi = jnp.moveaxis(yis, 0, -2).reshape(*si.shape[:-1], s)
+    return yr, yi
+
+
+def pi_dft_einsum_planes(xr, xi, p: int):
+    """Full phased einsum pi-DFT: funnel einsum then tube einsum, output
+    in pi layout — layout-identical to the butterfly models, so the whole
+    verification stack applies unchanged."""
+    n = xr.shape[-1]
+    fr, fi = funnel_einsum_planes(xr, xi, p)
+    tr, ti = tube_einsum_planes(fr, fi, n, p)
+    return (
+        tr.reshape(*xr.shape[:-1], n),
+        ti.reshape(*xi.shape[:-1], n),
+    )
 
 
 def dft_direct_pi_planes(xr, xi, p: int = 1):

@@ -59,6 +59,11 @@ def needs_loop_slope() -> bool:
     return "axon" in platforms
 
 
+class LoopSlopeUnresolved(RuntimeError):
+    """The op is too fast for the slope method to resolve over the
+    relay's noise floor at any feasible iteration count."""
+
+
 def _timed_fetch(fn: Callable, *args, reps: int) -> float:
     """Best-of wall time of a scalar-returning jit fn, fetch included."""
     float(fn(*args))  # compile + warm (and, on axon, enter sync mode)
@@ -70,16 +75,27 @@ def _timed_fetch(fn: Callable, *args, reps: int) -> float:
     return best
 
 
-def loop_slope_ms(body: Callable, args: tuple, k1: int = 32,
-                  k2: int = 512, reps: int = 3,
-                  min_delta_ms: float = 40.0, max_k: int = 1 << 15) -> float:
+def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
+                  k2: int = 64, reps: int = 3,
+                  min_delta_ms: float = 40.0, max_k: int = 1 << 22,
+                  max_program_ms: float = 4000.0) -> float:
     """True device ms per application of `body`.
 
     `body(pytree) -> pytree` must be shape-closed (output feeds back as
     input).  Builds jitted K-iteration fori_loops ending in a scalar, so
     the fetch is a hard barrier; returns (T(k2) - T(k1)) / (k2 - k1).
-    If the delta is below `min_delta_ms` (noise floor ~±20 ms on the
-    relay), k2 doubles — one recompile per doubling — up to max_k.
+
+    The window adapts in both directions:
+
+    * slow ops — if even T(k1) exceeds `max_program_ms`, the window
+      shrinks to (1, 4): a single While program that runs for many
+      seconds gets killed by the relay (observed worker crashes at ~10 s
+      programs), and a slow op doesn't need many iterations to clear the
+      noise floor anyway;
+    * fast ops — if the delta is below `min_delta_ms` (noise floor
+      ~±20 ms on the relay), k2 quadruples — one recompile per
+      escalation — up to max_k, and T(k1) is re-measured alongside so
+      both endpoints of the slope come from the same noise conditions.
     """
     import jax
 
@@ -91,15 +107,21 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 32,
 
         return jax.jit(run)
 
-    t1 = _timed_fetch(make(k1), args, reps=reps)
+    f1 = make(k1)
+    t1 = _timed_fetch(f1, args, reps=reps)
+    if t1 > max_program_ms and k1 > 1:
+        k1, k2 = 1, 4
+        f1 = make(k1)
+        t1 = _timed_fetch(f1, args, reps=reps)
     while True:
         t2 = _timed_fetch(make(k2), args, reps=reps)
         if t2 - t1 >= min_delta_ms:
             return (t2 - t1) / (k2 - k1)
         if k2 >= max_k:
-            raise RuntimeError(
+            raise LoopSlopeUnresolved(
                 f"loop-slope below noise floor: T({k1})={t1:.1f}ms "
                 f"T({k2})={t2:.1f}ms delta<{min_delta_ms}ms — op too fast "
                 f"to resolve even at {max_k} iterations"
             )
         k2 *= 4
+        t1 = min(t1, _timed_fetch(f1, args, reps=reps))

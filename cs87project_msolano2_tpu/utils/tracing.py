@@ -11,20 +11,30 @@ import sys
 
 @contextlib.contextmanager
 def trace(outdir: str | None):
-    """`with trace("/tmp/trace"):` profiles the block; None disables."""
+    """`with trace("/tmp/trace"):` profiles the block; None disables.
+
+    Only start_trace is guarded: if it fails the block still runs
+    unprofiled, but an exception raised *inside* the block propagates
+    unchanged (a single yield per path — yielding from an except branch
+    would make contextlib re-raise RuntimeError and mask the original).
+    """
     if not outdir:
         yield
         return
+    started = False
     try:
         import jax
 
         jax.profiler.start_trace(outdir)
-        try:
-            yield
-        finally:
-            jax.profiler.stop_trace()
-            print(f"# profiler trace written to {outdir}", file=sys.stderr)
+        started = True
     except Exception as e:
         print(f"# profiling unavailable ({type(e).__name__}: {e})",
               file=sys.stderr)
+    try:
         yield
+    finally:
+        if started:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"# profiler trace written to {outdir}", file=sys.stderr)

@@ -67,3 +67,42 @@ def test_real_input_promoted():
     x = np.random.default_rng(7).standard_normal(512).astype(np.float32)
     ref = np.fft.fft(x.astype(np.float64))
     assert rel_err(np.asarray(fft(x)), ref) < 1e-5
+
+
+# --- fori_loop stage-scan path (models.pi_fft.fft_stages_scan) ---------
+
+
+@pytest.mark.parametrize("n", [2, 8, 256, 4096])
+def test_fft_stages_scan_vs_numpy(n):
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.pi_fft import fft_stages_scan
+    from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+
+    x = rand(n, seed=8)
+    yr, yi = jax.jit(fft_stages_scan)(
+        jnp.asarray(x.real), jnp.asarray(x.imag)
+    )
+    out = np.asarray(yr) + 1j * np.asarray(yi)
+    nat = out[bit_reverse_indices(n)]
+    assert rel_err(nat, np.fft.fft(x.astype(np.complex128))) < 1e-5
+
+
+@pytest.mark.parametrize("n,p", [(256, 1), (256, 16), (4096, 64)])
+def test_pi_fft_scan_matches_unrolled(n, p):
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.pi_fft import (
+        pi_fft_pi_layout,
+        pi_fft_pi_layout_scan,
+    )
+
+    x = rand(n, seed=9)
+    xr, xi = jnp.asarray(x.real), jnp.asarray(x.imag)
+    ar, ai = jax.jit(lambda a, b: pi_fft_pi_layout_scan(a, b, p))(xr, xi)
+    br, bi = jax.jit(lambda a, b: pi_fft_pi_layout(a, b, p))(xr, xi)
+    a = np.asarray(ar) + 1j * np.asarray(ai)
+    b = np.asarray(br) + 1j * np.asarray(bi)
+    assert rel_err(a, b.astype(np.complex128)) < 1e-6
