@@ -5,15 +5,25 @@
 # complexity law, a t-statistic for the slope, and a normal-tail
 # significance approximation).
 #
-# Input: 5-column TSV  n  p  total_ms  funnel_ms  tube_ms
+# Law model selection mirrors analyze_results.py::model_for: filenames of
+# single-accelerator backends (-jax-/-pallas-/-einsum-) get the on-chip
+# law (funnel n(p-1), tube n*log2(n/p) — all p virtual processors on one
+# chip, time tracks total work); everything else the reference's
+# per-processor law.  Rows marked DEGRADED (6th column: dispatch-inclusive
+# fallback timing) are excluded, as in the python analysis.
+#
+# Input: 5- or 6-column TSV  n  p  total_ms  funnel_ms  tube_ms  [DEGRADED]
 # Usage: awk -f analyze-results.awk results.tsv
 
 function log2(v) { return log(v) / log(2) }
 
-# law(n, p) = n(p-1)/p + (n/p) log2(n/p)
-function law(n, p,    s) {
+# law(n, p) under the selected model
+function law(n, p,    s, lg) {
     s = n / p
-    return n * (p - 1) / p + (s > 1 ? s * log2(s) : 0)
+    lg = (s > 1) ? log2(s) : 0
+    if (model == "on-chip")
+        return n * (p - 1) + n * lg
+    return n * (p - 1) / p + s * lg
 }
 
 # upper normal tail via Abramowitz-Stegun 7.1.26 erfc approximation
@@ -24,6 +34,19 @@ function normal_sf(z,    t, y) {
         + t * (-1.453152027 + t * 1.061405429)))) * exp(-z * z / 2)
     return y / 2
 }
+
+FNR == 1 {
+    base = FILENAME
+    sub(/.*\//, "", base)      # basename, mirroring model_for()
+    newmodel = (base ~ /-(jax|pallas|einsum)-/) ? "on-chip" : "per-processor"
+    if (model != "" && newmodel != model) mixed = 1
+    model = newmodel
+}
+
+$1 ~ /^[0-9]+$/ && NF == 6 && $6 == "DEGRADED" { degraded += 1; next }
+
+# unknown 6th-column markers: refuse, like load_tsv does
+$1 ~ /^[0-9]+$/ && NF == 6 { badmarker = $6; exit 1 }
 
 $1 ~ /^[0-9]+$/ && NF == 5 {
     x = law($1, $2); y = $3
@@ -36,6 +59,14 @@ $1 ~ /^[0-9]+$/ && NF == 5 {
 }
 
 END {
+    if (badmarker != "") {
+        printf "error: unknown row marker '%s' (only DEGRADED is defined) — refusing to fit\n", badmarker
+        exit 1
+    }
+    if (mixed) {
+        print "error: input files select different law models — analyze them separately"
+        exit 1
+    }
     if (m < 2 || sxx == 0) { print "error: not enough data"; exit 1 }
     beta = sxy / sxx
     ssr = syy - beta * sxy           # sum of squared residuals (zero-intercept)
@@ -47,6 +78,9 @@ END {
     r2 = (syy > 0) ? 1 - ssr / syy : 0
 
     printf "limited analysis (awk fallback; install numpy for the full one)\n"
+    printf "law model: %s\n", model
+    if (degraded > 0)
+        printf "excluded %d DEGRADED rows (dispatch-inclusive timing)\n", degraded
     printf "runs: %d   fit: total_ms ~ %.3e * law   R^2=%.4f  t=%.1f  alpha~%.2e\n", \
         m, beta, r2, t, alpha
     printf "law holds: %s\n", (alpha < 0.01 && beta > 0) ? "Yes" : "No"

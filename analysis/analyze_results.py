@@ -37,23 +37,64 @@ def t_sf(t: float, df: int) -> float:
         return 0.5 * math.erfc(t / math.sqrt(2.0))
 
 
-def load_tsv(path: str) -> np.ndarray:
-    rows = []
+def load_tsv(path: str) -> tuple[np.ndarray, int]:
+    """Returns (rows, n_degraded).  Rows carrying the harness's DEGRADED
+    marker (6th column: loop-slope fell back to dispatch-inclusive wall
+    time) are excluded from the fit — they carry ~100 ms of relay
+    overhead that is not device time."""
+    rows, degraded = [], 0
     with open(path) as fh:
         for line in fh:
             parts = line.strip().split("\t")
-            if len(parts) == 5 and parts[0] and parts[0][0].isdigit():
+            if len(parts) in (5, 6) and parts[0] and parts[0][0].isdigit():
+                if len(parts) == 6:
+                    if parts[5] != "DEGRADED":
+                        raise SystemExit(
+                            f"{path}: unknown row marker {parts[5]!r} "
+                            "(only DEGRADED is defined) — refusing to fit "
+                            "data of unknown provenance"
+                        )
+                    degraded += 1
+                    continue
                 rows.append([float(v) for v in parts])
     if not rows:
-        raise SystemExit(f"no data rows in {path}")
-    return np.asarray(rows)  # n p total funnel tube
+        raise SystemExit(f"no usable data rows in {path}")
+    return np.asarray(rows), degraded  # n p total funnel tube
 
 
-def laws(n: np.ndarray, p: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    funnel_law = n * (p - 1) / p
+# Which complexity law governs each phase depends on WHERE the p virtual
+# processors run:
+#  * per-processor (the reference's law, analyze-results.R:35-37): each
+#    of p real cores runs its own chain, so time tracks the per-processor
+#    work — funnel n(p-1)/p, tube (n/p)log2(n/p).
+#  * on-chip (single-accelerator backends jax/pallas/einsum): ALL p
+#    virtual processors are materialized as rows of one array on one
+#    chip, whose throughput is fixed — time tracks the TOTAL work, p x
+#    the per-processor law: funnel n(p-1) (the paper's redundant
+#    replication made explicit), tube n*log2(n/p) (each stage touches all
+#    n elements regardless of p).  On a real multi-chip mesh each device
+#    runs only its own chain (parallel/pi_shard.py), recovering the
+#    per-processor law.
+MODELS = ("per-processor", "on-chip")
+ON_CHIP_BACKENDS = ("jax", "pallas", "einsum")
+
+
+def model_for(path: str, requested: str = "auto") -> str:
+    if requested != "auto":
+        return requested
+    base = os.path.basename(path)
+    if any(f"-{b}-" in base for b in ON_CHIP_BACKENDS):
+        return "on-chip"
+    return "per-processor"
+
+
+def laws(n: np.ndarray, p: np.ndarray,
+         model: str = "per-processor") -> tuple[np.ndarray, np.ndarray]:
     s = n / p
-    tube_law = s * np.where(s > 1, np.log2(np.maximum(s, 2)), 0.0)
-    return funnel_law, tube_law
+    log_s = np.where(s > 1, np.log2(np.maximum(s, 2)), 0.0)
+    if model == "on-chip":
+        return n * (p - 1), n * log_s
+    return n * (p - 1) / p, s * log_s
 
 
 def zero_intercept_fit(x: np.ndarray, y: np.ndarray):
@@ -73,15 +114,21 @@ def zero_intercept_fit(x: np.ndarray, y: np.ndarray):
     return beta, r2, tstat, alpha, df
 
 
-def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None):
-    data = load_tsv(path)
+def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
+            model: str = "auto"):
+    data, degraded = load_tsv(path)
+    model = model_for(path, model)
     n, p, total, funnel, tube = data.T
-    funnel_law, tube_law = laws(n, p)
+    funnel_law, tube_law = laws(n, p, model)
 
-    report = {}
+    report = {"model": model}
     print(f"== {os.path.basename(path)}: {len(n)} runs, "
           f"n in {sorted(int(v) for v in set(n))}, "
-          f"p in {sorted(int(v) for v in set(p))} ==")
+          f"p in {sorted(int(v) for v in set(p))}, "
+          f"law model: {model} ==")
+    if degraded:
+        print(f"# excluded {degraded} DEGRADED rows "
+              "(dispatch-inclusive fallback timing)")
     for name, y, x in (
         ("total", total, funnel_law + tube_law),
         ("funnel", funnel, funnel_law),
@@ -104,13 +151,13 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None):
         if not sel1.any():
             continue
         t1 = float(np.mean(total[sel1]))
+        fl1, tl1 = laws(np.array([nn]), np.array([1]), model)
+        t1_law = beta_f * fl1[0] + beta_t * tl1[0]
         for pp in sorted(set(p[n == nn].astype(int))):
             sel = (n == nn) & (p == pp)
             tp = float(np.mean(total[sel]))
-            fl, tl = laws(np.array([nn]), np.array([pp]))
-            fitted = (beta_f * 0 + beta_t * nn * np.log2(nn)) / max(
-                beta_f * fl[0] + beta_t * tl[0], 1e-30
-            )
+            fl, tl = laws(np.array([nn]), np.array([pp]), model)
+            fitted = t1_law / max(beta_f * fl[0] + beta_t * tl[0], 1e-30)
             print(f"  n={nn:>9} p={pp:>4}: {t1 / tp:7.2f}x  "
                   f"(law predicts {float(fitted):7.2f}x)")
 
@@ -133,6 +180,7 @@ def plot_results(data, report, plot_dir: str, stem: str):
 
     os.makedirs(plot_dir, exist_ok=True)
     n, p, total, funnel, tube = data.T
+    model = report.get("model", "per-processor")
     beta_f = report["funnel"]["beta"]
     beta_t = report["tube"]["beta"]
 
@@ -145,8 +193,10 @@ def plot_results(data, report, plot_dir: str, stem: str):
         emp = np.array([t1 / float(np.mean(total[(n == nn) & (p == pp)]))
                         for pp in ps])
         grid = np.array([2**k for k in range(0, int(np.log2(ps.max())) + 1)])
-        fl, tl = laws(np.full_like(grid, nn, dtype=float), grid.astype(float))
-        fit = (beta_t * nn * np.log2(nn)) / np.maximum(
+        fl, tl = laws(np.full_like(grid, nn, dtype=float),
+                      grid.astype(float), model)
+        fl1, tl1 = laws(np.array([float(nn)]), np.array([1.0]), model)
+        fit = (beta_f * fl1[0] + beta_t * tl1[0]) / np.maximum(
             beta_f * fl + beta_t * tl, 1e-30)
 
         fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9, 3.6))
@@ -178,10 +228,15 @@ def main(argv=None) -> int:
     ap.add_argument("--alpha", type=float, default=0.01)
     ap.add_argument("--plots", default=None,
                     help="directory for per-n PDF figures")
+    ap.add_argument("--model", default="auto",
+                    choices=("auto",) + MODELS,
+                    help="complexity-law model; auto picks on-chip for "
+                         "single-accelerator backends (jax/pallas/einsum) "
+                         "and per-processor otherwise")
     args = ap.parse_args(argv)
     ok = True
     for path in args.tsv:
-        report = analyze(path, args.alpha, args.plots)
+        report = analyze(path, args.alpha, args.plots, args.model)
         ok &= report["total"]["holds"]
     return 0 if ok else 1
 

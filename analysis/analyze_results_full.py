@@ -18,7 +18,12 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from analyze_results import laws, load_tsv, zero_intercept_fit  # noqa: E402
+from analyze_results import (  # noqa: E402
+    laws,
+    load_tsv,
+    model_for,
+    zero_intercept_fit,
+)
 
 
 def figure(path: str, outdir: str) -> str | None:
@@ -31,7 +36,7 @@ def figure(path: str, outdir: str) -> str | None:
         print(f"# matplotlib unavailable, no figures: {e}", file=sys.stderr)
         return None
 
-    data = load_tsv(path)
+    data, _ = load_tsv(path)
     n, p, total, funnel, tube = data.T
     stem = os.path.splitext(os.path.basename(path))[0]
 
@@ -76,10 +81,11 @@ def figure(path: str, outdir: str) -> str | None:
 
 
 def summary(path: str) -> None:
-    data = load_tsv(path)
+    data, _ = load_tsv(path)
     n, p, total, funnel, tube = data.T
-    funnel_law, tube_law = laws(n, p)
-    print(f"== {os.path.basename(path)} ==")
+    model = model_for(path)
+    funnel_law, tube_law = laws(n, p, model)
+    print(f"== {os.path.basename(path)} (law model: {model}) ==")
     for name, y, x in (("funnel", funnel, funnel_law),
                        ("tube", tube, tube_law)):
         beta, r2, t, a, df = zero_intercept_fit(x, y)

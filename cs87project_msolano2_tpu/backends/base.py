@@ -18,6 +18,10 @@ class RunResult:
     total_ms: float
     funnel_ms: float
     tube_ms: float
+    # True when the timers are dispatch-inclusive wall time rather than
+    # honest device time (the loop-slope noise-floor fallback).  The
+    # harness marks such TSV rows DEGRADED and the analysis excludes them.
+    degraded: bool = False
 
 
 class Backend(Protocol):

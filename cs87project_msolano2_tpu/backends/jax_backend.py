@@ -171,6 +171,10 @@ class JaxBackend:
     def __init__(self, impl: str = "jnp"):
         self.name = "jax" if impl == "jnp" else impl
         self._impl = impl
+        # golden-test tolerance: butterfly impls are bit-exact on the
+        # 8-point golden vector; the einsum impl goes through MXU matmuls
+        # whose accumulation order is not (see utils.verify.golden_check_tol)
+        self.golden_atol = 1e-4 if impl == "einsum" else 0.0
 
     def capacity(self) -> Optional[int]:
         return None  # virtual processors: any power of two <= n
@@ -193,7 +197,12 @@ class JaxBackend:
         # measured the three as independent fits and got TSV rows with
         # tube > total; deriving total from the phases removes that
         # inconsistency without sacrificing honesty (each phase is still
-        # measured on the real compiled phase program).
+        # measured on the real compiled phase program).  Tradeoff: the
+        # fused full_f program (which produces the returned output) is NOT
+        # itself timed here, so cross-phase fusion wins don't show in
+        # total_ms; bench.py independently times the real full body, so
+        # the headline number is unaffected.
+        degraded = False
         if needs_loop_slope():
             # remote accelerator: loop-slope with scalar-fetch barriers
             # (block_until_ready does not wait on the relay — see module
@@ -221,6 +230,7 @@ class JaxBackend:
                       file=sys.stderr)
                 funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=reps)
                 tube_ms, _ = time_ms(tube_f, fr, fi, reps=reps)
+                degraded = True
             total_ms = funnel_ms + tube_ms
             yr, yi = full_f(xr, xi) if fetch else (None, None)
         else:
@@ -234,5 +244,6 @@ class JaxBackend:
             out = np.asarray(yr).astype(np.complex64)
             out.imag = np.asarray(yi)
         return RunResult(
-            out=out, total_ms=total_ms, funnel_ms=funnel_ms, tube_ms=tube_ms
+            out=out, total_ms=total_ms, funnel_ms=funnel_ms,
+            tube_ms=tube_ms, degraded=degraded,
         )

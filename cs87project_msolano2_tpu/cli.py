@@ -32,10 +32,17 @@ def make_input(n: int, seed: int = 0) -> np.ndarray:
 
 def run_golden(backend_name: str) -> int:
     b = get_backend(backend_name)
+    # butterfly backends reproduce the golden DFT bit-exactly (reference
+    # semantics, …pthreads.c:689-705) and declare atol=0, where the
+    # tolerance check degenerates to exact equality; matmul backends
+    # declare a golden tolerance because MXU accumulation order differs
+    # (same bound as tests/test_direct_dft.py::test_einsum_backend_golden)
+    atol = getattr(b, "golden_atol", 0.0)
     ok_all = True
     for p in (1, 2, 4, 8):
         res = b.run(verify.golden_input(), p)
-        ok = verify.golden_check_exact(verify.pi_layout_to_natural(res.out))
+        nat = verify.pi_layout_to_natural(res.out)
+        ok = verify.golden_check_tol(nat, atol)
         print(f"golden test: backend={backend_name} n=8 p={p} ... "
               f"{'PASSED' if ok else 'FAILED'}")
         ok_all &= ok
@@ -95,8 +102,12 @@ def main(argv=None) -> int:
 
     if not args.o:
         print("n\tp\ttotal_ms\tfunnel_ms\ttube_ms")
+    # degraded timers (loop-slope noise-floor fallback) carry the same
+    # marker the harness writes, so redirected CLI output stays honest
+    # when fed to the analysis
+    mark = "\tDEGRADED" if getattr(res, "degraded", False) else ""
     print(f"{args.n}\t{args.p}\t{res.total_ms:.6f}\t{res.funnel_ms:.6f}\t"
-          f"{res.tube_ms:.6f}")
+          f"{res.tube_ms:.6f}{mark}")
     return 0
 
 

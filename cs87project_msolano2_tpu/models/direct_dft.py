@@ -174,6 +174,11 @@ def tube_einsum_planes(sr, si, n: int, p: int, block: int | None = None):
         block = max(min(s, (1 << 22) // s), 1)
     if block >= s:
         return apply(*rows(revk))
+    if s % block:
+        raise ValueError(
+            f"tube block={block} must divide segment length s={s} "
+            "(auto-chosen blocks are powers of two and always do)"
+        )
 
     def step(carry, kb):
         wr, wi = rows(kb)

@@ -87,11 +87,10 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
 
     The window adapts in both directions:
 
-    * slow ops — if even T(k1) exceeds `max_program_ms`, the window
-      shrinks to (1, 4): a single While program that runs for many
-      seconds gets killed by the relay (observed worker crashes at ~10 s
-      programs), and a slow op doesn't need many iterations to clear the
-      noise floor anyway;
+    * slow ops — k2 is derived from the measured T(k1) so that the k2
+      program stays under `max_program_ms` (long-running While programs
+      get killed by the relay — observed worker crashes at ~10 s); if
+      even T(k1) exceeds the budget, the window shrinks to (1, 4);
     * fast ops — if the delta is below `min_delta_ms` (noise floor
       ~±20 ms on the relay), k2 quadruples — one recompile per
       escalation — up to max_k, and T(k1) is re-measured alongside so
@@ -113,6 +112,12 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
         k1, k2 = 1, 4
         f1 = make(k1)
         t1 = _timed_fetch(f1, args, reps=reps)
+    # cap k2 so the k2 program itself stays within the relay's budget:
+    # per-op estimate t1/k1 (overhead-inflated, so this errs safe).  Ops
+    # in the ~150-500 ms range would otherwise run 10-32 s at k2=64.
+    if t1 > 0:
+        k2_budget = int(max_program_ms / (t1 / k1))
+        k2 = max(k1 + 3, min(k2, k2_budget))
     while True:
         t2 = _timed_fetch(make(k2), args, reps=reps)
         if t2 - t1 >= min_delta_ms:
@@ -124,4 +129,7 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
                 f"to resolve even at {max_k} iterations"
             )
         k2 *= 4
-        t1 = min(t1, _timed_fetch(f1, args, reps=reps))
+        # fresh re-measurement (not a running min): both slope endpoints
+        # must come from the same number of samples, else t1 is biased
+        # low and the slope high
+        t1 = _timed_fetch(f1, args, reps=reps)

@@ -35,6 +35,15 @@ def golden_check_exact(y_natural: np.ndarray) -> bool:
     return bool(np.all(y_natural == golden_expected()))
 
 
+def golden_check_tol(y_natural: np.ndarray, atol: float = 1e-4) -> bool:
+    """Tolerance variant for matmul backends: MXU einsum accumulation
+    orders float adds differently from the butterfly recursion, so the
+    golden integers (4, -4) are reached to ~1e-6, not bit-exactly.  The
+    reference's exact check (…pthreads.c:689-705) is kept for butterfly
+    backends; this is the documented relaxation for einsum."""
+    return bool(np.max(np.abs(y_natural - golden_expected())) <= atol)
+
+
 def naive_dft(x: np.ndarray) -> np.ndarray:
     """O(N^2) reference DFT in float64 (independent oracle)."""
     x = np.asarray(x, dtype=np.complex128)

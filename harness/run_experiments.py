@@ -57,8 +57,8 @@ def done_counts(path: str) -> Counter:
     if os.path.exists(path):
         with open(path) as fh:
             for line in fh:
-                parts = line.split("\t")
-                if len(parts) == 5 and parts[0].isdigit():
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) in (5, 6) and parts[0].isdigit():
                     done[(int(parts[0]), int(parts[1]))] += 1
     return done
 
@@ -93,8 +93,12 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
             x = make_input(n, seed)
             for rep in range(done[(n, p)], reps):
                 res = backend.run(x, p, fetch=False)
+                # degraded = loop-slope fell back to dispatch-inclusive
+                # timing (relay noise floor); mark the row so the analysis
+                # can exclude it instead of fitting ~100 ms of relay bias
+                mark = "\tDEGRADED" if getattr(res, "degraded", False) else ""
                 fh.write(f"{n}\t{p}\t{res.total_ms:.6f}\t{res.funnel_ms:.6f}"
-                         f"\t{res.tube_ms:.6f}\n")
+                         f"\t{res.tube_ms:.6f}{mark}\n")
                 fh.flush()
                 completed += 1
                 if completed % 10 == 0 or completed == todo:

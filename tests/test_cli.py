@@ -30,6 +30,20 @@ def test_golden_mode(capsys):
     assert out.count("PASSED") == 4 and "FAILED" not in out
 
 
+def test_golden_mode_every_backend(capsys):
+    """The reference's -t is the acceptance gate for EVERY backend
+    (…pthreads.c:689-705); all registered backends must print PASSED x4,
+    including the einsum backend whose MXU accumulation needs the
+    documented tolerance check instead of exact equality."""
+    from cs87project_msolano2_tpu.backends.registry import list_backends
+
+    for b in list_backends():
+        rc = main(["-t", "-b", b])
+        out = capsys.readouterr().out
+        assert rc == 0, f"{b}: rc={rc}\n{out}"
+        assert out.count("PASSED") == 4 and "FAILED" not in out, f"{b}:\n{out}"
+
+
 def test_verify_flag(capsys):
     rc = main(["-n", "512", "-p", "8", "-b", "serial", "--verify", "-o"])
     assert rc == 0

@@ -94,6 +94,85 @@ def test_law_fit_on_real_sweep(sweep_tsv):
     assert rep["tube"]["r2"] > 0.9
 
 
+def test_law_fit_on_chip_model(tmp_path):
+    """Synthetic data generated from the on-chip law (funnel n(p-1),
+    tube n*log2(n/p) — all p virtual processors on one accelerator) must
+    pass under the on-chip model, which auto-selects for TPU-backend
+    filenames."""
+    an = load_module("analysis/analyze_results.py", "analyze_results")
+    rng = np.random.default_rng(1)
+    path = tmp_path / "fourier-parallel-pi-pallas-results.tsv"
+    with open(path, "w") as fh:
+        for n in (2**18, 2**19, 2**20):
+            for p in (1, 4, 16, 64):
+                for _ in range(5):
+                    fl, tl = an.laws(np.array([float(n)]),
+                                     np.array([float(p)]), "on-chip")
+                    noise = 1 + 0.05 * rng.standard_normal()
+                    fm = 4e-7 * fl[0] * noise
+                    tm = 6e-9 * tl[0] * noise
+                    fh.write(f"{n}\t{p}\t{fm + tm:.6f}\t{fm:.6f}\t{tm:.6f}\n")
+    assert an.model_for(str(path)) == "on-chip"
+    rep = an.analyze(str(path))
+    assert rep["model"] == "on-chip"
+    assert all(rep[k]["holds"] for k in ("total", "funnel", "tube"))
+    # the same data must NOT fit the per-processor funnel law
+    rep_pp = an.analyze(str(path), model="per-processor")
+    assert rep_pp["funnel"]["r2"] < rep["funnel"]["r2"]
+
+
+def test_degraded_rows_excluded(tmp_path):
+    """Rows marked DEGRADED (dispatch-inclusive fallback timing) must not
+    enter the fit."""
+    an = load_module("analysis/analyze_results.py", "analyze_results")
+    rng = np.random.default_rng(2)
+    path = tmp_path / "fourier-parallel-pi-serial-results.tsv"
+    with open(path, "w") as fh:
+        for n in (1024, 4096, 16384):
+            for p in (1, 2, 4, 8, 16):
+                for _ in range(3):
+                    fl, tl = an.laws(np.array([float(n)]),
+                                     np.array([float(p)]))
+                    noise = 1 + 0.05 * rng.standard_normal()
+                    fm = 2e-6 * fl[0] * noise
+                    tm = 3e-6 * tl[0] * noise
+                    fh.write(f"{n}\t{p}\t{fm + tm:.6f}\t{fm:.6f}\t{tm:.6f}\n")
+        # poisoned rows: ~100 ms of relay overhead, properly marked
+        for p in (1, 2, 4, 8, 16):
+            fh.write(f"64\t{p}\t100.0\t50.0\t50.0\tDEGRADED\n")
+    data, degraded = an.load_tsv(str(path))
+    assert degraded == 5
+    assert not (data[:, 0] == 64).any()
+    rep = an.analyze(str(path))
+    assert all(rep[k]["holds"] for k in ("total", "funnel", "tube"))
+
+
+def test_harness_marks_degraded_rows(tmp_path, monkeypatch):
+    """A backend reporting degraded timers must produce a 6th-column
+    marker, and resume must still count the row as done."""
+    he = load_module("harness/run_experiments.py", "run_experiments")
+    from cs87project_msolano2_tpu.backends import registry
+    from cs87project_msolano2_tpu.backends.base import RunResult
+
+    class FakeBackend:
+        name = "serial"
+
+        def capacity(self):
+            return None
+
+        def run(self, x, p, reps=1, fetch=True):
+            return RunResult(out=None, total_ms=100.0, funnel_ms=50.0,
+                             tube_ms=50.0, degraded=True)
+
+    monkeypatch.setattr(registry, "get_backend", lambda name: FakeBackend())
+    monkeypatch.setattr(he, "get_backend", lambda name: FakeBackend())
+    path = he.sweep("serial", [256], [1, 2], reps=1, outdir=str(tmp_path),
+                    resume=True, seed=0)
+    rows = [l.split("\t") for l in open(path).read().strip().splitlines()]
+    assert all(len(r) == 6 and r[5] == "DEGRADED" for r in rows)
+    assert he.done_counts(path)[(256, 1)] == 1
+
+
 def test_dispatcher_and_awk_fallback(sweep_tsv):
     """The bash dispatcher runs the full analysis; the awk fallback must
     agree with the python fit to ~3 significant digits."""
@@ -112,6 +191,38 @@ def test_dispatcher_and_awk_fallback(sweep_tsv):
     assert awk.returncode == 0
     an = load_module("analysis/analyze_results.py", "analyze_results")
     rep = an.analyze(sweep_tsv)
+    awk_beta = float(awk.stdout.split("~")[1].split("*")[0])
+    assert abs(awk_beta - rep["total"]["beta"]) / rep["total"]["beta"] < 1e-3
+
+
+def test_awk_fallback_on_chip_model_and_degraded(tmp_path):
+    """The awk fallback must mirror the python analysis: on-chip law for
+    TPU-backend filenames, DEGRADED rows excluded."""
+    an = load_module("analysis/analyze_results.py", "analyze_results")
+    rng = np.random.default_rng(3)
+    path = tmp_path / "fourier-parallel-pi-jax-results.tsv"
+    with open(path, "w") as fh:
+        for n in (2**16, 2**18, 2**20):
+            for p in (1, 4, 16):
+                for _ in range(4):
+                    fl, tl = an.laws(np.array([float(n)]),
+                                     np.array([float(p)]), "on-chip")
+                    noise = 1 + 0.03 * rng.standard_normal()
+                    fm = 4e-7 * fl[0] * noise
+                    tm = 6e-9 * tl[0] * noise
+                    fh.write(f"{n}\t{p}\t{fm + tm:.6f}\t{fm:.6f}\t{tm:.6f}\n")
+        fh.write("64\t2\t100.0\t50.0\t50.0\tDEGRADED\n")
+    awk = subprocess.run(
+        ["awk", "-f", os.path.join(REPO, "analysis", "analyze-results.awk"),
+         str(path)],
+        capture_output=True, text=True,
+    )
+    assert awk.returncode == 0, awk.stderr
+    assert "law model: on-chip" in awk.stdout
+    assert "excluded 1 DEGRADED" in awk.stdout
+    assert "law holds: Yes" in awk.stdout
+    # and the fitted beta agrees with the python fit on the same data
+    rep = an.analyze(str(path))
     awk_beta = float(awk.stdout.split("~")[1].split("*")[0])
     assert abs(awk_beta - rep["total"]["beta"]) / rep["total"]["beta"] < 1e-3
 
