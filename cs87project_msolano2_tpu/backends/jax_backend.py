@@ -186,6 +186,19 @@ class JaxBackend:
 
         x = check_run_args(x, p)
         n = x.shape[-1]
+        if p >= 32:
+            # single-chip backends materialize ALL p virtual processors,
+            # so the funnel's redundant work is n(p-1) — at large p it
+            # dominates and the run gets SLOWER with p (measured 0.34x
+            # at p=64, datasets/README.md).  Real parallelism at large p
+            # is the multi-chip path (parallel/pi_shard.py).
+            import sys
+
+            print(f"# note: p={p} on a single chip does n(p-1) redundant "
+                  "funnel work (the paper's communication/replication "
+                  "trade); expect slowdown beyond p~16 — use "
+                  "parallel.pi_fft_sharded for real multi-device speedup",
+                  file=sys.stderr)
         funnel_f, tube_f, full_f = _compiled(n, p, self._impl)
 
         xr = jax.device_put(jnp.asarray(np.real(x), dtype=jnp.float32))

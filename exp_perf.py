@@ -1,0 +1,84 @@
+"""Round-3 perf experiments, part 5: the rql composed path vs pallas2,
+high-precision slope.  Timing first, fetches last."""
+
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cs87project_msolano2_tpu.ops.pallas_fft import (
+    fft_pi_layout_pallas2,
+    fft_pi_layout_pallas_rql,
+)
+from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+N = 1 << 20
+K1, K2, REPS = 64, 2048, 5
+
+
+def gf(ms):
+    return 5.0 * N * np.log2(N) / (ms * 1e-3) / 1e9
+
+
+def main():
+    # XLA FFT availability probe (compile-only shapes, tiny)
+    try:
+        x = jnp.asarray(np.ones(1024, np.complex64))
+        _ = jax.jit(jnp.fft.fft)(x)
+        print("jnp.fft.fft: compiles on this backend", flush=True)
+    except Exception as e:
+        print(f"jnp.fft.fft: UNAVAILABLE ({type(e).__name__})", flush=True)
+
+    key = jax.random.PRNGKey(0)
+    xr = jax.random.normal(key, (N,), jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(key, 1), (N,), jnp.float32)
+    inv = np.float32(1.0 / np.sqrt(N))
+
+    def rql(c, tile, cb):
+        yr, yi = fft_pi_layout_pallas_rql(c[0], c[1], tile=tile, cb=cb)
+        return yr * inv, yi * inv
+
+    def p2(c, tile, cb):
+        yr, yi = fft_pi_layout_pallas2(c[0], c[1], tile=tile, cb=cb,
+                                       separable=True)
+        return yr * inv, yi * inv
+
+    cases = [
+        ("rql t16 cb13", lambda c: rql(c, 1 << 16, 1 << 13)),
+        ("rql t17 cb14", lambda c: rql(c, 1 << 17, 1 << 14)),
+        ("rql t16 cb14", lambda c: rql(c, 1 << 16, 1 << 14)),
+        ("p2  t16 cb13", lambda c: p2(c, 1 << 16, 1 << 13)),
+        ("rql t18 cb14", lambda c: rql(c, 1 << 18, 1 << 14)),
+    ]
+    for rnd in range(2):
+        for name, body in cases:
+            try:
+                ms = loop_slope_ms(body, (xr, xi), k1=K1, k2=K2, reps=REPS,
+                                   min_delta_ms=150.0)
+                print(f"[{rnd}] {name}: {ms:.4f} ms  ({gf(ms):.0f} GF)",
+                      flush=True)
+            except Exception as e:
+                print(f"[{rnd}] {name}: FAILED {type(e).__name__}", flush=True)
+
+    # correctness at bench shape (fetch — last)
+    rng = np.random.default_rng(0)
+    hxr = rng.standard_normal(N).astype(np.float32)
+    hxi = rng.standard_normal(N).astype(np.float32)
+    ref = np.fft.fft(hxr.astype(np.complex128) + 1j * hxi)
+    from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+    idx = bit_reverse_indices(N)
+    for tile, cb in ((1 << 16, 1 << 13), (1 << 17, 1 << 14)):
+        yr, yi = jax.jit(
+            lambda a, b, t=tile, c=cb: fft_pi_layout_pallas_rql(
+                a, b, tile=t, cb=c)
+        )(hxr, hxi)
+        y = np.asarray(yr).astype(np.complex128) + 1j * np.asarray(yi)
+        err = np.max(np.abs(y[idx] - ref)) / np.max(np.abs(ref))
+        print(f"rql t{int(np.log2(tile))}: rel_err {err:.2e}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

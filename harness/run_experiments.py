@@ -92,7 +92,15 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
         for n, p in cells:
             x = make_input(n, seed)
             for rep in range(done[(n, p)], reps):
-                res = backend.run(x, p, fetch=False)
+                try:
+                    res = backend.run(x, p, fetch=False)
+                except ValueError as e:
+                    # per-(n, p) infeasibility (e.g. einsum's p*n cap) is
+                    # a property of the cell, not an error of the sweep
+                    print(f"# {backend_name} n={n} p={p} skipped: {e}",
+                          file=sys.stderr)
+                    todo -= reps - rep
+                    break
                 # degraded = loop-slope fell back to dispatch-inclusive
                 # timing (relay noise floor); mark the row so the analysis
                 # can exclude it instead of fitting ~100 ms of relay bias
@@ -116,7 +124,12 @@ def verify_pass(backend_name: str, ns: list[int], ps: list[int],
     for n, p in cells:
         x = make_input(n, seed)
         ref = np.fft.fft(x.astype(np.complex128))
-        res = backend.run(x, p)
+        try:
+            res = backend.run(x, p)
+        except ValueError as e:
+            print(f"# {backend_name} n={n} p={p} verify skipped: {e}",
+                  file=sys.stderr)
+            continue
         err = rel_err(pi_layout_to_natural(res.out), ref)
         if err > 1e-5:
             raise AssertionError(

@@ -97,6 +97,71 @@ def test_multihost_noop_without_env(monkeypatch):
     assert mesh.devices.size >= 8
 
 
+_MULTIHOST_CHILD = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["PIFFT_REPO"])
+from cs87project_msolano2_tpu.parallel.multihost import (
+    global_mesh, init_distributed,
+)
+
+# env-driven config, exactly how a launcher would set it
+assert init_distributed() is True
+assert jax.process_count() == 2
+assert len(jax.devices()) == 4  # 2 local x 2 processes
+
+import numpy as np
+from cs87project_msolano2_tpu.parallel.pi_shard import pi_fft_sharded
+
+mesh = global_mesh()
+rng = np.random.default_rng(0)
+n = 1024
+xr = rng.standard_normal(n).astype(np.float32)
+xi = rng.standard_normal(n).astype(np.float32)
+yr, yi = jax.jit(lambda a, b: pi_fft_sharded(a, b, mesh))(xr, xi)
+jax.block_until_ready((yr, yi))
+assert yr.shape == (n,)
+print(f"OK process {jax.process_index()}", flush=True)
+"""
+
+
+def test_multihost_two_process_smoke(tmp_path):
+    """The initialized path of init_distributed: a real 2-process
+    jax.distributed job on localhost (CPU platform), running the sharded
+    pi-FFT over the 4-device global mesh."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        env.update(
+            PIFFT_REPO=repo,
+            PIFFT_COORDINATOR=f"127.0.0.1:{port}",
+            PIFFT_NUM_PROCESSES="2",
+            PIFFT_PROCESS_ID=str(pid),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MULTIHOST_CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=180) for p in procs]
+    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}\n{err}"
+        assert f"OK process {pid}" in out
+
+
 def test_cli_trace_flag(tmp_path, capsys):
     from cs87project_msolano2_tpu.cli import main
 
