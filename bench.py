@@ -1,15 +1,19 @@
 """Headline benchmark: 1-D complex FFT, N = 2^20, single TPU chip.
 
-Measures the framework's flagship path (XLA long-range stages + Pallas
-VMEM tile kernel, pi layout — gather excluded exactly as the reference
-excludes it from timing) against the native C baseline on this host, and
-prints ONE JSON line:
+Measures the framework's flagship path (the composed two-kernel Pallas
+pi-FFT on the shared (R, Q, 128) layout, pi-layout output — gather
+excluded exactly as the reference excludes it from timing) against TWO
+baselines on this host and prints ONE JSON line:
 
-    {"metric": ..., "value": GFLOP/s, "unit": ..., "vs_baseline": speedup}
+    {"metric": ..., "value": GFLOP/s, "unit": ...,
+     "vs_baseline": ..., "vs_xla_fft": ..., "xla_fft_ms": ...}
 
-vs_baseline is wall-clock speedup over the C backend at the same N
-(BASELINE.md north star: >= 10x; GFLOP/s uses the standard 5 N log2 N
-FFT flop count).
+* vs_baseline — wall-clock speedup over the native C backend at the same
+  N (BASELINE.md north star: >= 10x; GFLOP/s uses the standard
+  5 N log2 N FFT flop count).
+* vs_xla_fft — wall-clock speedup over `jnp.fft.fft` ON THE SAME CHIP at
+  the same N: the strongest same-hardware comparison (XLA's own FFT is
+  the production alternative a user would otherwise call).
 
 Measurement method: loop-slope (utils/timing.py) — on the axon TPU relay
 block_until_ready is not a real barrier, so the FFT is iterated K times
@@ -25,14 +29,6 @@ import sys
 import numpy as np
 
 N = 1 << 20
-# (impl, tile, cb): two-kernel first (fastest measured: ~0.11 ms at
-# tile=2^16 cb=2^14 = ~930 GFLOP/s), hybrid as fallback configs
-CONFIGS = (
-    ("two-kernel", 1 << 16, 1 << 14),
-    ("two-kernel", 1 << 16, 1 << 16),
-    ("hybrid", 1 << 16, None),
-    ("hybrid", 1 << 15, None),
-)
 
 
 def measure_tpu_ms() -> float:
@@ -40,10 +36,22 @@ def measure_tpu_ms() -> float:
     import jax.numpy as jnp
 
     from cs87project_msolano2_tpu.ops.pallas_fft import (
-        fft_pi_layout_pallas,
         fft_pi_layout_pallas2,
+        fft_pi_layout_pallas_rql,
     )
     from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+    # (impl, tile, cb, tail): rql = the retiling-free (R, Q, 128)
+    # composed path; tail=256 moves one VPU stage traversal onto the
+    # (otherwise idle) MXU as a 2x2-blocked 256-point DIF matmul —
+    # fastest measured: ~0.092 ms at tile=2^16 cb=2^12..13 (~1100 GF),
+    # rel_err 2.2e-07 vs numpy (tail=512 tips the MXU out of hiding)
+    configs = (
+        ("rql", 1 << 16, 1 << 13, 256),
+        ("rql", 1 << 16, 1 << 12, 256),
+        ("rql", 1 << 16, 1 << 13, 128),
+        ("two-kernel", 1 << 16, 1 << 14, 128),
+    )
 
     key = jax.random.PRNGKey(0)
     xr = jax.random.normal(key, (N,), jnp.float32)
@@ -51,23 +59,93 @@ def measure_tpu_ms() -> float:
 
     inv_rn = np.float32(1.0 / np.sqrt(N))  # keep loop iterates in range
     best = float("inf")
-    for impl, tile, cb in CONFIGS:
+    for impl, tile, cb, tail in configs:
         try:
-            def body(c, impl=impl, t=tile, cb=cb):
-                if impl == "two-kernel":
-                    yr, yi = fft_pi_layout_pallas2(c[0], c[1], tile=t, cb=cb)
+            def body(c, impl=impl, t=tile, cb=cb, tail=tail):
+                if impl == "rql":
+                    yr, yi = fft_pi_layout_pallas_rql(
+                        c[0], c[1], tile=t, cb=cb, tail=tail)
                 else:
-                    yr, yi = fft_pi_layout_pallas(c[0], c[1], tile=t)
+                    yr, yi = fft_pi_layout_pallas2(c[0], c[1], tile=t, cb=cb)
                 return yr * inv_rn, yi * inv_rn
 
-            ms = loop_slope_ms(body, (xr, xi), k1=32, k2=512, reps=3)
+            ms = loop_slope_ms(body, (xr, xi), k1=64, k2=1024, reps=5,
+                               min_delta_ms=100.0)
             best = min(best, ms)
         except Exception as e:  # a config failing to compile is not fatal
-            print(f"# {impl} tile={tile} cb={cb} failed: {type(e).__name__}",
-                  file=sys.stderr)
+            print(f"# {impl} tile={tile} cb={cb} tail={tail} failed: "
+                  f"{type(e).__name__}", file=sys.stderr)
     if not np.isfinite(best):
         raise RuntimeError("no benchmark configuration compiled")
     return best
+
+
+def measure_xla_fft_ms():
+    """jnp.fft.fft on the same chip at the same N — the same-hardware
+    comparison VERDICT.md round 2 demanded.  The loop body carries
+    complex state (no per-iteration plane split/merge) so only the FFT
+    itself plus one scaling is timed — the same epilogue the Pallas body
+    pays.  Falls back to the unrolled slope if the FFT custom-call
+    cannot lower inside a fori_loop; returns None (metric omitted) if it
+    cannot be measured at all rather than losing the other results."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.utils.timing import (
+        loop_slope_ms,
+        unrolled_slope_ms,
+    )
+
+    key = jax.random.PRNGKey(2)
+    xr = jax.random.normal(key, (N,), jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(key, 1), (N,), jnp.float32)
+    inv_rn = np.complex64(1.0 / np.sqrt(N))
+
+    # The relay cannot pass complex64 across the program ABI (eager
+    # complex ops, complex program inputs, and complex While carries are
+    # all Unimplemented), so the loop body must carry float planes and
+    # pay a complex-merge + re/im-split every iteration.  That epilogue
+    # is NOT the XLA FFT's cost — charging it would overstate our
+    # speedup — so it is measured separately with the same method (the
+    # identical elementwise chain minus the fft) and subtracted.
+    inv = np.float32(inv_rn.real)
+
+    def body_fft(c):
+        y = jnp.fft.fft(c[0] + 1j * c[1])
+        return jnp.real(y) * inv, jnp.imag(y) * inv
+
+    def body_epilogue(c):
+        y = c[0] + 1j * c[1]
+        return jnp.real(y) * inv, jnp.imag(y) * inv
+
+    try:
+        raw = loop_slope_ms(body_fft, (xr, xi), k1=64, k2=1024, reps=5,
+                            min_delta_ms=100.0)
+    except Exception as e:
+        # some backends cannot lower the FFT custom-call inside a While
+        # body — statically unroll instead (modest k2: program size and
+        # remote-compile time grow linearly with the unroll)
+        print(f"# xla fft under fori_loop failed ({type(e).__name__}); "
+              "trying unrolled slope", file=sys.stderr)
+        try:
+            raw = unrolled_slope_ms(body_fft, (xr, xi), k1=8, k2=64,
+                                    reps=7, min_delta_ms=20.0, max_k=256)
+        except Exception as e2:
+            print(f"# xla fft not measurable on this backend "
+                  f"({type(e2).__name__}); omitting vs_xla_fft",
+                  file=sys.stderr)
+            return None
+    try:
+        epilogue = loop_slope_ms(body_epilogue, (xr, xi), k1=64, k2=1024,
+                                 reps=5, min_delta_ms=40.0)
+    except Exception as e:
+        print(f"# epilogue not resolvable ({type(e).__name__}); "
+              "vs_xla_fft conservatively uncorrected", file=sys.stderr)
+        epilogue = 0.0
+    # the epilogue is a small fraction of the FFT; if its measurement
+    # came back implausibly large (relay noise), don't let it eat the
+    # result — cap the correction at half the raw time
+    return max(raw - epilogue, raw * 0.5)
 
 
 def measure_c_baseline_ms() -> float:
@@ -84,18 +162,19 @@ def measure_c_baseline_ms() -> float:
 
 def main() -> int:
     tpu_ms = measure_tpu_ms()
+    xla_ms = measure_xla_fft_ms()
     c_ms = measure_c_baseline_ms()
     gflops = 5.0 * N * np.log2(N) / (tpu_ms * 1e-3) / 1e9
-    print(
-        json.dumps(
-            {
-                "metric": "fft1d_n2^20_complex64_gflops",
-                "value": round(gflops, 1),
-                "unit": "GFLOP/s",
-                "vs_baseline": round(c_ms / tpu_ms, 1),
-            }
-        )
-    )
+    record = {
+        "metric": "fft1d_n2^20_complex64_gflops",
+        "value": round(gflops, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(c_ms / tpu_ms, 1),
+    }
+    if xla_ms is not None:
+        record["vs_xla_fft"] = round(xla_ms / tpu_ms, 2)
+        record["xla_fft_ms"] = round(xla_ms, 4)
+    print(json.dumps(record))
     return 0
 
 

@@ -167,6 +167,9 @@ def _loop_bodies(n: int, p: int, impl: str):
     return funnel_body, tube_body, full_body
 
 
+_warned_large_p: set[tuple[int, int]] = set()
+
+
 class JaxBackend:
     def __init__(self, impl: str = "jnp"):
         self.name = "jax" if impl == "jnp" else impl
@@ -186,14 +189,16 @@ class JaxBackend:
 
         x = check_run_args(x, p)
         n = x.shape[-1]
-        if p >= 32:
+        if p >= 32 and (n, p) not in _warned_large_p:
             # single-chip backends materialize ALL p virtual processors,
             # so the funnel's redundant work is n(p-1) — at large p it
             # dominates and the run gets SLOWER with p (measured 0.34x
             # at p=64, datasets/README.md).  Real parallelism at large p
-            # is the multi-chip path (parallel/pi_shard.py).
+            # is the multi-chip path (parallel/pi_shard.py).  Once per
+            # (n, p): a harness sweep calls run() reps times per cell.
             import sys
 
+            _warned_large_p.add((n, p))
             print(f"# note: p={p} on a single chip does n(p-1) redundant "
                   "funnel work (the paper's communication/replication "
                   "trade); expect slowdown beyond p~16 — use "

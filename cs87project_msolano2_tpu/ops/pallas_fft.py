@@ -41,33 +41,45 @@ DEFAULT_TILE = 1 << 15
 
 
 @lru_cache(maxsize=8)
-def dif_tail_matrix_t() -> tuple[np.ndarray, np.ndarray]:
-    """B^T for the 128-point DIF as (re, im) float32.
+def dif_tail_matrix_t(tail: int = LANE) -> tuple[np.ndarray, np.ndarray]:
+    """B^T for the `tail`-point DIF as (re, im) float32.
 
-    B[j, k] = W_128^{k * bitrev7(j)} maps a 128-vector to its 128-point
+    B[j, k] = W_tail^{k * bitrev(j)} maps a tail-vector to its tail-point
     DIF (DFT in bit-reversed order); the kernel computes x2d @ B^T.
+    tail > 128 trades MXU flops (x4 per doubling) for one fewer VPU
+    stage traversal — profitable while the matmul hides under the
+    elementwise stages and HBM copies (measured: DEFAULT ~= HIGHEST at
+    n=2^20, i.e. the MXU tail is fully hidden).
     """
-    j = bit_reverse_indices(LANE)  # bitrev7(j) for each output row j
-    k = np.arange(LANE)
-    bt = np.exp(-2j * np.pi * np.outer(k, j) / LANE)  # Bt[k, j] = B[j, k]
+    j = bit_reverse_indices(tail)  # bitrev(j) for each output row j
+    k = np.arange(tail)
+    bt = np.exp(-2j * np.pi * np.outer(k, j) / tail)  # Bt[k, j] = B[j, k]
     return bt.real.astype(np.float32), bt.imag.astype(np.float32)
 
 
-def _tile_plan(tile: int):
+def _check_tail(tail: int, tile: int) -> None:
+    if tail < LANE or tail & (tail - 1) or tile % tail:
+        raise ValueError(f"tail={tail} must be a power-of-two multiple "
+                         f"of {LANE} dividing tile={tile}")
+
+
+def _tile_plan(tile: int, tail: int = LANE):
     """Mixed-radix plan for the elementwise levels of a tile-point DIF.
 
     Pairs of radix-2 levels are fused into radix-4 stages (two levels in
     one VMEM traversal, 3 complex muls per 4 points instead of 4 — the
     W_m^{m/4} = -i rotation is free as a re/im swap).  A radix-4 stage
     needs q = half/2 >= LANE; a trailing odd level (or the last >=LANE
-    level) stays radix-2.  Returns (steps, tables):
+    level) stays radix-2.  Elementwise levels stop once sub-transforms
+    reach `tail` points (the MXU finishes those as one dense matmul).
+    Returns (steps, tables):
       steps  — tuples ("r4", q_rows) consuming 6 table refs (w1, w2,
                w3 = w1*w2 as re/im pairs) or ("r2", half_rows) consuming
                2 refs;
       tables — the flat numpy list, each (rows, LANE) float32.
     """
     full = twiddle_tables(tile)
-    nlev = max(ilog2(tile) - 7, 0)  # levels with half >= LANE
+    nlev = max(ilog2(tile) - ilog2(tail), 0)  # levels down to `tail`
     steps, tables = [], []
     l = 0
     while l < nlev:
@@ -155,7 +167,14 @@ def _tile_fft_kernel(steps, precision, *refs):
             xr = jnp.stack((tr, ur), axis=1).reshape(rows, LANE)
             xi = jnp.stack((ti2, ui), axis=1).reshape(rows, LANE)
 
-    # MXU tail: the 7 sub-lane levels of every 128-chunk as one matmul
+    # MXU tail: the log2(tail) sub-chunk levels as one dense matmul.
+    # tail == 128: every (1, LANE) row is an independent 128-point DIF,
+    # finished as x @ B^T.  tail == S*128, S > 1: every S consecutive
+    # rows form one tail-point group; split rows by position-in-group
+    # (X_i, a sublane-stride gather), block the (tail, tail) B^T into
+    # (LANE, LANE) tiles, and accumulate Y_s = sum_i X_i @ Bt[i, s] —
+    # S^2 complex block-matmuls that trade MXU flops for one fewer VPU
+    # traversal per tail doubling.
     btr = btr_ref[:, :]
     bti = bti_ref[:, :]
     dot = partial(
@@ -164,8 +183,28 @@ def _tile_fft_kernel(steps, precision, *refs):
         precision=precision,
         preferred_element_type=jnp.float32,
     )
-    yr = dot(xr, btr) - dot(xi, bti)
-    yi = dot(xr, bti) + dot(xi, btr)
+    S = btr.shape[0] // LANE
+    if S == 1:
+        yr = dot(xr, btr) - dot(xi, bti)
+        yi = dot(xr, bti) + dot(xi, btr)
+    else:
+        xrs = xr.reshape(-1, S, LANE)
+        xis = xi.reshape(-1, S, LANE)
+        yr_parts, yi_parts = [], []
+        for s in range(S):
+            accr = acci = None
+            for i in range(S):
+                br = btr[i * LANE : (i + 1) * LANE, s * LANE : (s + 1) * LANE]
+                bi = bti[i * LANE : (i + 1) * LANE, s * LANE : (s + 1) * LANE]
+                xri, xii = xrs[:, i], xis[:, i]
+                pr = dot(xri, br) - dot(xii, bi)
+                pi_ = dot(xri, bi) + dot(xii, br)
+                accr = pr if accr is None else accr + pr
+                acci = pi_ if acci is None else acci + pi_
+            yr_parts.append(accr)
+            yi_parts.append(acci)
+        yr = jnp.stack(yr_parts, axis=1).reshape(rows, LANE)
+        yi = jnp.stack(yi_parts, axis=1).reshape(rows, LANE)
     or_ref[...] = yr.reshape(or_ref.shape)
     oi_ref[...] = yi.reshape(oi_ref.shape)
 
@@ -175,15 +214,21 @@ def _use_interpret() -> bool:
 
 
 def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
-                  precision=None):
+                  precision=None, tail: int = LANE):
     """Grid the tile kernel over rows: (R, tile//128*...)  Input planes
     shaped (total_rows, 128) with total_rows % (tile/128) == 0; each
     consecutive group of tile/128 rows is one independent tile-point DIF.
 
-    `precision` controls the MXU tail matmul: HIGHEST (default) runs the
-    float32 6-pass decomposition; HIGH (3-pass bf16) roughly halves MXU
-    time at ~1e-6 extra relative error on the 128-point tail — still
-    comfortably inside the framework's 1e-5 verification bound.
+    `precision` controls the MXU tail matmul.  Mosaic lowers only
+    HIGHEST (default — the multi-pass bf16 decomposition of f32) and
+    DEFAULT (single-pass bf16, ~4e-3 relative error: fails the 1e-5
+    verification bound, useful only for isolating MXU cost); HIGH
+    raises NotImplementedError in the TPU lowering.
+
+    `tail` (128, 256, 512, ... — any power-of-two multiple of 128
+    dividing tile) picks the dense-matmul tail size — see
+    dif_tail_matrix_t.  256 is the measured sweet spot at n=2^20;
+    512 tips the MXU out of hiding.
     """
     from jax.experimental import pallas as pl
 
@@ -191,6 +236,7 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
         interpret = _use_interpret()
     if precision is None:
         precision = jax.lax.Precision.HIGHEST
+    _check_tail(tail, tile)
 
     trows = tile // LANE
     total_rows = xr2d.shape[0]
@@ -200,15 +246,15 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
 
     assert_disjoint_cover(total_rows, trows, ntiles)
 
-    steps, np_tables = _tile_plan(tile)
+    steps, np_tables = _tile_plan(tile, tail)
     tables = [jnp.asarray(t) for t in np_tables]
-    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t())
+    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
 
     in_specs = [pl.BlockSpec((trows, LANE), lambda i: (i, 0))] * 2
     in_specs += [
         pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables
     ]
-    in_specs += [pl.BlockSpec((LANE, LANE), lambda i: (0, 0))] * 2
+    in_specs += [pl.BlockSpec((tail, tail), lambda i: (0, 0))] * 2
 
     out = pl.pallas_call(
         partial(_tile_fft_kernel, steps, precision),
@@ -382,7 +428,8 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
 
 def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
                           cb: int | None = None, interpret=None,
-                          precision=None, separable: bool = False):
+                          precision=None, separable: bool = False,
+                          tail: int = LANE):
     """Two-kernel whole-FFT: long-range stages as a column-grid kernel,
     tile-local FFTs as the row-grid kernel — exactly two HBM round trips,
     no XLA elementwise passes in between."""
@@ -393,6 +440,7 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
         # typo'd cb fails at every n, not only once n grows past tile
         raise ValueError(f"cb={cb} must divide tile={tile} and be a "
                          f"multiple of {LANE}")
+    _check_tail(tail, tile)  # before the long-range kernel runs
     R = n // tile
     if R > 1:
         xr2, xi2 = long_range_grid(
@@ -402,14 +450,14 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
         xr, xi = xr2.reshape(n), xi2.reshape(n)
     yr, yi = tile_fft_grid(
         xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret,
-        precision,
+        precision, tail,
     )
     return yr.reshape(n), yi.reshape(n)
 
 
 def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
                              cb: int | None = None, interpret=None,
-                             precision=None):
+                             precision=None, tail: int = LANE):
     """Two-kernel whole-FFT on a shared 3-D (R, Q, LANE) layout.
 
     fft_pi_layout_pallas2 reshapes (R, C) -> (R*C/128, 128) between the
@@ -432,6 +480,7 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     if cb % LANE or tile % cb:
         raise ValueError(f"cb={cb} must divide tile={tile} and be a "
                          f"multiple of {LANE}")
+    _check_tail(tail, tile)  # before any kernel runs
     R = n // tile
     Q = tile // LANE
     qb = cb // LANE
@@ -462,12 +511,12 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
 
     if precision is None:
         precision = jax.lax.Precision.HIGHEST
-    steps, np_tables = _tile_plan(tile)
+    steps, np_tables = _tile_plan(tile, tail)
     tables = [jnp.asarray(t) for t in np_tables]
-    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t())
+    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
     in_specs = [pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2
     in_specs += [pl.BlockSpec(t.shape, lambda j: (0, 0)) for t in tables]
-    in_specs += [pl.BlockSpec((LANE, LANE), lambda j: (0, 0))] * 2
+    in_specs += [pl.BlockSpec((tail, tail), lambda j: (0, 0))] * 2
     yr, yi = pl.pallas_call(
         partial(_tile_fft_kernel, steps, precision),
         grid=(R,),

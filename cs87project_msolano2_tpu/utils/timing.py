@@ -106,6 +106,41 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
 
         return jax.jit(run)
 
+    return _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
+                            max_program_ms, kind="loop")
+
+
+def unrolled_slope_ms(body: Callable, args: tuple, k1: int = 4,
+                      k2: int = 32, reps: int = 3,
+                      min_delta_ms: float = 40.0, max_k: int = 512,
+                      max_program_ms: float = 4000.0) -> float:
+    """loop_slope_ms for ops that cannot lower inside a While body on
+    this backend: the K applications are STATICALLY UNROLLED into one jit
+    program ending in a scalar fetch.  Same slope arithmetic, same
+    barriers; max_k is much smaller because program size (and compile
+    time) grows linearly with K — large unrolls can take minutes of
+    remote compile, so keep k2 modest."""
+    import jax
+
+    def make(k):
+        def run(a):
+            c = a
+            for _ in range(k):
+                c = body(c)
+            leaf = jax.tree_util.tree_leaves(c)[0]
+            return jax.numpy.real(leaf).ravel()[0]
+
+        return jax.jit(run)
+
+    return _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
+                            max_program_ms, kind="unrolled")
+
+
+def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
+                     max_program_ms, kind):
+    """Shared slope machinery: `make(k)` builds the jitted K-application
+    program; returns (T(k2) - T(k1)) / (k2 - k1) once the delta clears
+    `min_delta_ms`."""
     f1 = make(k1)
     t1 = _timed_fetch(f1, args, reps=reps)
     if t1 > max_program_ms and k1 > 1:
@@ -124,57 +159,12 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
             return (t2 - t1) / (k2 - k1)
         if k2 >= max_k:
             raise LoopSlopeUnresolved(
-                f"loop-slope below noise floor: T({k1})={t1:.1f}ms "
+                f"{kind}-slope below noise floor: T({k1})={t1:.1f}ms "
                 f"T({k2})={t2:.1f}ms delta<{min_delta_ms}ms — op too fast "
-                f"to resolve even at {max_k} iterations"
+                f"to resolve even at {max_k} applications"
             )
-        k2 *= 4
+        k2 = min(k2 * 4, max_k)
         # fresh re-measurement (not a running min): both slope endpoints
         # must come from the same number of samples, else t1 is biased
         # low and the slope high
-        t1 = _timed_fetch(f1, args, reps=reps)
-
-
-def unrolled_slope_ms(body: Callable, args: tuple, k1: int = 4,
-                      k2: int = 32, reps: int = 3,
-                      min_delta_ms: float = 40.0, max_k: int = 512,
-                      max_program_ms: float = 4000.0) -> float:
-    """loop_slope_ms for ops that cannot lower inside a While body on
-    this backend (e.g. the XLA FFT custom-call, which the axon relay
-    reports Unimplemented under fori_loop): the K applications are
-    STATICALLY UNROLLED into one jit program ending in a scalar fetch.
-    Same slope arithmetic, same barriers; max_k is much smaller because
-    program size (and compile time) grows linearly with K."""
-    import jax
-
-    def make(k):
-        def run(a):
-            c = a
-            for _ in range(k):
-                c = body(c)
-            leaf = jax.tree_util.tree_leaves(c)[0]
-            return jax.numpy.real(leaf).ravel()[0]
-
-        return jax.jit(run)
-
-    f1 = make(k1)
-    t1 = _timed_fetch(f1, args, reps=reps)
-    if t1 > max_program_ms and k1 > 1:
-        k1, k2 = 1, 4
-        f1 = make(k1)
-        t1 = _timed_fetch(f1, args, reps=reps)
-    if t1 > 0:
-        k2_budget = int(max_program_ms / (t1 / k1))
-        k2 = max(k1 + 3, min(k2, k2_budget))
-    while True:
-        t2 = _timed_fetch(make(k2), args, reps=reps)
-        if t2 - t1 >= min_delta_ms:
-            return (t2 - t1) / (k2 - k1)
-        if k2 >= max_k:
-            raise LoopSlopeUnresolved(
-                f"unrolled-slope below noise floor: T({k1})={t1:.1f}ms "
-                f"T({k2})={t2:.1f}ms delta<{min_delta_ms}ms at the "
-                f"unroll limit {max_k}"
-            )
-        k2 = min(k2 * 4, max_k)
         t1 = _timed_fetch(f1, args, reps=reps)

@@ -156,7 +156,15 @@ def test_multihost_two_process_smoke(tmp_path):
             [sys.executable, "-c", _MULTIHOST_CHILD], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
-    outs = [p.communicate(timeout=180) for p in procs]
+    try:
+        outs = [p.communicate(timeout=180) for p in procs]
+    finally:
+        # a child that lost its coordinator blocks forever in
+        # jax.distributed.initialize — never leak it into the pytest run
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}\n{err}"
         assert f"OK process {pid}" in out

@@ -23,11 +23,14 @@ def load_module(relpath, name):
 
 @pytest.fixture(scope="module")
 def sweep_tsv(tmp_path_factory):
+    # n >= 4096 so per-row serial times are tens of microseconds: at
+    # n=256 the phase timers sit at the clock's noise floor and the law
+    # fit below (r2 > 0.9) becomes flaky on a loaded machine
     out = tmp_path_factory.mktemp("sweep")
     he = load_module("harness/run_experiments.py", "run_experiments")
-    path = he.sweep("serial", [256, 1024], [1, 2, 4, 8], reps=3,
+    path = he.sweep("serial", [4096, 16384], [1, 2, 4, 8], reps=3,
                     outdir=str(out), resume=True, seed=0)
-    he.verify_pass("serial", [256, 1024], [1, 2, 4, 8], seed=0)
+    he.verify_pass("serial", [4096, 16384], [1, 2, 4, 8], seed=0)
     return path
 
 
@@ -40,7 +43,7 @@ def test_sweep_rows_and_contract(sweep_tsv):
 def test_sweep_resume_skips_done(sweep_tsv):
     he = load_module("harness/run_experiments.py", "run_experiments")
     before = open(sweep_tsv).read()
-    path = he.sweep("serial", [256, 1024], [1, 2, 4, 8], reps=3,
+    path = he.sweep("serial", [4096, 16384], [1, 2, 4, 8], reps=3,
                     outdir=os.path.dirname(sweep_tsv), resume=True, seed=0)
     assert path == sweep_tsv
     assert open(sweep_tsv).read() == before  # nothing re-run

@@ -73,6 +73,46 @@ def test_fft_pallas2_bad_cb():
         fft_pi_layout_pallas2(xr, xi, tile=512, cb=100)
 
 
+@pytest.mark.parametrize("n,tile,cb,tail", [
+    (1 << 14, 1 << 12, 1 << 10, 128),
+    (1 << 14, 1 << 12, 1 << 10, 256),   # 2x2-block MXU tail
+    (1 << 13, 1 << 13, 1 << 13, 512),   # 4x4-block tail, R == 1
+])
+def test_fft_pallas_rql_vs_numpy(n, tile, cb, tail):
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_rql
+
+    xr, xi = rand_planes(n, seed=11)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_rql(xr, xi, tile=tile, cb=cb, tail=tail)
+    nat = pi_layout_to_natural(to_complex(yr, yi))
+    assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
+@pytest.mark.parametrize("n,tile,cb,tail", [(1 << 14, 1 << 12, 1 << 10, 256)])
+def test_fft_pallas2_tail_vs_numpy(n, tile, cb, tail):
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas2
+
+    xr, xi = rand_planes(n, seed=12)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas2(xr, xi, tile=tile, cb=cb, tail=tail)
+    nat = pi_layout_to_natural(to_complex(yr, yi))
+    assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
+def test_fft_pallas_tail_validation():
+    from cs87project_msolano2_tpu.ops.pallas_fft import (
+        fft_pi_layout_pallas_rql,
+        tile_fft_grid,
+    )
+
+    xr, xi = rand_planes(1 << 12, seed=13)
+    with pytest.raises(ValueError):  # tail not a power of two
+        fft_pi_layout_pallas_rql(xr, xi, tile=512, tail=384)
+    with pytest.raises(ValueError):  # tail > tile
+        tile_fft_grid(xr.reshape(-1, 128), xi.reshape(-1, 128), 512,
+                      tail=1024)
+
+
 @pytest.mark.parametrize("p", [1, 4, 64])
 def test_pi_fft_pallas_matches_jnp(p):
     from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
