@@ -70,7 +70,7 @@ def measure_tpu_ms() -> float:
                 return yr * inv_rn, yi * inv_rn
 
             ms = loop_slope_ms(body, (xr, xi), k1=64, k2=1024, reps=5,
-                               min_delta_ms=100.0)
+                               min_delta_ms=100.0, cache=False)
             best = min(best, ms)
         except Exception as e:  # a config failing to compile is not fatal
             print(f"# {impl} tile={tile} cb={cb} tail={tail} failed: "
@@ -120,7 +120,7 @@ def measure_xla_fft_ms():
 
     try:
         raw = loop_slope_ms(body_fft, (xr, xi), k1=64, k2=1024, reps=5,
-                            min_delta_ms=100.0)
+                            min_delta_ms=100.0, cache=False)
     except Exception as e:
         # some backends cannot lower the FFT custom-call inside a While
         # body — statically unroll instead (modest k2: program size and
@@ -129,7 +129,8 @@ def measure_xla_fft_ms():
               "trying unrolled slope", file=sys.stderr)
         try:
             raw = unrolled_slope_ms(body_fft, (xr, xi), k1=8, k2=64,
-                                    reps=7, min_delta_ms=20.0, max_k=256)
+                                    reps=7, min_delta_ms=20.0, max_k=256,
+                                    cache=False)
         except Exception as e2:
             print(f"# xla fft not measurable on this backend "
                   f"({type(e2).__name__}); omitting vs_xla_fft",
@@ -137,7 +138,7 @@ def measure_xla_fft_ms():
             return None
     try:
         epilogue = loop_slope_ms(body_epilogue, (xr, xi), k1=64, k2=1024,
-                                 reps=5, min_delta_ms=40.0)
+                                 reps=5, min_delta_ms=40.0, cache=False)
     except Exception as e:
         print(f"# epilogue not resolvable ({type(e).__name__}); "
               "vs_xla_fft conservatively uncorrected", file=sys.stderr)

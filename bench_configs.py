@@ -52,7 +52,7 @@ def config2_pallas_2e20():
         yr, yi = fft_pi_layout_pallas(c[0], c[1])
         return yr * inv, yi * inv
 
-    ms = loop_slope_ms(body, (xr, xi))
+    ms = loop_slope_ms(body, (xr, xi), cache=False)
     return {"config": "1D FFT N=2^20 complex64 (single-chip Pallas)",
             "ms": round(ms, 4),
             "gflops": round(5 * n * 20 / (ms * 1e-3) / 1e9, 1)}
@@ -76,7 +76,7 @@ def config3_batched():
         yr, yi = fft_batched_planes(c[0], c[1], mesh)
         return yr * inv, yi * inv
 
-    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64)
+    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64, cache=False)
     flops = 5 * b * n * np.log2(n)
     return {"config": f"batched FFT {b}x{n} (DP over {mesh.devices.size} devices)",
             "ms": round(ms, 3),
@@ -101,7 +101,7 @@ def config4_fft2d():
         yr, yi = fft2_sharded_planes(v[0], v[1], mesh)
         return yr * inv, yi * inv
 
-    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64)
+    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64, cache=False)
     flops = 5 * r * c * (np.log2(r) + np.log2(c))
     return {"config": f"2D FFT {r}x{c} ({mesh.devices.size}-device slab)",
             "ms": round(ms, 3),
@@ -122,7 +122,8 @@ def config5_poisson():
     key = jax.random.PRNGKey(4)
     fsrc = jax.random.normal(key, (side, side, side), jnp.float32)
     ms = loop_slope_ms(
-        lambda v: (poisson_solve_sharded(v[0], mesh),), (fsrc,), k1=4, k2=32
+        lambda v: (poisson_solve_sharded(v[0], mesh),), (fsrc,), k1=4, k2=32,
+        cache=False
     )
     return {"config": f"3D Poisson {side}^3 slab solve ({ndev} device(s))",
             "ms": round(ms, 2)}

@@ -230,7 +230,13 @@ class JaxBackend:
                 n, p, self._impl
             )
             try:
-                funnel_ms = loop_slope_ms(funnel_body, (xr, xi), reps=reps)
+                # p == 1: zero funnel iterations (the reference's funnel
+                # loop runs log2(p) times, …pthreads.c:419) — the body is
+                # an empty program that XLA folds away, which the slope
+                # method cannot (and need not) resolve
+                funnel_ms = 0.0 if p == 1 else loop_slope_ms(
+                    funnel_body, (xr, xi), reps=reps
+                )
                 tube_ms = loop_slope_ms(
                     tube_body,
                     (xr.reshape(p, n // p), xi.reshape(p, n // p)),

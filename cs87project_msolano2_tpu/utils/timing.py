@@ -13,6 +13,7 @@ what remains is true device time per iteration."""
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Any, Callable
 
 
@@ -78,7 +79,8 @@ def _timed_fetch(fn: Callable, *args, reps: int) -> float:
 def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
                   k2: int = 64, reps: int = 3,
                   min_delta_ms: float = 40.0, max_k: int = 1 << 22,
-                  max_program_ms: float = 4000.0) -> float:
+                  max_program_ms: float = 4000.0,
+                  cache: bool = True) -> float:
     """True device ms per application of `body`.
 
     `body(pytree) -> pytree` must be shape-closed (output feeds back as
@@ -107,13 +109,15 @@ def loop_slope_ms(body: Callable, args: tuple, k1: int = 8,
         return jax.jit(run)
 
     return _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
-                            max_program_ms, kind="loop")
+                            max_program_ms, kind="loop",
+                            body=body if cache else None)
 
 
 def unrolled_slope_ms(body: Callable, args: tuple, k1: int = 4,
                       k2: int = 32, reps: int = 3,
                       min_delta_ms: float = 40.0, max_k: int = 512,
-                      max_program_ms: float = 4000.0) -> float:
+                      max_program_ms: float = 4000.0,
+                      cache: bool = True) -> float:
     """loop_slope_ms for ops that cannot lower inside a While body on
     this backend: the K applications are STATICALLY UNROLLED into one jit
     program ending in a scalar fetch.  Same slope arithmetic, same
@@ -133,14 +137,49 @@ def unrolled_slope_ms(body: Callable, args: tuple, k1: int = 4,
         return jax.jit(run)
 
     return _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
-                            max_program_ms, kind="unrolled")
+                            max_program_ms, kind="unrolled",
+                            body=body if cache else None)
+
+
+# (kind, body, k) -> jitted program.  Slope calls rebuild closures every
+# time, which defeats jax.jit's own cache — a 10-replication sweep cell
+# would recompile the SAME k-loop program 10 times (~10-30 s each on the
+# relay).  Keyed on the body function object itself: backends hand out
+# lru_cached bodies, so the key is stable across replications.  Bounded
+# LRU: each jitted program pins its executable plus baked-in constants
+# (twiddle tables are O(n log n) — ~100 MB at n=2^20), and a finished
+# cell's entries can never hit again — evict oldest quickly.  16 covers
+# one sweep cell's two phase bodies (~8 programs incl. escalations)
+# with margin while bounding pinned HBM to ~2 cells' worth.
+_PROGRAM_CACHE: OrderedDict = OrderedDict()
+_PROGRAM_CACHE_MAX = 16
 
 
 def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
-                     max_program_ms, kind):
+                     max_program_ms, kind, body=None):
     """Shared slope machinery: `make(k)` builds the jitted K-application
     program; returns (T(k2) - T(k1)) / (k2 - k1) once the delta clears
-    `min_delta_ms`."""
+    `min_delta_ms`.
+
+    `body is None` (callers passing `cache=False`) bypasses the program
+    cache: one-shot callers that rebuild body closures per call would
+    only insert never-hit entries that pin their executables (and baked
+    twiddle constants) until eviction.
+    """
+    if body is not None:
+        raw_make = make
+
+        def make(k):
+            key = (kind, body, k)
+            fn = _PROGRAM_CACHE.get(key)
+            if fn is None:
+                while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+                    _PROGRAM_CACHE.popitem(last=False)
+                fn = _PROGRAM_CACHE[key] = raw_make(k)
+            else:
+                _PROGRAM_CACHE.move_to_end(key)
+            return fn
+
     f1 = make(k1)
     t1 = _timed_fetch(f1, args, reps=reps)
     if t1 > max_program_ms and k1 > 1:

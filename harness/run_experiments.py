@@ -73,6 +73,30 @@ def grid_cells(backend_name: str, ns: list[int], ps: list[int]):
     return backend, [(n, p) for n in ns for p in ps_eff if p <= n]
 
 
+def run_with_retry(backend, x, p, attempts: int = 3, pause_s: float = 20.0,
+                   fetch: bool = False):
+    """backend.run with retries on transient infrastructure errors.
+
+    Remote-accelerator relays drop connections under long sweeps
+    (observed: 'remote_compile: response body closed' mid-sweep, killing
+    hours of remaining grid).  ValueError (cell infeasibility) passes
+    through untouched; anything else is retried after a pause, then
+    re-raised — the append-only TSV keeps completed rows either way.
+    """
+    for attempt in range(attempts):
+        try:
+            return backend.run(x, p, fetch=fetch)
+        except ValueError:
+            raise
+        except Exception as e:
+            if attempt == attempts - 1:
+                raise
+            print(f"# transient backend error ({type(e).__name__}: "
+                  f"{str(e)[:120]}); retry {attempt + 1}/{attempts - 1} "
+                  f"in {pause_s:.0f}s", file=sys.stderr)
+            time.sleep(pause_s)
+
+
 def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
           outdir: str, resume: bool, seed: int) -> str:
     """Timing pass: append TSV rows, NO result fetches (on remote
@@ -93,7 +117,7 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
             x = make_input(n, seed)
             for rep in range(done[(n, p)], reps):
                 try:
-                    res = backend.run(x, p, fetch=False)
+                    res = run_with_retry(backend, x, p)
                 except ValueError as e:
                     # per-(n, p) infeasibility (e.g. einsum's p*n cap) is
                     # a property of the cell, not an error of the sweep
@@ -126,7 +150,7 @@ def verify_pass(backend_name: str, ns: list[int], ps: list[int],
         x = make_input(n, seed)
         ref = np.fft.fft(x.astype(np.complex128))
         try:
-            res = backend.run(x, p)
+            res = run_with_retry(backend, x, p, fetch=True)
         except ValueError as e:
             print(f"# {backend_name} n={n} p={p} verify skipped: {e}",
                   file=sys.stderr)
