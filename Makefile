@@ -4,7 +4,8 @@
 # native core's own Makefile.
 
 .PHONY: all clean recompile test bench replicate \
-        run-experiments run-experiments-and-analyze-results analyze
+        run-experiments run-experiments-and-analyze-results analyze \
+        analyze-datasets
 
 all:
 	$(MAKE) -C cs87project_msolano2_tpu/native all
@@ -23,6 +24,15 @@ run-experiments: all
 
 analyze:
 	./analysis/analyze-results results/fourier-parallel-pi-*-results.tsv
+
+# regenerate the COMMITTED datasets' analysis artifacts (D2 parity:
+# law-fit log + per-n figures) from the committed TSVs
+analyze-datasets:
+	set -o pipefail; \
+	python3 analysis/analyze_results.py datasets/fourier-parallel-pi-*-results.tsv \
+	  --plots datasets | tee datasets/pifft-sweep-results-analysis.out
+	python3 analysis/analyze_results_full.py datasets/fourier-parallel-pi-*-results.tsv \
+	  --out datasets
 
 run-experiments-and-analyze-results: run-experiments analyze
 

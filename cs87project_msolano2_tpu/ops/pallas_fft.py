@@ -455,6 +455,32 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
     return yr.reshape(n), yi.reshape(n)
 
 
+def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret):
+    """Row-gridded tile kernel on the shared (R, Q, LANE) layout: each of
+    the R grid programs finishes one tile-point DIF (shared by the rql
+    and matmul-funnel whole-FFT paths)."""
+    from jax.experimental import pallas as pl
+
+    R, Q, _ = x3r.shape
+    steps, np_tables = _tile_plan(tile, tail)
+    tables = [jnp.asarray(t) for t in np_tables]
+    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
+    in_specs = [pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2
+    in_specs += [pl.BlockSpec(t.shape, lambda j: (0, 0)) for t in tables]
+    in_specs += [pl.BlockSpec((tail, tail), lambda j: (0, 0))] * 2
+    return pl.pallas_call(
+        partial(_tile_fft_kernel, steps, precision),
+        grid=(R,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x3r, x3i, *tables, btr, bti)
+
+
 def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
                              cb: int | None = None, interpret=None,
                              precision=None, tail: int = LANE):
@@ -511,23 +537,116 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
 
     if precision is None:
         precision = jax.lax.Precision.HIGHEST
-    steps, np_tables = _tile_plan(tile, tail)
-    tables = [jnp.asarray(t) for t in np_tables]
-    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
-    in_specs = [pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2
-    in_specs += [pl.BlockSpec(t.shape, lambda j: (0, 0)) for t in tables]
-    in_specs += [pl.BlockSpec((tail, tail), lambda j: (0, 0))] * 2
-    yr, yi = pl.pallas_call(
-        partial(_tile_fft_kernel, steps, precision),
-        grid=(R,),
+    yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
+    return yr.reshape(n), yi.reshape(n)
+
+
+@lru_cache(maxsize=8)
+def dft_funnel_matrices(R: int, n: int):
+    """Four-step funnel factors: the first log2(R) DIF stages of an
+    n-point transform viewed as (R, C = n/R) are ONE R-point DFT matrix
+    across rows followed by an elementwise twiddle grid —
+        out[r, c] = T[r, c] * sum_r' B[r, r'] x[r', c],
+        B[r, r'] = W_R^{bitrev(r) r'},   T[r, c] = W_n^{bitrev(r) c}
+    (verified to 4e-15 against the stage-by-stage DIF).  With R = 128
+    the row transform is a perfect MXU shape: the long-range pass
+    becomes matmul work instead of log2(R) VPU traversals.
+    Returns (Br, Bi, Tr, Ti) float32; B is (R, R), T is (R, n/R).
+    """
+    C = n // R
+    rev = bit_reverse_indices(R).astype(np.float64)
+    rp = np.arange(R, dtype=np.float64)
+    b = np.exp(-2j * np.pi * np.outer(rev, rp) / R)
+    c = np.arange(C, dtype=np.float64)
+    t = np.exp(-2j * np.pi * np.outer(rev, c) / n)
+    return (
+        b.real.astype(np.float32), b.imag.astype(np.float32),
+        t.real.astype(np.float32), t.imag.astype(np.float32),
+    )
+
+
+def _matmul_funnel_kernel(precision, *refs):
+    """Pallas kernel body: Y = (B @ X) * T on one (R, qb, LANE) column
+    block — four real MXU matmuls for the complex row transform, then
+    the elementwise complex twiddle."""
+    xr_ref, xi_ref, br_ref, bi_ref, tr_ref, ti_ref, or_ref, oi_ref = refs
+    xr = xr_ref[...]
+    xi = xi_ref[...]
+    R = xr.shape[0]
+    rest = xr.shape[1:]
+    xr2 = xr.reshape(R, -1)
+    xi2 = xi.reshape(R, -1)
+    br = br_ref[...]
+    bi = bi_ref[...]
+    dot = partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+    yr = dot(br, xr2) - dot(bi, xi2)
+    yi = dot(br, xi2) + dot(bi, xr2)
+    tr = tr_ref[...].reshape(R, -1)
+    ti = ti_ref[...].reshape(R, -1)
+    zr = yr * tr - yi * ti
+    zi = yr * ti + yi * tr
+    or_ref[...] = zr.reshape(R, *rest)
+    oi_ref[...] = zi.reshape(R, *rest)
+
+
+def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
+                            interpret=None, precision=None,
+                            tail: int = LANE):
+    """Two-kernel whole-FFT with a MATMUL funnel: the first log2(R)
+    stages run as one R-point DFT matmul + twiddle grid (MXU work, one
+    HBM pass — see dft_funnel_matrices) on the shared (R, Q, LANE)
+    layout, then the tile kernel finishes each C-point row.  R = 128
+    both feeds the MXU a native shape and shrinks the tile kernel's
+    VPU stage count versus the butterfly long-range pass (R = 16 at
+    n = 2^20)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = _use_interpret()
+    if precision is None:
+        precision = jax.lax.Precision.HIGHEST
+    n = xr.shape[-1]
+    if R < 2 or R & (R - 1) or n % R or (n // R) % LANE:
+        raise ValueError(
+            f"R={R} must be a power of two dividing n={n} with "
+            f"n/R a multiple of {LANE}"
+        )
+    tile = n // R  # the tile kernel finishes whole rows
+    if cb is None:
+        cb = min(tile, 1 << 13)
+    if cb % LANE or tile % cb:
+        raise ValueError(f"cb={cb} must divide C={tile} and be a "
+                         f"multiple of {LANE}")
+    _check_tail(tail, tile)
+    Q = tile // LANE
+    qb = cb // LANE
+    br, bi, tr, ti = (jnp.asarray(t) for t in dft_funnel_matrices(R, n))
+    t3r = tr.reshape(R, Q, LANE)
+    t3i = ti.reshape(R, Q, LANE)
+    x3r = xr.reshape(R, Q, LANE)
+    x3i = xi.reshape(R, Q, LANE)
+
+    in_specs = [pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2
+    in_specs += [pl.BlockSpec((R, R), lambda i: (0, 0))] * 2
+    in_specs += [pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2
+    x3r, x3i = pl.pallas_call(
+        partial(_matmul_funnel_kernel, precision),
+        grid=(Q // qb,),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2,
+        out_specs=[pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2,
         out_shape=[
             jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
             jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
         ],
         interpret=interpret,
-    )(x3r, x3i, *tables, btr, bti)
+    )(x3r, x3i, br, bi, t3r, t3i)
+
+    yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
 
 

@@ -154,6 +154,15 @@ def unrolled_slope_ms(body: Callable, args: tuple, k1: int = 4,
 _PROGRAM_CACHE: OrderedDict = OrderedDict()
 _PROGRAM_CACHE_MAX = 16
 
+# (kind, body) -> (k1, k2) window that resolved the slope last time.
+# For fast ops the escalation ladder (quadrupling k2 until the delta
+# clears the noise floor, re-measuring both endpoints each step) costs
+# tens of fetches; replications of the same cell re-ran it from scratch
+# every time (~45 s/rep observed on the einsum sweep).  Starting from
+# the proven window cuts a replication to one t1 + one t2 measurement.
+_WINDOW_CACHE: OrderedDict = OrderedDict()
+_WINDOW_CACHE_MAX = 64
+
 
 def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
                      max_program_ms, kind, body=None):
@@ -180,6 +189,10 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
                 _PROGRAM_CACHE.move_to_end(key)
             return fn
 
+        window = _WINDOW_CACHE.get((kind, body))
+        if window is not None:
+            k1, k2 = window
+
     f1 = make(k1)
     t1 = _timed_fetch(f1, args, reps=reps)
     if t1 > max_program_ms and k1 > 1:
@@ -195,6 +208,10 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
     while True:
         t2 = _timed_fetch(make(k2), args, reps=reps)
         if t2 - t1 >= min_delta_ms:
+            if body is not None:
+                while len(_WINDOW_CACHE) >= _WINDOW_CACHE_MAX:
+                    _WINDOW_CACHE.popitem(last=False)
+                _WINDOW_CACHE[(kind, body)] = (k1, k2)
             return (t2 - t1) / (k2 - k1)
         if k2 >= max_k:
             raise LoopSlopeUnresolved(

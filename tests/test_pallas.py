@@ -88,6 +88,24 @@ def test_fft_pallas_rql_vs_numpy(n, tile, cb, tail):
     assert rel_err(nat, np.fft.fft(x)) < 1e-5
 
 
+@pytest.mark.parametrize("n,R,cb,tail", [
+    (1 << 14, 128, 1 << 7, 128),
+    (1 << 15, 128, 1 << 8, 256),   # matmul funnel + 2x2-block MXU tail
+    (1 << 14, 16, 1 << 10, 128),   # non-MXU R still correct
+])
+def test_fft_pallas_mf_vs_numpy(n, R, cb, tail):
+    """Four-step matmul funnel (B @ X) * T — algebra verified against
+    the stage-by-stage DIF to 4e-15 in dft_funnel_matrices' derivation;
+    this checks the composed Pallas path end-to-end vs numpy."""
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_mf
+
+    xr, xi = rand_planes(n, seed=13)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_mf(xr, xi, R=R, cb=cb, tail=tail)
+    nat = pi_layout_to_natural(to_complex(yr, yi))
+    assert rel_err(nat, np.fft.fft(x)) < 1e-5
+
+
 @pytest.mark.parametrize("n,tile,cb,tail", [(1 << 14, 1 << 12, 1 << 10, 256)])
 def test_fft_pallas2_tail_vs_numpy(n, tile, cb, tail):
     from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas2
