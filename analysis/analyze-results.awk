@@ -5,12 +5,16 @@
 # complexity law, a t-statistic for the slope, and a normal-tail
 # significance approximation).
 #
-# Law model selection mirrors analyze_results.py::model_for: filenames of
-# single-accelerator backends (-jax-/-pallas-/-einsum-) get the on-chip
-# law (funnel n(p-1), tube n*log2(n/p) — all p virtual processors on one
-# chip, time tracks total work); everything else the reference's
-# per-processor law.  Rows marked DEGRADED (6th column: dispatch-inclusive
-# fallback timing) are excluded, as in the python analysis.
+# Law model selection mirrors analyze_results.py::model_for: the einsum
+# backend (-einsum-) gets the einsum-dense law (funnel n(p-1), tube
+# n^2/p — dense contractions), other single-accelerator backends
+# (-jax-/-pallas-) the on-chip law (funnel n(p-1), tube n*log2(n/p) —
+# all p virtual processors on one chip, time tracks total work), and
+# everything else the reference's per-processor law.  Rows marked
+# DEGRADED (6th column: dispatch-inclusive fallback timing) are
+# excluded, as in the python analysis.  Only the TOTAL time is fitted
+# here; the python analysis's per-phase fits (and its negligible-phase
+# "untestable" rule) have no awk counterpart.
 #
 # Input: 5- or 6-column TSV  n  p  total_ms  funnel_ms  tube_ms  [DEGRADED]
 # Usage: awk -f analyze-results.awk results.tsv
@@ -21,6 +25,8 @@ function log2(v) { return log(v) / log(2) }
 function law(n, p,    s, lg) {
     s = n / p
     lg = (s > 1) ? log2(s) : 0
+    if (model == "einsum-dense")
+        return n * (p - 1) + n * n / p
     if (model == "on-chip")
         return n * (p - 1) + n * lg
     return n * (p - 1) / p + s * lg
@@ -38,7 +44,8 @@ function normal_sf(z,    t, y) {
 FNR == 1 {
     base = FILENAME
     sub(/.*\//, "", base)      # basename, mirroring model_for()
-    newmodel = (base ~ /-(jax|pallas|einsum)-/) ? "on-chip" : "per-processor"
+    newmodel = (base ~ /-einsum-/) ? "einsum-dense" : \
+               (base ~ /-(jax|pallas)-/) ? "on-chip" : "per-processor"
     if (model != "" && newmodel != model) mixed = 1
     model = newmodel
 }

@@ -37,16 +37,23 @@ def measure_tpu_ms() -> float:
 
     from cs87project_msolano2_tpu.ops.pallas_fft import (
         fft_pi_layout_pallas2,
+        fft_pi_layout_pallas_mf,
         fft_pi_layout_pallas_rql,
     )
     from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
 
-    # (impl, tile, cb, tail): rql = the retiling-free (R, Q, 128)
-    # composed path; tail=256 moves one VPU stage traversal onto the
-    # (otherwise idle) MXU as a 2x2-blocked 256-point DIF matmul —
-    # fastest measured: ~0.092 ms at tile=2^16 cb=2^12..13 (~1100 GF),
-    # rel_err 2.2e-07 vs numpy (tail=512 tips the MXU out of hiding)
+    # (impl, tile_or_R, cb, tail): rql = the retiling-free (R, Q, 128)
+    # composed path (tile_or_R = tile); mf = the four-step matmul funnel
+    # (tile_or_R = R — the first log2(R) stages as one R-point DFT
+    # matmul on the MXU, see ops/pallas_fft.py::dft_funnel_matrices).
+    # tail=256 moves one VPU stage traversal onto the (otherwise idle)
+    # MXU as a 2x2-blocked 256-point DIF matmul.  rql fastest measured:
+    # ~0.092 ms at tile=2^16 cb=2^12..13 (~1100 GF), rel_err 2.2e-07
+    # vs numpy (tail=512 tips the MXU out of hiding)
     configs = (
+        ("mf", 128, 1 << 13, 256),
+        ("mf", 128, 1 << 12, 256),
+        ("mf", 256, 1 << 12, 256),
         ("rql", 1 << 16, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 12, 256),
         ("rql", 1 << 16, 1 << 13, 128),
@@ -62,7 +69,10 @@ def measure_tpu_ms() -> float:
     for impl, tile, cb, tail in configs:
         try:
             def body(c, impl=impl, t=tile, cb=cb, tail=tail):
-                if impl == "rql":
+                if impl == "mf":
+                    yr, yi = fft_pi_layout_pallas_mf(
+                        c[0], c[1], R=t, cb=cb, tail=tail)
+                elif impl == "rql":
                     yr, yi = fft_pi_layout_pallas_rql(
                         c[0], c[1], tile=t, cb=cb, tail=tail)
                 else:

@@ -169,6 +169,11 @@ def _loop_bodies(n: int, p: int, impl: str):
 
 _warned_large_p: set[tuple[int, int]] = set()
 
+# Largest einsum-tube segment the relay can run as ONE program: s=2^14
+# measured safe (~2 GB twiddle-gather traffic/application); s=2^15 is
+# borderline and s=2^16 crashes the TPU worker (see run()).
+EINSUM_TUBE_MAX_S = 1 << 14
+
 
 class JaxBackend:
     def __init__(self, impl: str = "jnp"):
@@ -189,6 +194,22 @@ class JaxBackend:
 
         x = check_run_args(x, p)
         n = x.shape[-1]
+        if (self._impl == "einsum" and needs_loop_slope()
+                and n // p > EINSUM_TUBE_MAX_S):
+            # The einsum tube is a dense per-segment DFT: Theta(s^2)
+            # work AND s^2 on-the-fly twiddle-gather traffic per
+            # application (~34 GB at s=2^16).  One application at
+            # s >= 2^15 exceeds the relay's ~10 s single-program budget
+            # and CRASHES the TPU worker (observed; >1 min restart), so
+            # this is a capacity limit of the accelerator path, not a
+            # timing-window problem — the reference's harness clips
+            # infeasible configs the same way (probe-and-clip,
+            # run-experiments:42-50).
+            raise ValueError(
+                f"einsum tube segment s={n // p} exceeds the relay's "
+                f"single-program budget (max s={EINSUM_TUBE_MAX_S}); "
+                "use a larger p or the jax/pallas backends"
+            )
         if p >= 32 and (n, p) not in _warned_large_p:
             # single-chip backends materialize ALL p virtual processors,
             # so the funnel's redundant work is n(p-1) — at large p it
@@ -229,6 +250,15 @@ class JaxBackend:
             funnel_body, tube_body, full_body = _loop_bodies(
                 n, p, self._impl
             )
+            # The einsum tube does Theta(s^2) work per application; at
+            # the capacity limit (s = EINSUM_TUBE_MAX_S, guarded above)
+            # the default k1=8 first measurement program is ~8 x ~1 s —
+            # within budget but with no headroom, so start the einsum
+            # tube at a (1, 4) window; the escalation ladder still grows
+            # it if the delta doesn't resolve.
+            tube_kw = {}
+            if self._impl == "einsum" and n // p >= 1 << 13:
+                tube_kw = dict(k1=1, k2=4)
             try:
                 # p == 1: zero funnel iterations (the reference's funnel
                 # loop runs log2(p) times, …pthreads.c:419) — the body is
@@ -241,6 +271,7 @@ class JaxBackend:
                     tube_body,
                     (xr.reshape(p, n // p), xi.reshape(p, n // p)),
                     reps=reps,
+                    **tube_kw,
                 )
             except LoopSlopeUnresolved as e:
                 # tiny transforms sit below the relay's noise floor at any

@@ -1,5 +1,11 @@
-"""Round-3 perf experiments, part 10: composed rql with the 256-point
-MXU tail (one fewer VPU traversal) x cb tuning, plus accuracy check."""
+"""Round-3 perf experiments, part 11: four-step matmul funnel (mf) vs
+the rql composed path at N=2^20 — R sweep x cb tuning, plus accuracy.
+
+mf runs the first log2(R) stages as one R-point DFT matmul + twiddle
+grid (ops/pallas_fft.py::dft_funnel_matrices); larger R moves more
+levels onto the MXU and shrinks the tile kernel's VPU stage count, at
+R^2-growing matmul flops.  The expected sweet spot is R in {128, 256}.
+"""
 
 import sys
 
@@ -8,7 +14,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_rql
+from cs87project_msolano2_tpu.ops.pallas_fft import (
+    fft_pi_layout_pallas_mf,
+    fft_pi_layout_pallas_rql,
+)
 from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
 
 N = 1 << 20
@@ -30,13 +39,17 @@ def main():
                                           tail=tail)
         return yr * inv, yi * inv
 
+    def mf(c, R, cb, tail):
+        yr, yi = fft_pi_layout_pallas_mf(c[0], c[1], R=R, cb=cb, tail=tail)
+        return yr * inv, yi * inv
+
     cases = [
-        ("t16 cb13 tail128", lambda c: rql(c, 1 << 16, 1 << 13, 128)),
-        ("t16 cb13 tail256", lambda c: rql(c, 1 << 16, 1 << 13, 256)),
-        ("t16 cb11 tail256", lambda c: rql(c, 1 << 16, 1 << 11, 256)),
-        ("t16 cb12 tail256", lambda c: rql(c, 1 << 16, 1 << 12, 256)),
-        ("t15 cb13 tail256", lambda c: rql(c, 1 << 15, 1 << 13, 256)),
-        ("t16 cb13 tail512", lambda c: rql(c, 1 << 16, 1 << 13, 512)),
+        ("rql t16 cb13 tail256", lambda c: rql(c, 1 << 16, 1 << 13, 256)),
+        ("mf R128 cb13 tail256", lambda c: mf(c, 128, 1 << 13, 256)),
+        ("mf R128 cb12 tail256", lambda c: mf(c, 128, 1 << 12, 256)),
+        ("mf R256 cb12 tail256", lambda c: mf(c, 256, 1 << 12, 256)),
+        ("mf R256 cb12 tail512", lambda c: mf(c, 256, 1 << 12, 512)),
+        ("mf R64  cb13 tail256", lambda c: mf(c, 64, 1 << 13, 256)),
     ]
     for rnd in range(3):
         for name, body in cases:
@@ -46,7 +59,8 @@ def main():
                 print(f"[{rnd}] {name}: {ms:.4f} ms  ({gf(ms):.0f} GF)",
                       flush=True)
             except Exception as e:
-                print(f"[{rnd}] {name}: FAILED {type(e).__name__}", flush=True)
+                print(f"[{rnd}] {name}: FAILED {type(e).__name__}: "
+                      f"{str(e)[:100]}", flush=True)
 
     # accuracy at bench shape (fetches — last)
     rng = np.random.default_rng(0)
@@ -56,14 +70,14 @@ def main():
     from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
     idx = bit_reverse_indices(N)
     scale = np.max(np.abs(ref))
-    for tail in (128, 256, 512):
+    for R in (128, 256):
         yr, yi = jax.jit(
-            lambda a, b, t=tail: fft_pi_layout_pallas_rql(
-                a, b, tile=1 << 16, cb=1 << 13, tail=t)
+            lambda a, b, r=R: fft_pi_layout_pallas_mf(
+                a, b, R=r, cb=1 << 12, tail=256)
         )(hxr, hxi)
         y = np.asarray(yr).astype(np.complex128) + 1j * np.asarray(yi)
         err = np.max(np.abs(y[idx] - ref)) / scale
-        print(f"tail={tail}: rel_err {err:.2e}", flush=True)
+        print(f"mf R={R}: rel_err {err:.2e}", flush=True)
     return 0
 
 

@@ -73,15 +73,18 @@ def grid_cells(backend_name: str, ns: list[int], ps: list[int]):
     return backend, [(n, p) for n in ns for p in ps_eff if p <= n]
 
 
-def run_with_retry(backend, x, p, attempts: int = 3, pause_s: float = 20.0,
+def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
                    fetch: bool = False):
     """backend.run with retries on transient infrastructure errors.
 
     Remote-accelerator relays drop connections under long sweeps
     (observed: 'remote_compile: response body closed' mid-sweep, killing
-    hours of remaining grid).  ValueError (cell infeasibility) passes
-    through untouched; anything else is retried after a pause, then
-    re-raised — the append-only TSV keeps completed rows either way.
+    hours of remaining grid), and a crashed TPU worker process takes
+    over a minute to come back (observed: UNAVAILABLE for >60 s after a
+    worker kill) — hence exponential backoff (30, 60, 120 s).
+    ValueError (cell infeasibility) passes through untouched; anything
+    else is retried, then re-raised — the append-only TSV keeps
+    completed rows either way.
     """
     for attempt in range(attempts):
         try:
@@ -91,10 +94,11 @@ def run_with_retry(backend, x, p, attempts: int = 3, pause_s: float = 20.0,
         except Exception as e:
             if attempt == attempts - 1:
                 raise
+            pause = pause_s * (2 ** attempt)
             print(f"# transient backend error ({type(e).__name__}: "
                   f"{str(e)[:120]}); retry {attempt + 1}/{attempts - 1} "
-                  f"in {pause_s:.0f}s", file=sys.stderr)
-            time.sleep(pause_s)
+                  f"in {pause:.0f}s", file=sys.stderr)
+            time.sleep(pause)
 
 
 def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,

