@@ -27,7 +27,7 @@ function law(n, p,    s, lg) {
     lg = (s > 1) ? log2(s) : 0
     if (model == "einsum-dense")
         return n * (p - 1) + n * n / p
-    if (model == "on-chip")
+    if (model == "on-chip" || model == "serialized")
         return n * (p - 1) + n * lg
     return n * (p - 1) / p + s * lg
 }
@@ -45,7 +45,8 @@ FNR == 1 {
     base = FILENAME
     sub(/.*\//, "", base)      # basename, mirroring model_for()
     newmodel = (base ~ /-einsum-/) ? "einsum-dense" : \
-               (base ~ /-(jax|pallas)-/) ? "on-chip" : "per-processor"
+               (base ~ /-(jax|pallas)-/) ? "on-chip" : \
+               (base ~ /-serial-/) ? "serialized" : "per-processor"
     if (model != "" && newmodel != model) mixed = 1
     model = newmodel
 }

@@ -81,8 +81,13 @@ def load_tsv(path: str) -> tuple[np.ndarray, int]:
 #    p=1, where the funnel is empty); the tube is a dense s-point DFT
 #    matrix per segment, Theta(p*s^2) = n^2/p.  Fitting the butterfly
 #    law to a dense implementation would test the wrong hypothesis.
-MODELS = ("per-processor", "on-chip", "einsum-dense")
+#  * serialized (CPU backends running all p virtual processors on fewer
+#    real cores — the `serial` backend by construction, `pthreads` when
+#    the host exposes 1 core, as this container does): wall time is the
+#    SUM over processors, i.e. the same total-work laws as on-chip.
+MODELS = ("per-processor", "on-chip", "einsum-dense", "serialized")
 ON_CHIP_BACKENDS = ("jax", "pallas")
+SERIALIZED_BACKENDS = ("serial",)
 
 
 def model_for(path: str, requested: str = "auto") -> str:
@@ -93,6 +98,8 @@ def model_for(path: str, requested: str = "auto") -> str:
         return "einsum-dense"
     if any(f"-{b}-" in base for b in ON_CHIP_BACKENDS):
         return "on-chip"
+    if any(f"-{b}-" in base for b in SERIALIZED_BACKENDS):
+        return "serialized"
     return "per-processor"
 
 
@@ -100,7 +107,7 @@ def laws(n: np.ndarray, p: np.ndarray,
          model: str = "per-processor") -> tuple[np.ndarray, np.ndarray]:
     s = n / p
     log_s = np.where(s > 1, np.log2(np.maximum(s, 2)), 0.0)
-    if model == "on-chip":
+    if model in ("on-chip", "serialized"):
         return n * (p - 1), n * log_s
     if model == "einsum-dense":
         return n * (p - 1), n * n / p

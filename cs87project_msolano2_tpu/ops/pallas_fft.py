@@ -103,25 +103,11 @@ def _tile_plan(tile: int, tail: int = LANE):
     return tuple(steps), tables
 
 
-def _tile_fft_kernel(steps, precision, *refs):
-    """Pallas kernel body: full DIF FFT of one (tile/128, 128) block.
-
-    refs = (xr, xi, <per-step tables>, btr, bti, or_, oi) block refs;
-    `steps` is the mixed-radix plan from _tile_plan (radix-4 stages fuse
-    two DIF levels per VMEM traversal, a -i rotation riding free as a
-    re/im swap; see _tile_plan).
-    """
-    ntab = sum(6 if kind == "r4" else 2 for kind, _ in steps)
-    xr_ref, xi_ref = refs[0], refs[1]
-    tw = refs[2 : 2 + ntab]
-    btr_ref, bti_ref = refs[2 + ntab], refs[3 + ntab]
-    or_ref, oi_ref = refs[4 + ntab], refs[5 + ntab]
-
-    xr = xr_ref[...]
-    xi = xi_ref[...]
-    if xr.ndim == 3:  # (1, Q, L) block from the 3-D composed layout
-        xr = xr.reshape(xr.shape[1], xr.shape[2])
-        xi = xi.reshape(xi.shape[1], xi.shape[2])
+def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
+    """The tile-point DIF on in-VMEM (rows, LANE) planes: the mixed-radix
+    elementwise stages from `steps` followed by the dense MXU tail.
+    Shared by the gridded tile kernel and the fused single-pass kernel.
+    Returns (yr, yi) shaped (rows, LANE)."""
     rows = xr.shape[0]
 
     # elementwise DIF stages while half >= one lane row
@@ -175,8 +161,6 @@ def _tile_fft_kernel(steps, precision, *refs):
     # (LANE, LANE) tiles, and accumulate Y_s = sum_i X_i @ Bt[i, s] —
     # S^2 complex block-matmuls that trade MXU flops for one fewer VPU
     # traversal per tail doubling.
-    btr = btr_ref[:, :]
-    bti = bti_ref[:, :]
     dot = partial(
         jax.lax.dot_general,
         dimension_numbers=(((1,), (0,)), ((), ())),
@@ -205,6 +189,32 @@ def _tile_fft_kernel(steps, precision, *refs):
             yi_parts.append(acci)
         yr = jnp.stack(yr_parts, axis=1).reshape(rows, LANE)
         yi = jnp.stack(yi_parts, axis=1).reshape(rows, LANE)
+    return yr, yi
+
+
+def _tile_fft_kernel(steps, precision, *refs):
+    """Pallas kernel body: full DIF FFT of one (tile/128, 128) block.
+
+    refs = (xr, xi, <per-step tables>, btr, bti, or_, oi) block refs;
+    `steps` is the mixed-radix plan from _tile_plan (radix-4 stages fuse
+    two DIF levels per VMEM traversal, a -i rotation riding free as a
+    re/im swap; see _tile_plan).  The math lives in _tile_fft_compute.
+    """
+    ntab = sum(6 if kind == "r4" else 2 for kind, _ in steps)
+    xr_ref, xi_ref = refs[0], refs[1]
+    tw = refs[2 : 2 + ntab]
+    btr_ref, bti_ref = refs[2 + ntab], refs[3 + ntab]
+    or_ref, oi_ref = refs[4 + ntab], refs[5 + ntab]
+
+    xr = xr_ref[...]
+    xi = xi_ref[...]
+    if xr.ndim == 3:  # (1, Q, L) block from the 3-D composed layout
+        xr = xr.reshape(xr.shape[1], xr.shape[2])
+        xi = xi.reshape(xi.shape[1], xi.shape[2])
+
+    yr, yi = _tile_fft_compute(
+        xr, xi, steps, tw, btr_ref[:, :], bti_ref[:, :], precision
+    )
     or_ref[...] = yr.reshape(or_ref.shape)
     oi_ref[...] = yi.reshape(oi_ref.shape)
 

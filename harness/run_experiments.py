@@ -63,9 +63,20 @@ def done_counts(path: str) -> Counter:
     return done
 
 
-def grid_cells(backend_name: str, ns: list[int], ps: list[int]):
+def grid_cells(backend_name: str, ns: list[int], ps: list[int],
+               oversubscribe: bool = False):
     backend = get_backend(backend_name)
     cap = backend.capacity()
+    if oversubscribe and cap is not None:
+        # Deliberately run more virtual processors than real cores (the
+        # reference's probe-and-clip would refuse): on an undersized host
+        # wall time then tracks the SUM of per-processor work — the
+        # `serialized` law model in analysis/analyze_results.py — which
+        # still verifies the funnel/tube complexity, just not speedup.
+        print(f"# {backend_name}: capacity {cap} OVERSUBSCRIBED — p-grid "
+              f"kept at {ps}; analyze with --model serialized",
+              file=sys.stderr)
+        cap = None
     ps_eff = [p for p in ps if cap is None or p <= cap]
     if len(ps_eff) < len(ps):
         print(f"# {backend_name}: capacity {cap} clips p-grid to {ps_eff}",
@@ -102,13 +113,14 @@ def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
 
 
 def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
-          outdir: str, resume: bool, seed: int) -> str:
+          outdir: str, resume: bool, seed: int,
+          oversubscribe: bool = False) -> str:
     """Timing pass: append TSV rows, NO result fetches (on remote
     accelerators the first device->host transfer permanently inflates
     per-dispatch latency — see Backend.run; verification is a separate
     pass that runs after ALL timing)."""
     os.makedirs(outdir, exist_ok=True)
-    backend, cells = grid_cells(backend_name, ns, ps)
+    backend, cells = grid_cells(backend_name, ns, ps, oversubscribe)
     path = result_path(outdir, backend_name)
     done = done_counts(path) if resume else Counter()
 
@@ -146,9 +158,9 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
 
 
 def verify_pass(backend_name: str, ns: list[int], ps: list[int],
-                seed: int) -> None:
+                seed: int, oversubscribe: bool = False) -> None:
     """Correctness pass: one fetched run per cell, checked against numpy."""
-    backend, cells = grid_cells(backend_name, ns, ps)
+    backend, cells = grid_cells(backend_name, ns, ps, oversubscribe)
     skipped = 0
     for n, p in cells:
         x = make_input(n, seed)
@@ -184,6 +196,9 @@ def main(argv=None) -> int:
     ap.add_argument("--verify", action="store_true",
                     help="check every config against numpy's FFT")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--oversubscribe", action="store_true",
+                    help="run p > capacity anyway (serialized-law regime; "
+                         "see grid_cells)")
     args = ap.parse_args(argv)
 
     ns = parse_grid(args.n_grid)
@@ -192,11 +207,11 @@ def main(argv=None) -> int:
     # ALL timing before ANY verification fetch (see sweep docstring)
     for b in backends:
         path = sweep(b, ns, ps, args.reps, args.out,
-                     not args.no_resume, args.seed)
+                     not args.no_resume, args.seed, args.oversubscribe)
         print(path)
     if args.verify:
         for b in backends:
-            verify_pass(b, ns, ps, args.seed)
+            verify_pass(b, ns, ps, args.seed, args.oversubscribe)
     return 0
 
 
