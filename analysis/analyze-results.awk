@@ -44,7 +44,9 @@ function normal_sf(z,    t, y) {
 FNR == 1 {
     base = FILENAME
     sub(/.*\//, "", base)      # basename, mirroring model_for()
-    newmodel = (base ~ /-einsum-/) ? "einsum-dense" : \
+    newmodel = (force_model != "") ? force_model : \
+               (base ~ /-oversub-/) ? "serialized" : \
+               (base ~ /-einsum-/) ? "einsum-dense" : \
                (base ~ /-(jax|pallas)-/) ? "on-chip" : \
                (base ~ /-serial-/) ? "serialized" : "per-processor"
     if (model != "" && newmodel != model) mixed = 1

@@ -43,17 +43,21 @@ def measure_tpu_ms() -> float:
     from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
 
     # (impl, tile_or_R, cb, tail): rql = the retiling-free (R, Q, 128)
-    # composed path (tile_or_R = tile); mf = the four-step matmul funnel
-    # (tile_or_R = R — the first log2(R) stages as one R-point DFT
-    # matmul on the MXU, see ops/pallas_fft.py::dft_funnel_matrices).
-    # tail=256 moves one VPU stage traversal onto the (otherwise idle)
-    # MXU as a 2x2-blocked 256-point DIF matmul.  rql fastest measured:
-    # ~0.092 ms at tile=2^16 cb=2^12..13 (~1100 GF), rel_err 2.2e-07
-    # vs numpy (tail=512 tips the MXU out of hiding)
+    # composed path (tile_or_R = tile).  tail=256 moves one VPU stage
+    # traversal onto the (otherwise idle) MXU as a 2x2-blocked 256-point
+    # DIF matmul.  rql fastest measured: ~0.092 ms at tile=2^16
+    # cb=2^12..13 (~1100 GF), rel_err 2.2e-07 vs numpy (tail=512 tips
+    # the MXU out of hiding).
+    #
+    # The matmul-funnel path (fft_pi_layout_pallas_mf) is NOT in the
+    # config list: round 3's mf configs OOM'd scoped VMEM on hardware
+    # (24.12M vs the 16M limit); round 4 fixed it with the separable
+    # A/B2 twiddle factorization (dft_funnel_factors) and a VMEM guard,
+    # but the surviving lowerable shape (R=128, cb=1024 — Mosaic stack
+    # intermediates force 1 MB blocks) measures 0.149 ms / 706 GF vs
+    # rql's 0.103 ms / 1017 GF at N=2^20: correct and supported (tests/
+    # test_pallas.py), just not the headline.
     configs = (
-        ("mf", 128, 1 << 13, 256),
-        ("mf", 128, 1 << 12, 256),
-        ("mf", 256, 1 << 12, 256),
         ("rql", 1 << 16, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 12, 256),
         ("rql", 1 << 16, 1 << 13, 128),

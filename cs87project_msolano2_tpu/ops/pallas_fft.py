@@ -565,21 +565,65 @@ def dft_funnel_matrices(R: int, n: int):
     """
     C = n // R
     rev = bit_reverse_indices(R).astype(np.float64)
-    rp = np.arange(R, dtype=np.float64)
-    b = np.exp(-2j * np.pi * np.outer(rev, rp) / R)
+    br, bi = dft_funnel_b(R)
     c = np.arange(C, dtype=np.float64)
     t = np.exp(-2j * np.pi * np.outer(rev, c) / n)
+    return br, bi, t.real.astype(np.float32), t.imag.astype(np.float32)
+
+
+@lru_cache(maxsize=8)
+def dft_funnel_b(R: int) -> tuple[np.ndarray, np.ndarray]:
+    """The (R, R) bit-reversed DFT matrix B[r, r'] = W_R^{bitrev(r) r'}
+    of the matmul funnel, alone — the kernel needs only B plus the
+    separable twiddle factors, and pulling B out of
+    dft_funnel_matrices keeps the dense (R, n/R) T grid (which exists
+    for derivation/testing) out of the hot path's compute and cache."""
+    rev = bit_reverse_indices(R).astype(np.float64)
+    rp = np.arange(R, dtype=np.float64)
+    b = np.exp(-2j * np.pi * np.outer(rev, rp) / R)
+    return b.real.astype(np.float32), b.imag.astype(np.float32)
+
+
+@lru_cache(maxsize=8)
+def dft_funnel_factors(R: int, n: int):
+    """Separably factored twiddle grid for the matmul funnel.
+
+    The dense T of dft_funnel_matrices is (R, n/R) — at n = 2^20 two
+    full-size extra operands whose double-buffered column blocks blew
+    the 16 MB scoped-VMEM limit on hardware (measured: 24.12M requested;
+    the round-3 mf bench configs all died with this OOM).  Splitting the
+    column index c = q*LANE + l factors it exactly:
+        T[r, q*LANE + l] = A[r, q] * B2[r, l],
+        A[r, q] = W_n^{bitrev(r) q LANE},  B2[r, l] = W_n^{bitrev(r) l}
+    (angle indices reduced mod n in int64, so both factors are exact
+    roots of unity and the product differs from dense T only by one f32
+    rounding).  A is (R, Q = n/R/LANE), B2 is (R, LANE): together
+    LANE x smaller than T, and the kernel rebuilds its block's T tile as
+    one broadcast complex multiply.  Returns (Ar, Ai, B2r, B2i).
+    """
+    Q = n // R // LANE
+    rev = bit_reverse_indices(R).astype(np.int64)
+    q = np.arange(Q, dtype=np.int64)
+    l = np.arange(LANE, dtype=np.int64)
+    a_idx = (rev[:, None] * q[None, :] * LANE) % n
+    b_idx = (rev[:, None] * l[None, :]) % n
+    a = np.exp(-2j * np.pi * a_idx / n)
+    b2 = np.exp(-2j * np.pi * b_idx / n)
     return (
-        b.real.astype(np.float32), b.imag.astype(np.float32),
-        t.real.astype(np.float32), t.imag.astype(np.float32),
+        a.real.astype(np.float32), a.imag.astype(np.float32),
+        b2.real.astype(np.float32), b2.imag.astype(np.float32),
     )
 
 
 def _matmul_funnel_kernel(precision, *refs):
     """Pallas kernel body: Y = (B @ X) * T on one (R, qb, LANE) column
     block — four real MXU matmuls for the complex row transform, then
-    the elementwise complex twiddle."""
-    xr_ref, xi_ref, br_ref, bi_ref, tr_ref, ti_ref, or_ref, oi_ref = refs
+    the elementwise complex twiddle, whose (R, qb, LANE) tile is rebuilt
+    in VMEM from the separable factors A (R, qb) and B2 (R, LANE) as a
+    broadcast complex product (see dft_funnel_factors: keeping dense T
+    blocks resident OOM'd scoped VMEM on hardware)."""
+    (xr_ref, xi_ref, br_ref, bi_ref, atr_ref, ati_ref, b2r_ref, b2i_ref,
+     or_ref, oi_ref) = refs
     xr = xr_ref[...]
     xi = xi_ref[...]
     R = xr.shape[0]
@@ -596,12 +640,39 @@ def _matmul_funnel_kernel(precision, *refs):
     )
     yr = dot(br, xr2) - dot(bi, xi2)
     yi = dot(br, xi2) + dot(bi, xr2)
-    tr = tr_ref[...].reshape(R, -1)
-    ti = ti_ref[...].reshape(R, -1)
+    # T tile = A (R, qb, 1) *complex B2 (R, 1, LANE), broadcast outer.
+    # A arrives TRANSPOSED as (qb, R) — its natural (R, qb) block has a
+    # sub-128 lane dim Mosaic rejects; the in-VMEM transpose of a tile
+    # this small (qb x 128 floats) is noise next to the matmuls.
+    ar = atr_ref[...].T.reshape(R, -1, 1)
+    ai = ati_ref[...].T.reshape(R, -1, 1)
+    b2r = b2r_ref[...].reshape(R, 1, LANE)
+    b2i = b2i_ref[...].reshape(R, 1, LANE)
+    tr = (ar * b2r - ai * b2i).reshape(R, -1)
+    ti = (ar * b2i + ai * b2r).reshape(R, -1)
     zr = yr * tr - yi * ti
     zi = yr * ti + yi * tr
     or_ref[...] = zr.reshape(R, *rest)
     oi_ref[...] = zi.reshape(R, *rest)
+
+
+# Scoped-VMEM ceiling Mosaic enforces per kernel invocation (v4/v5e:
+# 16 MB).  Used by the mf funnel's pre-lowering guard so un-lowerable
+# shapes fail with a clear ValueError instead of a backend OOM.
+VMEM_LIMIT_BYTES = 16 << 20
+
+
+def _mf_vmem_bytes(R: int, qb: int) -> int:
+    """Scoped-VMEM footprint estimate of one _matmul_funnel_kernel
+    invocation.  Beyond the double-buffered x/out column blocks (8
+    block-planes), Mosaic stack-allocates the kernel's intermediates
+    (xr2/xi2, yr/yi, the rebuilt tr/ti tile, zr/zi — ~14 more
+    block-sized planes; measured 22.19M at R=128 qb=16 where the io
+    blocks alone are 8M).  22 blocks + tables reproduces the measured
+    footprints within ~5%."""
+    block = R * qb * LANE * 4
+    tables = 2 * R * R * 4 + 2 * R * qb * 4 * 2 + 2 * R * LANE * 4
+    return 22 * block + tables
 
 
 def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
@@ -609,11 +680,13 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
                             tail: int = LANE):
     """Two-kernel whole-FFT with a MATMUL funnel: the first log2(R)
     stages run as one R-point DFT matmul + twiddle grid (MXU work, one
-    HBM pass — see dft_funnel_matrices) on the shared (R, Q, LANE)
-    layout, then the tile kernel finishes each C-point row.  R = 128
-    both feeds the MXU a native shape and shrinks the tile kernel's
-    VPU stage count versus the butterfly long-range pass (R = 16 at
-    n = 2^20)."""
+    HBM pass — see dft_funnel_matrices / dft_funnel_factors) on the
+    shared (R, Q, LANE) layout, then the tile kernel finishes each
+    C-point row.  R = 128 both feeds the MXU a native shape and shrinks
+    the tile kernel's VPU stage count versus the butterfly long-range
+    pass (R = 16 at n = 2^20).  The twiddle grid is applied from its
+    separable A/B2 factors: dense (R, n/R) T blocks OOM'd the 16 MB
+    scoped VMEM on hardware AND cost a full extra HBM read per plane."""
     from jax.experimental import pallas as pl
 
     if interpret is None:
@@ -627,23 +700,57 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
             f"n/R a multiple of {LANE}"
         )
     tile = n // R  # the tile kernel finishes whole rows
+    Q = tile // LANE
     if cb is None:
-        cb = min(tile, 1 << 13)
+        # largest VMEM-feasible column block among the shapes Mosaic can
+        # lower: qb must be a multiple of 8 (sublane rule on the A^T
+        # block) or the whole Q.  If even the smallest legal block blows
+        # the scoped-VMEM ceiling, this R is infeasible at this n —
+        # say so instead of suggesting a cb that also cannot lower.
+        # Interpret mode has no VMEM ceiling (matching the explicit-cb
+        # guard below): only the legality rule applies there.
+        legal = [q for q in range(8, Q, 8) if Q % q == 0] + [Q]
+        fits = [q for q in legal
+                if interpret
+                or _mf_vmem_bytes(R, q) <= VMEM_LIMIT_BYTES * 3 // 4]
+        if not fits:
+            need = _mf_vmem_bytes(R, min(legal)) >> 20
+            raise ValueError(
+                f"matmul funnel R={R} is infeasible at n={n}: its "
+                f"smallest lowerable block needs ~{need} MB scoped VMEM "
+                f"(limit {VMEM_LIMIT_BYTES >> 20} MB) — use a smaller R"
+            )
+        if interpret:  # keep interpret blocks modest (old cb<=2^13 default)
+            capped = [q for q in fits if q <= (1 << 13) // LANE]
+            cb = (capped[-1] if capped else fits[0]) * LANE
+        else:
+            cb = fits[-1] * LANE
     if cb % LANE or tile % cb:
         raise ValueError(f"cb={cb} must divide C={tile} and be a "
                          f"multiple of {LANE}")
     _check_tail(tail, tile)
-    Q = tile // LANE
     qb = cb // LANE
-    br, bi, tr, ti = (jnp.asarray(t) for t in dft_funnel_matrices(R, n))
-    t3r = tr.reshape(R, Q, LANE)
-    t3i = ti.reshape(R, Q, LANE)
+    if not interpret and _mf_vmem_bytes(R, qb) > VMEM_LIMIT_BYTES:
+        raise ValueError(
+            f"matmul funnel R={R} cb={cb} needs ~"
+            f"{_mf_vmem_bytes(R, qb) >> 20} MB scoped VMEM "
+            f"(limit {VMEM_LIMIT_BYTES >> 20} MB) — reduce cb"
+        )
+    if qb % 8 and qb != Q:
+        raise ValueError(
+            f"cb={cb} gives a {qb}-row A block; Mosaic needs sublane "
+            f"blocks divisible by 8 — use cb >= {8 * LANE}"
+        )
+    br, bi = (jnp.asarray(t) for t in dft_funnel_b(R))
+    ar, ai, b2r, b2i = (jnp.asarray(t) for t in dft_funnel_factors(R, n))
+    atr, ati = ar.T, ai.T  # (Q, R): lane-dim-legal blocks (see kernel)
     x3r = xr.reshape(R, Q, LANE)
     x3i = xi.reshape(R, Q, LANE)
 
     in_specs = [pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2
     in_specs += [pl.BlockSpec((R, R), lambda i: (0, 0))] * 2
-    in_specs += [pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2
+    in_specs += [pl.BlockSpec((qb, R), lambda i: (i, 0))] * 2  # A^T blocks
+    in_specs += [pl.BlockSpec((R, LANE), lambda i: (0, 0))] * 2
     x3r, x3i = pl.pallas_call(
         partial(_matmul_funnel_kernel, precision),
         grid=(Q // qb,),
@@ -654,7 +761,7 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
             jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
         ],
         interpret=interpret,
-    )(x3r, x3i, br, bi, t3r, t3i)
+    )(x3r, x3i, br, bi, atr, ati, b2r, b2i)
 
     yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
