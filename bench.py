@@ -43,23 +43,27 @@ def measure_tpu_ms() -> float:
     from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
 
     # (impl, tile_or_R, cb, tail): rql = the retiling-free (R, Q, 128)
-    # composed path (tile_or_R = tile).  tail=256 moves one VPU stage
-    # traversal onto the (otherwise idle) MXU as a 2x2-blocked 256-point
-    # DIF matmul.  rql fastest measured: ~0.092 ms at tile=2^16
-    # cb=2^12..13 (~1100 GF), rel_err 2.2e-07 vs numpy (tail=512 tips
-    # the MXU out of hiding).
+    # composed path (tile_or_R = tile).  tail=256 moves two VPU stage
+    # traversals onto the MXU as a 2x2-blocked 256-point DIF matmul; the
+    # tail matmul runs in SPLIT3 precision (3-pass bf16 error split,
+    # rel err ~4e-6 — pallas_fft.SPLIT3), which round-4 measurements
+    # showed cuts the tile pass by ~2x vs Precision.HIGHEST (XLA's
+    # 6-pass f32 emulation was the single largest cost in the whole
+    # transform).  rql fastest measured with split3: 0.081-0.092 ms at
+    # tile=2^16 cb=2^12..13 (~1180-1300 GF), rel_err 3.9e-06 vs numpy.
     #
     # The matmul-funnel path (fft_pi_layout_pallas_mf) is NOT in the
     # config list: round 3's mf configs OOM'd scoped VMEM on hardware
     # (24.12M vs the 16M limit); round 4 fixed it with the separable
     # A/B2 twiddle factorization (dft_funnel_factors) and a VMEM guard,
     # but the surviving lowerable shape (R=128, cb=1024 — Mosaic stack
-    # intermediates force 1 MB blocks) measures 0.149 ms / 706 GF vs
-    # rql's 0.103 ms / 1017 GF at N=2^20: correct and supported (tests/
+    # intermediates force 1 MB blocks) measures 0.108 ms (split3) vs
+    # rql's 0.089 ms at N=2^20: correct and supported (tests/
     # test_pallas.py), just not the headline.
     configs = (
         ("rql", 1 << 16, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 12, 256),
+        ("rql", 1 << 15, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 13, 128),
         ("two-kernel", 1 << 16, 1 << 14, 128),
     )
@@ -163,6 +167,42 @@ def measure_xla_fft_ms():
     return max(raw - epilogue, raw * 0.5)
 
 
+def measure_large_n_ms() -> dict:
+    """Large-n reach rows (the reference's pthreads analysis goes to
+    n=2^24): rql wall time at 2^22 and 2^24 with the VMEM-aware default
+    cb.  Best-effort — a failure drops the fields, not the bench."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_rql
+    from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+    out = {}
+    for logn in (22, 24):
+        nn = 1 << logn
+        try:
+            key = jax.random.PRNGKey(3)
+            xr = jax.random.normal(key, (nn,), jnp.float32)
+            xi = jax.random.normal(jax.random.fold_in(key, 1), (nn,),
+                                   jnp.float32)
+            inv = np.float32(1.0 / np.sqrt(nn))
+
+            def body(c):
+                yr, yi = fft_pi_layout_pallas_rql(c[0], c[1], tile=1 << 16,
+                                                  tail=256)
+                return yr * inv, yi * inv
+
+            ms = loop_slope_ms(body, (xr, xi), k1=16, k2=256, reps=5,
+                               min_delta_ms=100.0, cache=False)
+            out[f"n2^{logn}_ms"] = round(ms, 4)
+            out[f"n2^{logn}_gflops"] = round(
+                5.0 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
+        except Exception as e:
+            print(f"# large-n 2^{logn} not measured: {type(e).__name__}",
+                  file=sys.stderr)
+    return out
+
+
 def measure_c_baseline_ms() -> float:
     from cs87project_msolano2_tpu.backends.cpu import num_cores
     from cs87project_msolano2_tpu.backends.registry import get_backend
@@ -178,6 +218,7 @@ def measure_c_baseline_ms() -> float:
 def main() -> int:
     tpu_ms = measure_tpu_ms()
     xla_ms = measure_xla_fft_ms()
+    large = measure_large_n_ms()
     c_ms = measure_c_baseline_ms()
     gflops = 5.0 * N * np.log2(N) / (tpu_ms * 1e-3) / 1e9
     record = {
@@ -189,6 +230,7 @@ def main() -> int:
     if xla_ms is not None:
         record["vs_xla_fft"] = round(xla_ms / tpu_ms, 2)
         record["xla_fft_ms"] = round(xla_ms, 4)
+    record.update(large)
     print(json.dumps(record))
     return 0
 

@@ -36,7 +36,7 @@ SCAN_MIN_N = 1 << 17
 
 
 @lru_cache(maxsize=32)
-def _compiled(n: int, p: int, impl: str):
+def _compiled(n: int, p: int, impl: str, kblock: int | None = None):
     import jax
 
     from ..models.pi_fft import (
@@ -67,13 +67,41 @@ def _compiled(n: int, p: int, impl: str):
         from ..models.direct_dft import (
             funnel_einsum_planes,
             pi_dft_einsum_planes,
+            tube_einsum_block,
             tube_einsum_planes,
+            tube_einsum_planes_hostblocked,
         )
 
-        full = jax.jit(partial(pi_dft_einsum_planes, p=p))
-        tube_raw = partial(tube_einsum_planes, n=n, p=p)
+        # kblock is part of the cache key: needs_loop_slope() is dynamic
+        # (env var / configured platforms), so deriving it HERE would let
+        # a mode flip serve a stale single-program tube — the exact
+        # >2^14-gather program that crashes the relay worker
         funnel_f = jax.jit(partial(funnel_einsum_planes, p=p))
-        return funnel_f, jax.jit(tube_raw), full
+        if kblock is None:
+            full = jax.jit(partial(pi_dft_einsum_planes, p=p))
+            tube_f = jax.jit(partial(tube_einsum_planes, n=n, p=p))
+        else:
+            # capacity-lifted tube: one compiled block program (k0
+            # traced), s/kblock host dispatches per application — each
+            # within the relay's single-program budget
+            block_fn = jax.jit(
+                partial(tube_einsum_block, n=n, p=p, kblock=kblock)
+            )
+
+            def tube_f(sr, si):
+                return tube_einsum_planes_hostblocked(
+                    sr, si, n, p, kblock, block_fn=block_fn
+                )
+
+            def full(xr, xi):
+                fr, fi = funnel_f(xr, xi)
+                tr, ti = tube_f(fr, fi)
+                return (
+                    tr.reshape(*xr.shape[:-1], n),
+                    ti.reshape(*xi.shape[:-1], n),
+                )
+
+        return funnel_f, tube_f, full
     elif n >= SCAN_MIN_N:
         full = jax.jit(lambda xr, xi: pi_fft_pi_layout_scan(xr, xi, p, tables))
         tube_raw = lambda sr, si: tube_scan(sr, si, n, p)  # noqa: E731
@@ -87,7 +115,7 @@ def _compiled(n: int, p: int, impl: str):
 
 
 @lru_cache(maxsize=32)
-def _loop_bodies(n: int, p: int, impl: str):
+def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
     """Shape-closed raw bodies for loop-slope timing.
 
     funnel body folds the (p, n/p) result back to (n,) planes (a free
@@ -128,9 +156,12 @@ def _loop_bodies(n: int, p: int, impl: str):
     elif impl == "einsum":
         # phased einsum model, all-float plane ops (the axon relay cannot
         # lower complex inside While bodies)
+        import jax
+
         from ..models.direct_dft import (
             funnel_einsum_planes,
             pi_dft_einsum_planes,
+            tube_einsum_block,
             tube_einsum_planes,
         )
 
@@ -138,13 +169,32 @@ def _loop_bodies(n: int, p: int, impl: str):
             fr, fi = funnel_einsum_planes(c[0], c[1], p)
             return fr.reshape(n) * inv_rp, fi.reshape(n) * inv_rp
 
-        def tube_body(c):
-            tr, ti = tube_einsum_planes(c[0], c[1], n, p)
-            return tr * inv_rs, ti * inv_rs
+        if kblock is None:
+            def tube_body(c):
+                tr, ti = tube_einsum_planes(c[0], c[1], n, p)
+                return tr * inv_rs, ti * inv_rs
 
-        def full_body(c):
-            yr, yi = pi_dft_einsum_planes(c[0], c[1], p)
-            return yr * inv_rn, yi * inv_rn
+            def full_body(c):
+                yr, yi = pi_dft_einsum_planes(c[0], c[1], p)
+                return yr * inv_rn, yi * inv_rn
+        else:
+            # capacity-lifted regime: the timed unit is ONE block
+            # program (all s/kblock blocks are shape- and work-
+            # identical; run() multiplies the slope back up).  The
+            # block result scatters into the carry so shapes close;
+            # the O(p*kblock) update is noise next to the
+            # Theta(kblock*s) block compute.
+            def tube_body(c):
+                yr, yi = tube_einsum_block(c[0], c[1], 0, n, p, kblock)
+                cr = jax.lax.dynamic_update_slice(
+                    c[0], yr * inv_rs, (0,) * c[0].ndim
+                )
+                ci = jax.lax.dynamic_update_slice(
+                    c[1], yi * inv_rs, (0,) * c[1].ndim
+                )
+                return cr, ci
+
+            full_body = None  # full = funnel + blocked tube, host-level
 
         return funnel_body, tube_body, full_body
     elif n >= SCAN_MIN_N:
@@ -173,6 +223,26 @@ _warned_large_p: set[tuple[int, int]] = set()
 # measured safe (~2 GB twiddle-gather traffic/application); s=2^15 is
 # borderline and s=2^16 crashes the TPU worker (see run()).
 EINSUM_TUBE_MAX_S = 1 << 14
+# Beyond that the tube splits into host-driven block programs (one
+# compiled program, s/kblock dispatches — models.direct_dft.
+# tube_einsum_block), each within the single-program budget.  The
+# program COUNT caps the lift: 64 dispatches/application keeps one
+# application under ~2 min of relay round trips, giving s up to
+# sqrt(64) * 2^14 = 2^17.
+EINSUM_TUBE_MAX_PROGRAMS = 64
+EINSUM_TUBE_ABS_MAX_S = EINSUM_TUBE_MAX_S * 8  # sqrt(64) = 8
+
+
+def einsum_tube_kblock(s: int) -> int | None:
+    """Rows per block program for segment length s; None = single
+    program (the scan tube) suffices."""
+    if s <= EINSUM_TUBE_MAX_S:
+        return None
+    # keep per-program gather work ~ EINSUM_TUBE_MAX_S^2 entries
+    kblock = max((EINSUM_TUBE_MAX_S * EINSUM_TUBE_MAX_S) // s, 1)
+    while s % kblock:
+        kblock //= 2
+    return kblock
 
 
 class JaxBackend:
@@ -195,19 +265,21 @@ class JaxBackend:
         x = check_run_args(x, p)
         n = x.shape[-1]
         if (self._impl == "einsum" and needs_loop_slope()
-                and n // p > EINSUM_TUBE_MAX_S):
+                and n // p > EINSUM_TUBE_ABS_MAX_S):
             # The einsum tube is a dense per-segment DFT: Theta(s^2)
             # work AND s^2 on-the-fly twiddle-gather traffic per
             # application (~34 GB at s=2^16).  One application at
             # s >= 2^15 exceeds the relay's ~10 s single-program budget
-            # and CRASHES the TPU worker (observed; >1 min restart), so
-            # this is a capacity limit of the accelerator path, not a
-            # timing-window problem — the reference's harness clips
-            # infeasible configs the same way (probe-and-clip,
-            # run-experiments:42-50).
+            # and CRASHES the TPU worker (observed; >1 min restart).
+            # s in (2^14, 2^17] is served by the host-blocked tube
+            # (einsum_tube_kblock); past that even the blocked split
+            # needs > EINSUM_TUBE_MAX_PROGRAMS dispatches/application —
+            # a capacity limit of the accelerator path, clipped the way
+            # the reference's harness clips infeasible configs
+            # (probe-and-clip, run-experiments:42-50).
             raise ValueError(
                 f"einsum tube segment s={n // p} exceeds the relay's "
-                f"single-program budget (max s={EINSUM_TUBE_MAX_S}); "
+                f"blocked-tube budget (max s={EINSUM_TUBE_ABS_MAX_S}); "
                 "use a larger p or the jax/pallas backends"
             )
         if p >= 32 and (n, p) not in _warned_large_p:
@@ -225,7 +297,12 @@ class JaxBackend:
                   "trade); expect slowdown beyond p~16 — use "
                   "parallel.pi_fft_sharded for real multi-device speedup",
                   file=sys.stderr)
-        funnel_f, tube_f, full_f = _compiled(n, p, self._impl)
+        # compute the einsum tube's blocking ONCE per call from the
+        # CURRENT timing mode and thread it into both compile caches
+        kblock = (einsum_tube_kblock(n // p)
+                  if self._impl == "einsum" and needs_loop_slope()
+                  else None)
+        funnel_f, tube_f, full_f = _compiled(n, p, self._impl, kblock)
 
         xr = jax.device_put(jnp.asarray(np.real(x), dtype=jnp.float32))
         xi = jax.device_put(jnp.asarray(np.imag(x), dtype=jnp.float32))
@@ -248,7 +325,7 @@ class JaxBackend:
             # docstring).  Tube iterates on (p, s) planes; its input
             # content is irrelevant to its cost, so reshaped input works.
             funnel_body, tube_body, full_body = _loop_bodies(
-                n, p, self._impl
+                n, p, self._impl, kblock
             )
             # The einsum tube does Theta(s^2) work per application; at
             # the capacity limit (s = EINSUM_TUBE_MAX_S, guarded above)
@@ -257,8 +334,15 @@ class JaxBackend:
             # tube at a (1, 4) window; the escalation ladder still grows
             # it if the delta doesn't resolve.
             tube_kw = {}
-            if self._impl == "einsum" and n // p >= 1 << 13:
-                tube_kw = dict(k1=1, k2=4)
+            tube_mult = 1
+            if self._impl == "einsum":
+                if n // p >= 1 << 13:
+                    tube_kw = dict(k1=1, k2=4)
+                if kblock is not None:
+                    # blocked tube: the slope times ONE block program;
+                    # all s/kblock blocks are identical in shape and
+                    # work, so the phase time is the slope scaled up
+                    tube_mult = (n // p) // kblock
             try:
                 # p == 1: zero funnel iterations (the reference's funnel
                 # loop runs log2(p) times, …pthreads.c:419) — the body is
@@ -267,7 +351,7 @@ class JaxBackend:
                 funnel_ms = 0.0 if p == 1 else loop_slope_ms(
                     funnel_body, (xr, xi), reps=reps
                 )
-                tube_ms = loop_slope_ms(
+                tube_ms = tube_mult * loop_slope_ms(
                     tube_body,
                     (xr.reshape(p, n // p), xi.reshape(p, n // p)),
                     reps=reps,

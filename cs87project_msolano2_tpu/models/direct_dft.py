@@ -28,7 +28,7 @@ Two tiers live here:
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax.numpy as jnp
 import numpy as np
@@ -135,45 +135,48 @@ def funnel_einsum_planes(xr, xi, p: int):
     return yr, yi
 
 
+def _tube_rows_apply(sr, si, kb, s: int):
+    """Shared core of the scan tube and the host-blocked tube: generate
+    the DIF-matrix rows for output indices `kb` (already bit-reversed)
+    and contract them against the (..., s) planes.
+
+    Angle index (kb * j) mod s is computed with wrapping int32
+    multiplies — exact because s is a power of two, so the low bits of
+    the wrapped product ARE the mod — then gathered from the full-period
+    table; the four real '...j,kj->...k' einsums are the complex
+    contraction, MXU work.  Returns (..., len(kb)) planes."""
+    wr_t, wi_t = (jnp.asarray(t) for t in full_twiddle(s))
+    j = jnp.arange(s, dtype=jnp.int32)
+    idx = (kb[:, None] * j[None, :]) & jnp.int32(s - 1)
+    wr, wi = wr_t[idx], wi_t[idx]
+    yr = jnp.einsum("...j,kj->...k", sr, wr) - jnp.einsum(
+        "...j,kj->...k", si, wi
+    )
+    yi = jnp.einsum("...j,kj->...k", sr, wi) + jnp.einsum(
+        "...j,kj->...k", si, wr
+    )
+    return yr, yi
+
+
 def tube_einsum_planes(sr, si, n: int, p: int, block: int | None = None):
     """Tube phase as a blockwise dense einsum: per-segment s-point DIF
     matrix B[k, j] = W_s^{rev_s(k) * j} applied over the trailing axis.
 
     sr/si: (..., s) -> (..., s).  B rows are generated on the fly inside
-    a lax.scan over output-row blocks — angle index (rev_k * j) mod s is
-    computed with wrapping int32 multiplies (exact: s is a power of two,
-    so the low bits of the wrapped product ARE the mod), then gathered
-    from the full-period table.  Memory O(block * s) at any n; the
-    contraction itself is MXU work.
+    a lax.scan over output-row blocks (_tube_rows_apply).  Memory
+    O(block * s) at any n; the contraction itself is MXU work.
     """
     import jax
 
     s = sr.shape[-1]
     if s == 1:
         return sr, si
-    wr_t, wi_t = (jnp.asarray(t) for t in full_twiddle(s))
     revk = jnp.asarray(bit_reverse_indices(s).astype(np.int32))
-    j = jnp.arange(s, dtype=jnp.int32)
-    mask = jnp.int32(s - 1)
-
-    def rows(kb):
-        # (block, s) twiddle planes for output rows kb
-        idx = (kb[:, None] * j[None, :]) & mask
-        return wr_t[idx], wi_t[idx]
-
-    def apply(wr, wi):
-        yr = jnp.einsum("...j,kj->...k", sr, wr) - jnp.einsum(
-            "...j,kj->...k", si, wi
-        )
-        yi = jnp.einsum("...j,kj->...k", sr, wi) + jnp.einsum(
-            "...j,kj->...k", si, wr
-        )
-        return yr, yi
 
     if block is None:
         block = max(min(s, (1 << 22) // s), 1)
     if block >= s:
-        return apply(*rows(revk))
+        return _tube_rows_apply(sr, si, revk, s)
     if s % block:
         raise ValueError(
             f"tube block={block} must divide segment length s={s} "
@@ -181,13 +184,55 @@ def tube_einsum_planes(sr, si, n: int, p: int, block: int | None = None):
         )
 
     def step(carry, kb):
-        wr, wi = rows(kb)
-        return carry, apply(wr, wi)
+        return carry, _tube_rows_apply(sr, si, kb, s)
 
     _, (yrs, yis) = jax.lax.scan(step, None, revk.reshape(s // block, block))
     # (nsteps, ..., p, block) -> (..., p, s): blocks are consecutive k
     yr = jnp.moveaxis(yrs, 0, -2).reshape(*sr.shape[:-1], s)
     yi = jnp.moveaxis(yis, 0, -2).reshape(*si.shape[:-1], s)
+    return yr, yi
+
+
+def tube_einsum_block(sr, si, k0, n: int, p: int, kblock: int):
+    """One host-driven slice of the dense tube: output rows
+    [k0, k0 + kblock) of every segment's s-point DIF.
+
+    The blockwise-scan tube (tube_einsum_planes) is ONE device program
+    whose total twiddle-gather traffic is Theta(s^2) — past s = 2^14
+    that exceeds the relay's single-program budget and crashes the TPU
+    worker (see backends/jax_backend.py::EINSUM_TUBE_MAX_S).  Splitting
+    across MULTIPLE programs lifts the capacity: each call does
+    Theta(kblock * s) work, and `k0` is a TRACED scalar so one compiled
+    program serves every block of a segment length (s // kblock host
+    calls per application, not s // kblock compiles).
+
+    sr/si: (..., s) planes -> (..., kblock) planes of rows k0..k0+kblock.
+    """
+    import jax
+
+    s = sr.shape[-1]
+    revk_all = jnp.asarray(bit_reverse_indices(s).astype(np.int32))
+    kb = jax.lax.dynamic_slice(revk_all, (k0,), (kblock,))
+    return _tube_rows_apply(sr, si, kb, s)
+
+
+def tube_einsum_planes_hostblocked(sr, si, n: int, p: int, kblock: int,
+                                   block_fn=None):
+    """Full dense tube as a HOST loop over tube_einsum_block programs —
+    the capacity-lifting path for segments too long for one relay
+    program.  Each iteration dispatches the same compiled block program
+    at a different k0; results concatenate along the row axis (blocks
+    are consecutive bit-reversed-order output rows, exactly the scan
+    tube's layout).  `block_fn` lets the backend pass a jitted
+    tube_einsum_block."""
+    s = sr.shape[-1]
+    if s % kblock:
+        raise ValueError(f"kblock={kblock} must divide s={s}")
+    if block_fn is None:
+        block_fn = partial(tube_einsum_block, n=n, p=p, kblock=kblock)
+    parts = [block_fn(sr, si, k0) for k0 in range(0, s, kblock)]
+    yr = jnp.concatenate([pr for pr, _ in parts], axis=-1)
+    yi = jnp.concatenate([pi_ for _, pi_ in parts], axis=-1)
     return yr, yi
 
 

@@ -39,6 +39,45 @@ LANE = 128
 # ~3 TFLOP/s, 2^16 ~2.1, and >=2^17 overflows VMEM (remote-compile failure).
 DEFAULT_TILE = 1 << 15
 
+# Precision mode for the MXU tail matmul: error-compensated 3-pass bf16.
+# Measured at n=2^20 the tail at Precision.HIGHEST (XLA's 6-pass f32
+# emulation) costs ~100 us of the tile pass — the single largest term in
+# the whole transform — while DEFAULT (1-pass bf16, rel err ~4e-3) fails
+# the 1e-5 bound.  split3 decomposes each operand into bf16 hi + lo
+# residual planes and keeps the three significant cross products
+# (x_hi B_hi + x_hi B_lo + x_lo B_hi, f32 accumulation); the dropped
+# x_lo B_lo term is ~2^-18 relative — comfortably inside 1e-5 — at half
+# HIGHEST's MXU passes.  (Precision.HIGH, XLA's own 3-pass mode, raises
+# NotImplementedError in the Mosaic lowering; this is its manual twin.)
+SPLIT3 = "split3"
+
+
+def _make_dot(precision):
+    """Row-major (m,k)@(k,n) on the MXU under the given precision mode;
+    `precision` is a jax.lax.Precision or the SPLIT3 sentinel."""
+    if precision == SPLIT3:
+        raw = partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        )
+
+        def dot(x, b):
+            xh = x.astype(jnp.bfloat16)
+            xl = (x - xh.astype(jnp.float32)).astype(jnp.bfloat16)
+            bh = b.astype(jnp.bfloat16)
+            bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
+            return raw(xh, bh) + raw(xh, bl) + raw(xl, bh)
+
+        return dot
+    return partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+
 
 @lru_cache(maxsize=8)
 def dif_tail_matrix_t(tail: int = LANE) -> tuple[np.ndarray, np.ndarray]:
@@ -161,12 +200,7 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
     # (LANE, LANE) tiles, and accumulate Y_s = sum_i X_i @ Bt[i, s] —
     # S^2 complex block-matmuls that trade MXU flops for one fewer VPU
     # traversal per tail doubling.
-    dot = partial(
-        jax.lax.dot_general,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        precision=precision,
-        preferred_element_type=jnp.float32,
-    )
+    dot = _make_dot(precision)
     S = btr.shape[0] // LANE
     if S == 1:
         yr = dot(xr, btr) - dot(xi, bti)
@@ -229,11 +263,15 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
     shaped (total_rows, 128) with total_rows % (tile/128) == 0; each
     consecutive group of tile/128 rows is one independent tile-point DIF.
 
-    `precision` controls the MXU tail matmul.  Mosaic lowers only
-    HIGHEST (default — the multi-pass bf16 decomposition of f32) and
-    DEFAULT (single-pass bf16, ~4e-3 relative error: fails the 1e-5
-    verification bound, useful only for isolating MXU cost); HIGH
-    raises NotImplementedError in the TPU lowering.
+    `precision` controls the MXU tail matmul.  Default is SPLIT3 (the
+    error-compensated 3-pass bf16 split, rel err ~4e-6 — see SPLIT3):
+    measured at n=2^20 it cuts the tile pass from ~80 us (HIGHEST,
+    XLA's 6-pass f32 emulation — the single largest cost in the whole
+    transform) to ~45 us.  HIGHEST remains available where bit-tighter
+    accuracy is wanted; DEFAULT (single-pass bf16, ~4e-3 rel err) fails
+    the 1e-5 verification bound and is useful only for isolating MXU
+    cost; Precision.HIGH raises NotImplementedError in the TPU
+    lowering.
 
     `tail` (128, 256, 512, ... — any power-of-two multiple of 128
     dividing tile) picks the dense-matmul tail size — see
@@ -245,7 +283,7 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
     if interpret is None:
         interpret = _use_interpret()
     if precision is None:
-        precision = jax.lax.Precision.HIGHEST
+        precision = SPLIT3
     _check_tail(tail, tile)
 
     trows = tile // LANE
@@ -511,13 +549,20 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
         interpret = _use_interpret()
     n = xr.shape[-1]
     tile = _choose_tile(n, tile)
+    R = n // tile
     if cb is None:
+        # VMEM-aware default: the long-range kernel's double-buffered
+        # io blocks plus its butterfly stack temps come to ~12
+        # block-planes of R*cb*4 bytes (measured: 16.75M scoped at
+        # R=64, cb=2^13 — just past the 16M limit; R=16, cb=2^13 fits).
+        # Keep R*cb <= 2^18 (~12 MB) so n up to 2^24 (R=256) lowers.
         cb = min(tile, 1 << 13)
+        while cb > LANE and R * cb > (1 << 18):
+            cb //= 2
     if cb % LANE or tile % cb:
         raise ValueError(f"cb={cb} must divide tile={tile} and be a "
                          f"multiple of {LANE}")
     _check_tail(tail, tile)  # before any kernel runs
-    R = n // tile
     Q = tile // LANE
     qb = cb // LANE
     x3r = xr.reshape(R, Q, LANE)
@@ -546,7 +591,7 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
         )(x3r, x3i, a3r, a3i, b3r, b3i)
 
     if precision is None:
-        precision = jax.lax.Precision.HIGHEST
+        precision = SPLIT3
     yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
 
@@ -632,12 +677,7 @@ def _matmul_funnel_kernel(precision, *refs):
     xi2 = xi.reshape(R, -1)
     br = br_ref[...]
     bi = bi_ref[...]
-    dot = partial(
-        jax.lax.dot_general,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        precision=precision,
-        preferred_element_type=jnp.float32,
-    )
+    dot = _make_dot(precision)
     yr = dot(br, xr2) - dot(bi, xi2)
     yi = dot(br, xi2) + dot(bi, xr2)
     # T tile = A (R, qb, 1) *complex B2 (R, 1, LANE), broadcast outer.
@@ -692,7 +732,7 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
     if interpret is None:
         interpret = _use_interpret()
     if precision is None:
-        precision = jax.lax.Precision.HIGHEST
+        precision = SPLIT3
     n = xr.shape[-1]
     if R < 2 or R & (R - 1) or n % R or (n // R) % LANE:
         raise ValueError(

@@ -155,3 +155,69 @@ def test_einsum_capacity_guard():
 
     with pytest.raises(ValueError):
         funnel_coeff_planes(COEF_MAX_ENTRIES, 4)
+
+
+def test_tube_hostblocked_matches_scan():
+    """The host-driven blocked tube (the relay capacity-lift path,
+    backends/jax_backend.py::einsum_tube_kblock) must equal the
+    single-program scan tube row for row."""
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.direct_dft import (
+        tube_einsum_planes,
+        tube_einsum_planes_hostblocked,
+    )
+
+    n, p = 4096, 4  # s = 1024
+    x = rand_c64(n, seed=9)
+    sr = jnp.asarray(x.real.astype(np.float32)).reshape(p, n // p)
+    si = jnp.asarray(x.imag.astype(np.float32)).reshape(p, n // p)
+    ar, ai = tube_einsum_planes(sr, si, n, p)
+    br, bi = tube_einsum_planes_hostblocked(sr, si, n, p, kblock=128)
+    assert np.max(np.abs(np.asarray(ar) - np.asarray(br))) < 1e-3
+    assert np.max(np.abs(np.asarray(ai) - np.asarray(bi))) < 1e-3
+
+
+def test_hostblocked_full_pi_dft_vs_numpy():
+    """funnel + host-blocked tube end-to-end against numpy's FFT (the
+    shape the lifted einsum backend runs for s > EINSUM_TUBE_MAX_S)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.direct_dft import (
+        funnel_einsum_planes,
+        tube_einsum_block,
+        tube_einsum_planes_hostblocked,
+    )
+    from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+    from functools import partial
+
+    n, p = 4096, 2  # s = 2048
+    kblock = 256
+    x = rand_c64(n, seed=10)
+    xr = jnp.asarray(x.real.astype(np.float32))
+    xi = jnp.asarray(x.imag.astype(np.float32))
+    fr, fi = funnel_einsum_planes(xr, xi, p)
+    block_fn = jax.jit(partial(tube_einsum_block, n=n, p=p, kblock=kblock))
+    tr, ti = tube_einsum_planes_hostblocked(fr, fi, n, p, kblock,
+                                            block_fn=block_fn)
+    y = (np.asarray(tr) + 1j * np.asarray(ti)).reshape(n)
+    ref = np.fft.fft(x.astype(np.complex128))[bit_reverse_indices(n)]
+    assert rel_err(y, ref) < 1e-5
+
+
+def test_einsum_tube_kblock_policy():
+    from cs87project_msolano2_tpu.backends.jax_backend import (
+        EINSUM_TUBE_ABS_MAX_S,
+        EINSUM_TUBE_MAX_PROGRAMS,
+        EINSUM_TUBE_MAX_S,
+        einsum_tube_kblock,
+    )
+
+    assert einsum_tube_kblock(EINSUM_TUBE_MAX_S) is None  # fits one program
+    for s in (1 << 15, 1 << 16, 1 << 17):
+        kb = einsum_tube_kblock(s)
+        assert kb is not None and s % kb == 0
+        assert kb * s <= EINSUM_TUBE_MAX_S ** 2  # per-program budget
+        assert s // kb <= EINSUM_TUBE_MAX_PROGRAMS
+    assert (1 << 17) == EINSUM_TUBE_ABS_MAX_S

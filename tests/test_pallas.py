@@ -139,7 +139,10 @@ def test_pi_fft_pallas_matches_jnp(p):
     xr, xi = rand_planes(n, seed=2)
     yr, yi = pi_fft_pi_layout_pallas(xr, xi, p)
     rr, ri = pi_fft_pi_layout(xr, xi, p)
-    assert rel_err(to_complex(yr, yi), to_complex(rr, ri)) < 1e-6
+    # 1e-5 is the project verification bound (reference float32 parity);
+    # the SPLIT3 default tail precision sits at ~4e-6 vs jnp's all-f32
+    # chain (HIGHEST matched to 1e-6, but costs ~2x the tile pass)
+    assert rel_err(to_complex(yr, yi), to_complex(rr, ri)) < 1e-5
 
 
 def test_pi_fft_pallas_small_segment_fallback():
@@ -162,7 +165,8 @@ def test_tube_pallas_matches_jnp_tube():
     fr, fi = funnel(jnp.asarray(xr), jnp.asarray(xi), p)
     ar, ai = tube_pallas(fr, fi, n, p)
     br, bi = tube(fr, fi, n, p)
-    assert rel_err(to_complex(ar, ai), to_complex(br, bi)) < 1e-6
+    # 1e-5: project verification bound; SPLIT3 tail default gives ~4e-6
+    assert rel_err(to_complex(ar, ai), to_complex(br, bi)) < 1e-5
     assert ar.shape == br.shape  # (p, s) preserved
 
 
@@ -172,3 +176,29 @@ def test_backend_pallas_golden():
 
     res = get_backend("pallas").run(verify.golden_input(), 2)
     assert verify.golden_check_exact(verify.pi_layout_to_natural(res.out))
+
+
+def test_fft_pallas_rql_large_n_2_22():
+    """Large-n reach (the reference's pthreads analysis goes to n=2^24,
+    cpu/pthreads/...-analysis-n16777216.pdf): the rql path's VMEM-aware
+    default cb must produce lowerable shapes and correct results at
+    n = 2^22 (R = 64 long-range rows; the fixed cb=2^13 default OOM'd
+    scoped VMEM at 16.75M).  2^24 is exercised on hardware by bench.py
+    (interpret mode at 2^24 costs minutes; same code path as here)."""
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_rql
+
+    n = 1 << 22
+    rng = np.random.default_rng(1)
+    x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+        np.complex64
+    )
+    yr, yi = fft_pi_layout_pallas_rql(
+        jnp.asarray(x.real), jnp.asarray(x.imag), tile=1 << 16, tail=256
+    )
+    y = np.asarray(yr) + 1j * np.asarray(yi)
+    ref = np.fft.fft(x.astype(np.complex128))[bit_reverse_indices(n)]
+    err = np.max(np.abs(y - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5
