@@ -116,14 +116,15 @@ def _compiled(n: int, p: int, impl: str, kblock: int | None = None):
 
 @lru_cache(maxsize=32)
 def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
-    """Shape-closed raw bodies for loop-slope timing.
+    """Shape-closed raw (funnel_body, tube_body) for loop-slope timing.
 
     funnel body folds the (p, n/p) result back to (n,) planes (a free
-    reshape) so it can iterate; the tube body iterates on (p, n/p)."""
+    reshape) so it can iterate; the tube body iterates on (p, n/p).
+    Only the two phase bodies exist: run() derives total := funnel +
+    tube (the reference's nested-timer contract), so a full-transform
+    body would never be timed."""
     from ..models.pi_fft import (
         funnel,
-        pi_fft_pi_layout,
-        pi_fft_pi_layout_scan,
         tube,
         tube_scan,
     )
@@ -133,9 +134,8 @@ def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
     tables = twiddle_tables(n)
     # amplitude renormalization so hundreds of loop iterations neither
     # overflow nor denormalize; per application, random data grows by
-    # ~sqrt(len) through a full transform but only ~sqrt(p) through the
-    # funnel's log2(p) half-stages
-    inv_rn = np.float32(1.0 / np.sqrt(n))
+    # ~sqrt(seg) through the tube's segment transform but only ~sqrt(p)
+    # through the funnel's log2(p) half-stages
     inv_rs = np.float32(1.0 / np.sqrt(n // p))
     inv_rp = np.float32(1.0 / np.sqrt(p))
 
@@ -149,10 +149,6 @@ def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
         def tube_body(c):
             tr, ti = tube_pallas(c[0], c[1], n, p)
             return tr * inv_rs, ti * inv_rs
-
-        def full_body(c):
-            yr, yi = pi_fft_pi_layout_pallas(c[0], c[1], p)
-            return yr * inv_rn, yi * inv_rn
     elif impl == "einsum":
         # phased einsum model, all-float plane ops (the axon relay cannot
         # lower complex inside While bodies)
@@ -160,7 +156,6 @@ def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
 
         from ..models.direct_dft import (
             funnel_einsum_planes,
-            pi_dft_einsum_planes,
             tube_einsum_block,
             tube_einsum_planes,
         )
@@ -173,10 +168,6 @@ def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
             def tube_body(c):
                 tr, ti = tube_einsum_planes(c[0], c[1], n, p)
                 return tr * inv_rs, ti * inv_rs
-
-            def full_body(c):
-                yr, yi = pi_dft_einsum_planes(c[0], c[1], p)
-                return yr * inv_rn, yi * inv_rn
         else:
             # capacity-lifted regime: the timed unit is ONE block
             # program (all s/kblock blocks are shape- and work-
@@ -194,27 +185,17 @@ def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
                 )
                 return cr, ci
 
-            full_body = None  # full = funnel + blocked tube, host-level
-
-        return funnel_body, tube_body, full_body
+        return funnel_body, tube_body
     elif n >= SCAN_MIN_N:
         def tube_body(c):
             tr, ti = tube_scan(c[0], c[1], n, p)
             return tr * inv_rs, ti * inv_rs
-
-        def full_body(c):
-            yr, yi = pi_fft_pi_layout_scan(c[0], c[1], p, tables)
-            return yr * inv_rn, yi * inv_rn
     else:
         def tube_body(c):
             tr, ti = tube(c[0], c[1], n, p, tables)
             return tr * inv_rs, ti * inv_rs
 
-        def full_body(c):
-            yr, yi = pi_fft_pi_layout(c[0], c[1], p, tables)
-            return yr * inv_rn, yi * inv_rn
-
-    return funnel_body, tube_body, full_body
+    return funnel_body, tube_body
 
 
 _warned_large_p: set[tuple[int, int]] = set()
@@ -324,7 +305,7 @@ class JaxBackend:
             # (block_until_ready does not wait on the relay — see module
             # docstring).  Tube iterates on (p, s) planes; its input
             # content is irrelevant to its cost, so reshaped input works.
-            funnel_body, tube_body, full_body = _loop_bodies(
+            funnel_body, tube_body = _loop_bodies(
                 n, p, self._impl, kblock
             )
             # The einsum tube does Theta(s^2) work per application; at

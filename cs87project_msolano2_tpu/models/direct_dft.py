@@ -158,39 +158,47 @@ def _tube_rows_apply(sr, si, kb, s: int):
     return yr, yi
 
 
+def _tube_rows_scan(sr, si, kb, s: int, block: int | None = None):
+    """_tube_rows_apply streamed over row sub-blocks of `kb` with a
+    lax.scan, keeping the materialized (block, s) twiddle gather at
+    ~2^22 entries regardless of how many output rows are requested.
+    Returns (..., len(kb)) planes."""
+    import jax
+
+    nrows = kb.shape[0]
+    if block is None:
+        block = max(min(nrows, (1 << 22) // s), 1)
+    if block >= nrows:
+        return _tube_rows_apply(sr, si, kb, s)
+    if nrows % block:
+        raise ValueError(
+            f"tube block={block} must divide the {nrows} requested rows "
+            "(auto-chosen blocks are powers of two and always do)"
+        )
+
+    def step(carry, kb_blk):
+        return carry, _tube_rows_apply(sr, si, kb_blk, s)
+
+    _, (yrs, yis) = jax.lax.scan(step, None, kb.reshape(nrows // block, block))
+    # (nsteps, ..., block) -> (..., nrows): blocks are consecutive rows
+    yr = jnp.moveaxis(yrs, 0, -2).reshape(*sr.shape[:-1], nrows)
+    yi = jnp.moveaxis(yis, 0, -2).reshape(*si.shape[:-1], nrows)
+    return yr, yi
+
+
 def tube_einsum_planes(sr, si, n: int, p: int, block: int | None = None):
     """Tube phase as a blockwise dense einsum: per-segment s-point DIF
     matrix B[k, j] = W_s^{rev_s(k) * j} applied over the trailing axis.
 
     sr/si: (..., s) -> (..., s).  B rows are generated on the fly inside
-    a lax.scan over output-row blocks (_tube_rows_apply).  Memory
+    a lax.scan over output-row blocks (_tube_rows_scan).  Memory
     O(block * s) at any n; the contraction itself is MXU work.
     """
-    import jax
-
     s = sr.shape[-1]
     if s == 1:
         return sr, si
     revk = jnp.asarray(bit_reverse_indices(s).astype(np.int32))
-
-    if block is None:
-        block = max(min(s, (1 << 22) // s), 1)
-    if block >= s:
-        return _tube_rows_apply(sr, si, revk, s)
-    if s % block:
-        raise ValueError(
-            f"tube block={block} must divide segment length s={s} "
-            "(auto-chosen blocks are powers of two and always do)"
-        )
-
-    def step(carry, kb):
-        return carry, _tube_rows_apply(sr, si, kb, s)
-
-    _, (yrs, yis) = jax.lax.scan(step, None, revk.reshape(s // block, block))
-    # (nsteps, ..., p, block) -> (..., p, s): blocks are consecutive k
-    yr = jnp.moveaxis(yrs, 0, -2).reshape(*sr.shape[:-1], s)
-    yi = jnp.moveaxis(yis, 0, -2).reshape(*si.shape[:-1], s)
-    return yr, yi
+    return _tube_rows_scan(sr, si, revk, s, block)
 
 
 def tube_einsum_block(sr, si, k0, n: int, p: int, kblock: int):
@@ -207,13 +215,18 @@ def tube_einsum_block(sr, si, k0, n: int, p: int, kblock: int):
     calls per application, not s // kblock compiles).
 
     sr/si: (..., s) planes -> (..., kblock) planes of rows k0..k0+kblock.
+
+    Internally streamed by _tube_rows_scan so the materialized twiddle
+    gather stays at ~2^22 entries: kblock bounds the program's TOTAL
+    work for the relay budget, while the scan bounds its PEAK memory
+    (at s=2^15 a one-shot gather would be 2^28-entry/1 GB tensors).
     """
     import jax
 
     s = sr.shape[-1]
     revk_all = jnp.asarray(bit_reverse_indices(s).astype(np.int32))
     kb = jax.lax.dynamic_slice(revk_all, (k0,), (kblock,))
-    return _tube_rows_apply(sr, si, kb, s)
+    return _tube_rows_scan(sr, si, kb, s)
 
 
 def tube_einsum_planes_hostblocked(sr, si, n: int, p: int, kblock: int,

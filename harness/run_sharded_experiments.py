@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""Multi-chip speedup dataset: per-device timing of the sharded pi-FFT.
+
+The reference's headline evidence is measured speedup under the
+communication-free decomposition (13.4x on GPU, 21.4x on Xeon Phi —
+BASELINE.md).  This repo's multi-chip analogue is parallel/pi_shard.py:
+each device of a p-mesh runs ONE funnel chain plus its local tube, with
+machine-checked zero collectives in the compiled HLO
+(tests/test_parallel.py::test_pi_fft_sharded_is_collective_free).
+
+Because the computation is communication-free, device i's wall time on
+a real p-device mesh IS the wall time of its shard-local program — the
+devices never wait on each other.  This script therefore times the
+shard-local body (models.pi_fft.funnel_single + tube, exactly what
+pi_fft_sharded's device_fn runs) as a single-device jit per (n, p) and
+records per-processor phase times in the reference TSV contract.  The
+same modeling argument the reference itself makes: "because processors
+share nothing after init, distributed behavior is fully represented by
+P independent threads in one address space" (SURVEY.md §4).  What it
+does NOT capture is per-device dispatch overhead on a real pod (~us
+scale, constant in n) — the law fit, which regresses against n-scaled
+work terms, is insensitive to it.
+
+Before timing, the script cross-checks the REAL 8-virtual-device mesh:
+pi_fft_sharded on a CPU mesh must equal the single-device pi-FFT bit
+for bit (the dryrun recipe, __graft_entry__.dryrun_multichip).
+
+Output: datasets/fourier-parallel-pi-sharded-results.tsv
+(n  p  total_ms  funnel_ms  tube_ms — per-DEVICE times; analysis model:
+per-processor, auto-selected since the filename matches no on-chip or
+serialized backend pattern).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from harness.run_experiments import done_counts, parse_grid  # noqa: E402
+
+from cs87project_msolano2_tpu.models.pi_fft import (  # noqa: E402
+    funnel_single,
+    tube,
+    tube_scan,
+)
+from cs87project_msolano2_tpu.ops.twiddle import twiddle_tables  # noqa: E402
+from cs87project_msolano2_tpu.utils.timing import time_ms  # noqa: E402
+
+# past this segment length the unrolled tube's XLA compile time blows up
+# (backends/jax_backend.py::SCAN_MIN_N) — use the stage-scan tube.
+# IMPORTANT: every cell of one sweep must use the SAME tube
+# implementation — the scan tube carries per-stage overhead the
+# unrolled tube doesn't, and a grid that mixes them puts the extra cost
+# only in the small-p cells, inflating empirical speedup (observed:
+# 104x "speedup" at n=2^17 p=32 when the p=1 baseline alone used the
+# scan tube).  The default grid (n <= 2^17 = the reference's Xeon Phi
+# maximum) stays below this threshold everywhere.
+SCAN_MIN_S = 1 << 18
+
+
+def mesh_crosscheck(n: int = 1 << 12) -> None:
+    """The real virtual-device mesh must reproduce the single-device
+    pi-FFT exactly (same recipe as the driver's dryrun_multichip)."""
+    from jax.sharding import Mesh
+
+    from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
+    from cs87project_msolano2_tpu.parallel.pi_shard import pi_fft_sharded
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    mesh = Mesh(np.array(devs[:8]), ("p",))
+    rng = np.random.default_rng(0)
+    xr = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    xi = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    sr, si = pi_fft_sharded(xr, xi, mesh)
+    rr, ri = pi_fft_pi_layout(xr, xi, 8)
+    err = max(
+        float(jnp.max(jnp.abs(sr - rr.reshape(-1)))),
+        float(jnp.max(jnp.abs(si - ri.reshape(-1)))),
+    )
+    scale = float(jnp.max(jnp.abs(rr)))
+    assert err / scale < 1e-6, f"mesh cross-check failed: {err / scale:.2e}"
+    print(f"# 8-device mesh cross-check ok (n={n}, rel err "
+          f"{err / scale:.1e})", file=sys.stderr)
+
+
+def device_fns(n: int, p: int):
+    """jitted shard-local phases for device 0 of a p-mesh (all devices
+    do identical-shape work — funnel_single's chain length log2(p) and
+    the tube's segment n/p do not depend on the device index)."""
+    tables = twiddle_tables(n)
+    s = n // p
+    tube_f = tube_scan if s >= SCAN_MIN_S else tube
+
+    @jax.jit
+    def funnel_f(xr, xi):
+        return funnel_single(xr, xi, 0, p, tables)
+
+    @jax.jit
+    def tube_only(fr, fi):
+        if tube_f is tube:
+            return tube_f(fr, fi, n, p, tables)
+        return tube_f(fr, fi, n, p)
+
+    @jax.jit
+    def full(xr, xi):
+        fr, fi = funnel_single(xr, xi, 0, p, tables)
+        if tube_f is tube:
+            return tube_f(fr, fi, n, p, tables)
+        return tube_f(fr, fi, n, p)
+
+    return funnel_f, tube_only, full
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n-grid", default="2048..131072",
+                    help="default matches the reference Phi sweep "
+                         "(xeonphi run-experiments: n=16384..131072 plus "
+                         "the smaller committed grid)")
+    ap.add_argument("--p-grid", default="1..32")
+    ap.add_argument("-T", "--reps", type=int, default=10)
+    ap.add_argument("--out", default=os.path.join(REPO, "datasets"))
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    mesh_crosscheck()
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(
+        args.out, "fourier-parallel-pi-sharded-results.tsv"
+    )
+    done = done_counts(path)
+
+    ns = parse_grid(args.n_grid)
+    ps = parse_grid(args.p_grid)
+    cells = [(n, p) for n in ns for p in ps if p <= n]
+    rng = np.random.default_rng(args.seed)
+
+    with open(path, "a") as fh:
+        for n, p in cells:
+            todo = args.reps - done[(n, p)]
+            if todo <= 0:
+                continue
+            xr = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+            xi = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+            funnel_f, tube_only, full = device_fns(n, p)
+            for _ in range(todo):
+                # phase timers compose: total := funnel + tube, the
+                # reference's nested-timer contract (jax_backend.run)
+                if p == 1:
+                    funnel_ms = 0.0  # empty chain, log2(1) = 0 stages
+                    fr, fi = funnel_f(xr, xi)
+                else:
+                    funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=3)
+                tube_ms, _ = time_ms(tube_only, fr, fi, reps=3)
+                fh.write(f"{n}\t{p}\t{funnel_ms + tube_ms:.6f}"
+                         f"\t{funnel_ms:.6f}\t{tube_ms:.6f}\n")
+                fh.flush()
+            print(f"# sharded n={n} p={p} done", file=sys.stderr)
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,3 +119,45 @@ def test_poisson3d(devices8):
     u = np.array(u)
     u -= u.mean()
     assert rel_err(u, u_true - u_true.mean()) < 1e-3
+
+
+def test_sharded_harness_device_fns_correct():
+    """The per-device timing harness (harness/run_sharded_experiments)
+    times funnel_single + tube as the shard-local program; its output
+    for device 0 must equal segment 0 of the full pi-FFT — otherwise the
+    committed multi-chip dataset times the wrong computation."""
+    import importlib.util
+    import os
+    import sys
+
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "run_sharded_experiments",
+        os.path.join(repo, "harness", "run_sharded_experiments.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # the module sets JAX_PLATFORMS=cpu itself; under pytest that's
+    # already the conftest environment
+    sys.modules["run_sharded_experiments"] = mod
+    spec.loader.exec_module(mod)
+
+    n, p = 2048, 8
+    rng = np.random.default_rng(3)
+    xr = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    xi = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    funnel_f, tube_only, full = mod.device_fns(n, p)
+    fr, fi = funnel_f(xr, xi)
+    tr, ti = tube_only(fr, fi)
+    rr, ri = pi_fft_pi_layout(xr, xi, p)
+    seg_r = np.asarray(rr).reshape(p, n // p)[0]
+    seg_i = np.asarray(ri).reshape(p, n // p)[0]
+    assert np.max(np.abs(np.asarray(tr).ravel() - seg_r)) < 1e-3
+    assert np.max(np.abs(np.asarray(ti).ravel() - seg_i)) < 1e-3
+    # and the full composition agrees with the phase-by-phase path
+    ar, ai = full(xr, xi)
+    assert np.array_equal(np.asarray(ar), np.asarray(tr))
+    assert np.array_equal(np.asarray(ai), np.asarray(ti))
