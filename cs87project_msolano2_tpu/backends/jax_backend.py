@@ -329,13 +329,19 @@ class JaxBackend:
                 # loop runs log2(p) times, …pthreads.c:419) — the body is
                 # an empty program that XLA folds away, which the slope
                 # method cannot (and need not) resolve
+                # auto_window: sweep cells are visited in magnitude-
+                # adjacent order, so seed each fresh body's slope window
+                # from the last resolved one (skips most of the
+                # escalation ladder's remote recompiles); not used where
+                # an explicit window is passed (einsum's tube_kw)
                 funnel_ms = 0.0 if p == 1 else loop_slope_ms(
-                    funnel_body, (xr, xi), reps=reps
+                    funnel_body, (xr, xi), reps=reps, auto_window=True
                 )
                 tube_ms = tube_mult * loop_slope_ms(
                     tube_body,
                     (xr.reshape(p, n // p), xi.reshape(p, n // p)),
                     reps=reps,
+                    auto_window=not tube_kw,
                     **tube_kw,
                 )
             except LoopSlopeUnresolved as e:

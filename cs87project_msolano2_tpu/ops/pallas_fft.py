@@ -105,16 +105,19 @@ def _check_tail(tail: int, tile: int) -> None:
 def _tile_plan(tile: int, tail: int = LANE):
     """Mixed-radix plan for the elementwise levels of a tile-point DIF.
 
-    Pairs of radix-2 levels are fused into radix-4 stages (two levels in
-    one VMEM traversal, 3 complex muls per 4 points instead of 4 — the
-    W_m^{m/4} = -i rotation is free as a re/im swap).  A radix-4 stage
-    needs q = half/2 >= LANE; a trailing odd level (or the last >=LANE
-    level) stays radix-2.  Elementwise levels stop once sub-transforms
-    reach `tail` points (the MXU finishes those as one dense matmul).
+    Triples of radix-2 levels are fused into radix-8 stages and pairs
+    into radix-4 stages — each stage is ONE VMEM traversal of the data,
+    and the traversal count (with its inter-stage interleave shuffles),
+    not arithmetic, is what the round-4 phase breakdown showed the VPU
+    pass is bound by.  A radix-8 stage needs its finest slab
+    q = half/4 >= LANE; radix-4 needs half/2 >= LANE; leftovers stay
+    radix-2.  Elementwise levels stop once sub-transforms reach `tail`
+    points (the MXU finishes those as one dense matmul).
     Returns (steps, tables):
-      steps  — tuples ("r4", q_rows) consuming 6 table refs (w1, w2,
-               w3 = w1*w2 as re/im pairs) or ("r2", half_rows) consuming
-               2 refs;
+      steps  — tuples ("r8", q_rows) consuming 6 table refs (the three
+               levels' full tables, sliced per-slab in the kernel),
+               ("r4", q_rows) consuming 6 refs (w1, w2, w3 = w1*w2 as
+               re/im pairs), or ("r2", half_rows) consuming 2 refs;
       tables — the flat numpy list, each (rows, LANE) float32.
     """
     full = twiddle_tables(tile)
@@ -123,7 +126,17 @@ def _tile_plan(tile: int, tail: int = LANE):
     l = 0
     while l < nlev:
         half = tile >> (l + 1)
-        if l + 1 < nlev:  # radix-4: fuse levels l, l+1
+        if l + 2 < nlev and (half >> 2) >= LANE:
+            # radix-8: fuse levels l, l+1, l+2 in one traversal
+            q = half >> 2
+            steps.append(("r8", q // LANE))
+            for lev in (l, l + 1, l + 2):
+                wr, wi = full[lev]
+                tables.append(wr.reshape(-1, LANE))
+                tables.append(wi.reshape(-1, LANE))
+            l += 3
+        elif l + 1 < nlev and (half >> 1) >= LANE:
+            # radix-4: fuse levels l, l+1
             q = half // 2
             w1r, w1i = (t[:q] for t in full[l])
             w2r, w2i = full[l + 1]
@@ -149,10 +162,49 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
     Returns (yr, yi) shaped (rows, LANE)."""
     rows = xr.shape[0]
 
+    def cmul(ar, ai, wr, wi):
+        return ar * wr - ai * wi, ar * wi + ai * wr
+
     # elementwise DIF stages while half >= one lane row
     ti_ = 0  # table cursor
     for kind, qrows in steps:
-        if kind == "r4":
+        if kind == "r8":
+            # three radix-2 DIF levels fused into one traversal: the
+            # 8-slab view [a0..a7] goes through in-place butterflies
+            # (i, i+4) with level-l twiddles, then (i, i+2) within each
+            # half with level-(l+1) twiddles, then (i, i+1) with
+            # level-(l+2) twiddles — table slices per slab position.
+            w1r_t, w1i_t, w2r_t, w2i_t, w3r_t, w3i_t = (
+                t[:, :] for t in tw[ti_ : ti_ + 6]
+            )
+            ti_ += 6
+            q = qrows
+            xq = xr.reshape(-1, 8, q, LANE)
+            yq = xi.reshape(-1, 8, q, LANE)
+            v = [(xq[:, i], yq[:, i]) for i in range(8)]
+            nxt = [None] * 8
+            for i in range(4):  # level l: half = 4q
+                (ar, ai), (br, bi) = v[i], v[i + 4]
+                nxt[i] = (ar + br, ai + bi)
+                nxt[i + 4] = cmul(ar - br, ai - bi,
+                                  w1r_t[i * q : (i + 1) * q],
+                                  w1i_t[i * q : (i + 1) * q])
+            v, nxt = nxt, [None] * 8
+            for h in (0, 4):  # level l+1: half = 2q, same table each 4-block
+                for j in range(2):
+                    (ar, ai), (br, bi) = v[h + j], v[h + j + 2]
+                    nxt[h + j] = (ar + br, ai + bi)
+                    nxt[h + j + 2] = cmul(ar - br, ai - bi,
+                                          w2r_t[j * q : (j + 1) * q],
+                                          w2i_t[j * q : (j + 1) * q])
+            v, nxt = nxt, [None] * 8
+            for b0 in range(0, 8, 2):  # level l+2: half = q
+                (ar, ai), (br, bi) = v[b0], v[b0 + 1]
+                nxt[b0] = (ar + br, ai + bi)
+                nxt[b0 + 1] = cmul(ar - br, ai - bi, w3r_t, w3i_t)
+            xr = jnp.stack([t[0] for t in nxt], axis=1).reshape(rows, LANE)
+            xi = jnp.stack([t[1] for t in nxt], axis=1).reshape(rows, LANE)
+        elif kind == "r4":
             w1r, w1i, w2r, w2i, w3r, w3i = (
                 t[:, :] for t in tw[ti_ : ti_ + 6]
             )
@@ -166,15 +218,11 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
             sr, si = a0r - a2r, a0i - a2i    # a0 - a2
             tr_, tii = a1r - a3r, a1i - a3i  # a1 - a3
             y0r, y0i = e0r + e1r, e0i + e1i
-            d0r, d0i = e0r - e1r, e0i - e1i
-            y1r = d0r * w2r - d0i * w2i
-            y1i = d0r * w2i + d0i * w2r
+            y1r, y1i = cmul(e0r - e1r, e0i - e1i, w2r, w2i)
             mr, mi = sr + tii, si - tr_      # s - i*t
             pr, pi_ = sr - tii, si + tr_     # s + i*t
-            y2r = mr * w1r - mi * w1i
-            y2i = mr * w1i + mi * w1r
-            y3r = pr * w3r - pi_ * w3i
-            y3i = pr * w3i + pi_ * w3r
+            y2r, y2i = cmul(mr, mi, w1r, w1i)
+            y3r, y3i = cmul(pr, pi_, w3r, w3i)
             xr = jnp.stack((y0r, y1r, y2r, y3r), axis=1).reshape(rows, LANE)
             xi = jnp.stack((y0i, y1i, y2i, y3i), axis=1).reshape(rows, LANE)
         else:
@@ -186,9 +234,7 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
             ar, br = xr4[:, 0], xr4[:, 1]
             ai, bi = xi4[:, 0], xi4[:, 1]
             tr, ti2 = ar + br, ai + bi
-            dr, di = ar - br, ai - bi
-            ur = dr * wr - di * wi
-            ui = dr * wi + di * wr
+            ur, ui = cmul(ar - br, ai - bi, wr, wi)
             xr = jnp.stack((tr, ur), axis=1).reshape(rows, LANE)
             xi = jnp.stack((ti2, ui), axis=1).reshape(rows, LANE)
 
@@ -230,11 +276,14 @@ def _tile_fft_kernel(steps, precision, *refs):
     """Pallas kernel body: full DIF FFT of one (tile/128, 128) block.
 
     refs = (xr, xi, <per-step tables>, btr, bti, or_, oi) block refs;
-    `steps` is the mixed-radix plan from _tile_plan (radix-4 stages fuse
-    two DIF levels per VMEM traversal, a -i rotation riding free as a
-    re/im swap; see _tile_plan).  The math lives in _tile_fft_compute.
+    `steps` is the mixed-radix plan from _tile_plan: radix-8 stages fuse
+    three DIF levels per VMEM traversal (6 refs — the three levels'
+    full tables, sliced per slab in the kernel), radix-4 stages fuse
+    two (6 refs — w1, w2, precombined w3 = w1*w2, with a -i rotation
+    riding free as a re/im swap), radix-2 levels take 2 refs.  The math
+    lives in _tile_fft_compute.
     """
-    ntab = sum(6 if kind == "r4" else 2 for kind, _ in steps)
+    ntab = sum(6 if kind in ("r8", "r4") else 2 for kind, _ in steps)
     xr_ref, xi_ref = refs[0], refs[1]
     tw = refs[2 : 2 + ntab]
     btr_ref, bti_ref = refs[2 + ntab], refs[3 + ntab]
