@@ -3,6 +3,9 @@
 # run-experiments-and-analyze-results / replicate), one level up from the
 # native core's own Makefile.
 
+# analyze-datasets uses pipefail, which /bin/sh (dash) lacks
+SHELL := /bin/bash
+
 .PHONY: all clean recompile test bench replicate \
         run-experiments run-experiments-and-analyze-results analyze \
         analyze-datasets

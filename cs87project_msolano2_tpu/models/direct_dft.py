@@ -36,6 +36,28 @@ import numpy as np
 from ..ops.bits import bit_reverse_indices
 
 MAX_N = 1 << 13  # W is n^2 complex entries; 8192^2 * 8 B = 512 MB
+
+
+def _einsum_f32(spec: str, a, b):
+    """jnp.einsum pinned to Precision.HIGHEST (XLA's full f32 matmul
+    emulation on the MXU).
+
+    The MXU's DEFAULT single-pass bf16 einsum measures ~2e-3 relative
+    error on these dense contractions — the first on-chip einsum verify
+    failed exactly there — and the 3-pass bf16 error split (the pallas
+    tail's SPLIT3) has a ~2^-16 operand-representation floor that put
+    the p=1 identity funnel at 5e-5, over the 1e-5 bound.  Unlike the
+    pallas tail (where HIGHEST was the single largest cost in the whole
+    transform), the einsum phases are twiddle-GATHER-bound on the
+    accelerator (~34 GB gather vs ~0.2 s of even-HIGHEST MXU work per
+    blocked tube application at s=2^16; measured timing shift between
+    precision modes < 10%, within run noise), so full precision is the
+    right trade here."""
+    import jax
+
+    return jnp.einsum(spec, a, b, precision=jax.lax.Precision.HIGHEST)
+
+
 # funnel coefficient planes hold p*n floats x2; 2^24 = 128 MB — beyond
 # that the (n, p) combination is out of the einsum backend's capacity
 COEF_MAX_ENTRIES = 1 << 24
@@ -126,12 +148,9 @@ def funnel_einsum_planes(xr, xi, p: int):
     cr, ci = (jnp.asarray(t) for t in funnel_coeff_planes(n, p))
     xbr = xr.reshape(*xr.shape[:-1], p, n // p)
     xbi = xi.reshape(*xi.shape[:-1], p, n // p)
-    yr = jnp.einsum("pmj,...mj->...pj", cr, xbr) - jnp.einsum(
-        "pmj,...mj->...pj", ci, xbi
-    )
-    yi = jnp.einsum("pmj,...mj->...pj", cr, xbi) + jnp.einsum(
-        "pmj,...mj->...pj", ci, xbr
-    )
+    spec = "pmj,...mj->...pj"
+    yr = _einsum_f32(spec, cr, xbr) - _einsum_f32(spec, ci, xbi)
+    yi = _einsum_f32(spec, cr, xbi) + _einsum_f32(spec, ci, xbr)
     return yr, yi
 
 
@@ -149,12 +168,9 @@ def _tube_rows_apply(sr, si, kb, s: int):
     j = jnp.arange(s, dtype=jnp.int32)
     idx = (kb[:, None] * j[None, :]) & jnp.int32(s - 1)
     wr, wi = wr_t[idx], wi_t[idx]
-    yr = jnp.einsum("...j,kj->...k", sr, wr) - jnp.einsum(
-        "...j,kj->...k", si, wi
-    )
-    yi = jnp.einsum("...j,kj->...k", sr, wi) + jnp.einsum(
-        "...j,kj->...k", si, wr
-    )
+    spec = "...j,kj->...k"
+    yr = _einsum_f32(spec, sr, wr) - _einsum_f32(spec, si, wi)
+    yi = _einsum_f32(spec, sr, wi) + _einsum_f32(spec, si, wr)
     return yr, yi
 
 

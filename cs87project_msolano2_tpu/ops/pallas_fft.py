@@ -599,6 +599,7 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     n = xr.shape[-1]
     tile = _choose_tile(n, tile)
     R = n // tile
+    explicit_cb = cb is not None
     if cb is None:
         # VMEM-aware default: the long-range kernel's double-buffered
         # io blocks plus its butterfly stack temps come to ~12
@@ -611,6 +612,20 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     if cb % LANE or tile % cb:
         raise ValueError(f"cb={cb} must divide tile={tile} and be a "
                          f"multiple of {LANE}")
+    if not interpret and R * cb > (1 << 18):
+        # mirror the auto-chooser's ceiling for EXPLICIT cb too: the
+        # long-range kernel's ~12 block-planes at R*cb floats overflow
+        # the 16 MB scoped VMEM past 2^18 (measured 16.75M at 2^19) —
+        # fail with the applicable remedy instead of a backend OOM.
+        # (The auto path can get here too: cb bottoms out at LANE, so
+        # R > 2^11 — a tiny tile at huge n — has no feasible cb at all.)
+        hint = ("reduce cb or pass cb=None" if explicit_cb else
+                f"increase tile ({tile} leaves R={R} long-range rows, "
+                f"more than any column block can hold)")
+        raise ValueError(
+            f"long-range blocks R={R} x cb={cb} exceed scoped VMEM "
+            f"(R*cb must be <= {1 << 18}); {hint}"
+        )
     _check_tail(tail, tile)  # before any kernel runs
     Q = tile // LANE
     qb = cb // LANE
