@@ -60,6 +60,10 @@ def measure_tpu_ms() -> float:
     # intermediates force 1 MB blocks) measures 0.108 ms (split3) vs
     # rql's 0.089 ms at N=2^20: correct and supported (tests/
     # test_pallas.py), just not the headline.
+    # (the tile plan keeps radix-8 stages off sub-2-row slabs: an 8-way
+    # interleave of 1-row slabs measured 3x slower than finishing the
+    # last pre-tail levels radix-4 — with that guard tail=128 measures
+    # ~0.085 ms, on par with tail=256)
     configs = (
         ("rql", 1 << 16, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 12, 256),

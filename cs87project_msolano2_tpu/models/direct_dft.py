@@ -83,10 +83,13 @@ def dft_direct(x, dtype=np.complex64):
     if dtype == np.complex128:
         x = np.asarray(x, dtype=np.complex128)
         return np.einsum("kj,...j->...k", dft_matrix(x.shape[-1], dtype), x)
+    import jax
+
     x = jnp.asarray(x)
     n = x.shape[-1]
     w = jnp.asarray(dft_matrix(n, dtype))
-    return jnp.einsum("kj,...j->...k", w, x.astype(w.dtype))
+    return jnp.einsum("kj,...j->...k", w, x.astype(w.dtype),
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def dft_direct_pi(x, p: int = 1, dtype=np.complex64):
@@ -99,11 +102,14 @@ def dft_direct_pi(x, p: int = 1, dtype=np.complex64):
     (p, n/p, n) so row block Pi holds exactly Pi's bins — each block's
     contraction touches only the (replicated) input.
     """
+    import jax
+
     x = jnp.asarray(x)
     n = x.shape[-1]
     w = dft_matrix(n, dtype)[bit_reverse_indices(n)]  # pi-layout bin order
     w_blocks = jnp.asarray(w.reshape(p, n // p, n))
-    y = jnp.einsum("psj,...j->...ps", w_blocks, x.astype(w_blocks.dtype))
+    y = jnp.einsum("psj,...j->...ps", w_blocks, x.astype(w_blocks.dtype),
+                   precision=jax.lax.Precision.HIGHEST)
     return y.reshape(*x.shape[:-1], n)
 
 
@@ -286,12 +292,9 @@ def dft_direct_pi_planes(xr, xi, p: int = 1):
     w = dft_matrix(n, np.complex64)[bit_reverse_indices(n)].reshape(p, n // p, n)
     wr = jnp.asarray(np.ascontiguousarray(w.real))
     wi = jnp.asarray(np.ascontiguousarray(w.imag))
-    yr = jnp.einsum("psj,...j->...ps", wr, xr) - jnp.einsum(
-        "psj,...j->...ps", wi, xi
-    )
-    yi = jnp.einsum("psj,...j->...ps", wr, xi) + jnp.einsum(
-        "psj,...j->...ps", wi, xr
-    )
+    spec = "psj,...j->...ps"
+    yr = _einsum_f32(spec, wr, xr) - _einsum_f32(spec, wi, xi)
+    yi = _einsum_f32(spec, wr, xi) + _einsum_f32(spec, wi, xr)
     return (
         yr.reshape(*xr.shape[:-1], n),
         yi.reshape(*xi.shape[:-1], n),

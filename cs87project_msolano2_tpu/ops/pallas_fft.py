@@ -110,7 +110,9 @@ def _tile_plan(tile: int, tail: int = LANE):
     and the traversal count (with its inter-stage interleave shuffles),
     not arithmetic, is what the round-4 phase breakdown showed the VPU
     pass is bound by.  A radix-8 stage needs its finest slab
-    q = half/4 >= LANE; radix-4 needs half/2 >= LANE; leftovers stay
+    q = half/4 >= 2*LANE (two lane rows: an 8-way interleave of 1-row
+    slabs is all sublane shuffling, measured 3x slower than finishing
+    those levels radix-4); radix-4 needs half/2 >= LANE; leftovers stay
     radix-2.  Elementwise levels stop once sub-transforms reach `tail`
     points (the MXU finishes those as one dense matmul).
     Returns (steps, tables):
@@ -126,8 +128,11 @@ def _tile_plan(tile: int, tail: int = LANE):
     l = 0
     while l < nlev:
         half = tile >> (l + 1)
-        if l + 2 < nlev and (half >> 2) >= LANE:
-            # radix-8: fuse levels l, l+1, l+2 in one traversal
+        if l + 2 < nlev and (half >> 2) >= 2 * LANE:
+            # radix-8: fuse levels l, l+1, l+2 in one traversal.  Slabs
+            # must keep >= 2 lane rows: an 8-way interleave of 1-row
+            # slabs is all sublane shuffling (measured 3x slower than
+            # finishing the last pre-tail levels radix-4)
             q = half >> 2
             steps.append(("r8", q // LANE))
             for lev in (l, l + 1, l + 2):
@@ -612,7 +617,7 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     if cb % LANE or tile % cb:
         raise ValueError(f"cb={cb} must divide tile={tile} and be a "
                          f"multiple of {LANE}")
-    if not interpret and R * cb > (1 << 18):
+    if not interpret and R > 1 and R * cb > (1 << 18):
         # mirror the auto-chooser's ceiling for EXPLICIT cb too: the
         # long-range kernel's ~12 block-planes at R*cb floats overflow
         # the 16 MB scoped VMEM past 2^18 (measured 16.75M at 2^19) —
