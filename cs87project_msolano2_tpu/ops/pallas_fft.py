@@ -163,8 +163,10 @@ def _tile_plan(tile: int, tail: int = LANE):
 def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
     """The tile-point DIF on in-VMEM (rows, LANE) planes: the mixed-radix
     elementwise stages from `steps` followed by the dense MXU tail.
-    Shared by the gridded tile kernel and the fused single-pass kernel.
-    Returns (yr, yi) shaped (rows, LANE)."""
+    Shared by every tile-kernel body (the row-blocked tile_fft_grid and
+    the row-gridded _tile_fft_rows).  Batch-agnostic: `rows` may span any
+    whole number of tiles — every stage reshape carries a leading -1 that
+    absorbs the extra tiles.  Returns (yr, yi) shaped (rows, LANE)."""
     rows = xr.shape[0]
 
     def cmul(ar, ai, wr, wi):

@@ -211,9 +211,15 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
     if window is not None:
         k1, k2 = window
     elif auto_window and kind in _GLOBAL_WINDOW:
-        # fresh body: start from the last resolved window (see
-        # _GLOBAL_WINDOW) instead of the escalation ladder's floor
-        k1, k2 = _GLOBAL_WINDOW[kind]
+        # fresh body: seed only k2 from the last resolved window (see
+        # _GLOBAL_WINDOW) — that is the expensive part of the escalation
+        # ladder to skip.  k1 stays at the caller's small default because
+        # the k1 program runs BEFORE any budget correction can apply:
+        # funnel and tube bodies alternate under the same kind, and a k1
+        # sized for the faster op could put the slower op's first
+        # program past the relay's ~10 s worker-kill threshold.  The
+        # k2-budget rescale below still shrinks k2 once t1 is known.
+        k2 = max(k2, _GLOBAL_WINDOW[kind][1])
 
     f1 = make(k1)
     t1 = _timed_fetch(f1, args, reps=reps)
