@@ -9,12 +9,28 @@ significance of the fit, and plot empirical + fitted speedup.  This is
 the project's integration test: "the implementation scales as designed".
 
 This is a from-scratch Python port of that *discipline* (R is absent in
-the image): zero-intercept OLS per phase, t-statistic and its tail
-probability (scipy if present, else a normal approximation), empirical
-and fitted speedup tables, and optional matplotlib PDFs mirroring the
-reference's per-n figure layout.  The awk fallback (analyze-results.awk)
-covers machines without numpy, keeping the reference's R -> awk fallback
-philosophy (gpu/cuda/analyze-results:26-36).
+the image), made FALSIFIABLE in round 5 — the reference's single-beta
+significance test cannot reject any positively-correlated data (the
+round-4 einsum sweep measured 894x speedup against a "predicted" 32x
+and still printed Yes).  Three upgrades close that hole:
+
+* the TOTAL is fitted against BOTH phase laws with separate
+  coefficients (the two phases' constants differ by ~800x in some
+  regimes here; the reference's hardware kept them comparable);
+* measurements riding a JAX dispatch pipeline carry a latency-FLOOR
+  column (with a physical sanity bound — see the floor logic in
+  analyze());
+* acceptance requires, besides significance of every material
+  coefficient, the per-cell PREDICTION GATE
+  median |log(measured/predicted)| < log 2 — the fitted law must
+  predict the typical cell within 2x, not merely correlate.
+
+t-statistics use scipy when present, else a normal approximation;
+empirical and fitted speedup tables and optional matplotlib PDFs mirror
+the reference's per-n figure layout.  The awk fallback
+(analyze-results.awk) implements the same criterion for machines
+without numpy, keeping the reference's R -> awk fallback philosophy
+(gpu/cuda/analyze-results:26-36).
 """
 
 from __future__ import annotations
@@ -79,8 +95,9 @@ def load_tsv(path: str) -> tuple[np.ndarray, int]:
 #    dense contractions predict DIFFERENT complexity — funnel is the
 #    (p, p, s)-coefficient einsum, Theta(p*n) ~ n(p-1) total work (0 at
 #    p=1, where the funnel is empty); the tube is a dense s-point DFT
-#    matrix per segment, Theta(p*s^2) = n^2/p.  Fitting the butterfly
-#    law to a dense implementation would test the wrong hypothesis.
+#    matrix per segment — s^2 per processor, with the batch dimension
+#    absorbed by the MXU (see laws()).  Fitting the butterfly law to a
+#    dense implementation would test the wrong hypothesis.
 #  * serialized (CPU backends running all p virtual processors on fewer
 #    real cores: the `serial` backend by construction, and any backend
 #    swept with --oversubscribe, which the harness writes to a distinct
@@ -116,13 +133,32 @@ def laws(n: np.ndarray, p: np.ndarray,
     if model in ("on-chip", "serialized"):
         return n * (p - 1), n * log_s
     if model == "einsum-dense":
-        return n * (p - 1), n * n / p
+        # tube = a (p, s, s) batched dense matvec on the MXU.  TOTAL
+        # flops are p*s^2 = n^2/p, but the committed sweeps show time
+        # constant along fixed s and falling 4x per p-doubling — the
+        # chip absorbs the batch dimension (matvec leaves the MXU's
+        # lanes idle; batching fills them for free), so wall time
+        # tracks the PER-PROCESSOR dense work s^2 = n^2/p^2.  The
+        # round-4 criterion couldn't reject the total-work guess
+        # (894x measured vs "predicts 32x" while printing Yes); the
+        # falsifiable fit did, and this is the hardware-honest law.
+        return n * (p - 1), s * s
     return n * (p - 1) / p, s * log_s
 
 
 def fit_laws(n: np.ndarray, p: np.ndarray,
-             model: str) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Per-COLUMN regressors (total_x, funnel_x, tube_x).
+             model: str) -> tuple[tuple, np.ndarray, np.ndarray]:
+    """Per-COLUMN regressors ((total_funnel_x, total_tube_x), funnel_x,
+    tube_x).
+
+    The total is fitted against BOTH phase laws with separate
+    coefficients (round-4 verdict: the single-beta summed-law fit
+    cannot fail against monotone data — the einsum sweep's funnel and
+    tube constants differ by ~800x, and one beta split the difference
+    while the speedup table showed 894x measured vs "predicts 32x").
+    The reference could get away with one beta because its hardware had
+    comparable phase constants (analyze-results.R:46-50 fits the sum);
+    this framework's regimes don't.
 
     The serialized model is hybrid: total_ms sums over the p virtual
     processors run back-to-back (total-work laws), but the funnel/tube
@@ -134,39 +170,99 @@ def fit_laws(n: np.ndarray, p: np.ndarray,
     fl, tl = laws(n, p, model)
     if model == "serialized":
         pfl, ptl = laws(n, p, "per-processor")
-        return fl + tl, pfl, ptl
-    return fl + tl, fl, tl
+        return (fl, tl), pfl, ptl
+    return (fl, tl), fl, tl
+
+
+# Measurements that ride a JAX dispatch pipeline carry a per-run
+# latency FLOOR: a 2^14-point transform does not run 64x faster than a
+# 2^20-point one on hardware both underutilize (round-4 verdict: the
+# jax total fit was R^2=0.40 purely from this floor).  The fit includes
+# a constant column for them.  That is an implementation property, not
+# a law-model property: the per-device `-sharded-` dataset is
+# per-processor-law data timed through jitted jax calls (dispatch
+# ~tens of us), while the native-C-timed sweeps (serial, pthreads)
+# read the reference's floor-free form.
+FLOOR_MODELS = ("on-chip", "einsum-dense")
+NATIVE_TIMED = ("-serial-", "-pthreads-")
+
+
+def has_floor_for(path: str, model: str) -> bool:
+    base = os.path.basename(path)
+    if any(tag in base for tag in NATIVE_TIMED):
+        return False
+    return model in FLOOR_MODELS or "-sharded-" in base
+
+
+def ls_fit(y: np.ndarray, cols: list[np.ndarray]):
+    """Least squares y ~ sum_i beta_i * cols_i (no implicit intercept).
+
+    Columns are RMS-normalized internally (law columns span ~1e9 in
+    raw units next to a unit floor column; the raw normal equations'
+    conditioning produced garbage standard errors).  Returns (betas,
+    r2, tstats, alphas, df) in the caller's units.  R^2 keeps the
+    zero-intercept convention (1 - SSR / sum(y^2)) so values stay
+    comparable with earlier rounds' logs and the reference's R output.
+    """
+    scales = np.array([max(float(np.sqrt(np.mean(c * c))), 1e-30)
+                       for c in cols])
+    X = np.column_stack([c / s for c, s in zip(cols, scales)])
+    betas_n, *_ = np.linalg.lstsq(X, y, rcond=None)
+    resid = y - X @ betas_n
+    df = max(len(y) - X.shape[1], 1)
+    sigma2 = float(resid @ resid) / df
+    xtx_inv = np.linalg.pinv(X.T @ X)
+    ses = np.sqrt(np.maximum(sigma2 * np.diag(xtx_inv), 0.0))
+    tstats = np.where(ses > 0, betas_n / np.where(ses > 0, ses, 1.0), np.inf)
+    alphas = np.array([t_sf(float(t), df) if math.isfinite(t) else 0.0
+                       for t in tstats])
+    ss_tot = float(y @ y)
+    r2 = 1.0 - float(resid @ resid) / ss_tot if ss_tot > 0 else 0.0
+    return betas_n / scales, r2, tstats, alphas, df
+
+
+LOG2_GATE = math.log(2.0)
+
+
+def prediction_gate(y: np.ndarray, yhat: np.ndarray) -> tuple[bool, float]:
+    """Per-cell prediction-error gate: median |log(measured/predicted)|
+    must be < log 2 (i.e. the fitted law predicts the TYPICAL cell
+    within 2x).  Significance alone cannot catch a law that mispredicts
+    per-cell behavior by 30x while correlating with it (round-4
+    verdict, the einsum speedup table).  Returns (ok, median_abs_log).
+
+    Cells where the law predicts <= 0: a correct zero (the phase is
+    empty there — e.g. funnel at p=1 — and the measurement agrees) is
+    skipped; a nonpositive prediction against a real measurement fails
+    the gate outright."""
+    tiny = 1e-3 * float(np.max(y)) if np.max(y) > 0 else 0.0
+    bad = (yhat <= 0) & (y > tiny)
+    if bad.any():
+        return False, float("inf")
+    both = (yhat > 0) & (y > 0)
+    if not both.any():
+        return True, 0.0
+    err = float(np.median(np.abs(np.log(y[both] / yhat[both]))))
+    return err < LOG2_GATE, err
 
 
 def predicted_total(report: dict, n: np.ndarray, p: np.ndarray,
                     model: str) -> np.ndarray:
-    """Fitted-law total time at (n, p), for speedup tables and figures.
-
-    Serialized: the phase betas predict processor-0's phases, not the
-    summed wall time, so the total fit's single beta applies to the
-    total-work law.  Other models: the reference's two-coefficient
-    prediction beta_f*funnel_law + beta_t*tube_law."""
+    """Fitted-law total time at (n, p), for speedup tables and figures:
+    the TOTAL fit's own coefficients beta_f*funnel_law + beta_t*tube_law
+    (+ the latency floor where the model carries one)."""
     fl, tl = laws(n, p, model)
-    if model == "serialized":
-        return report["total"]["beta"] * (fl + tl)
-    return report["funnel"]["beta"] * fl + report["tube"]["beta"] * tl
+    t = report["total"]
+    return (t.get("beta_f", 0.0) * fl + t.get("beta_t", 0.0) * tl
+            + t.get("floor", 0.0))
 
 
 def zero_intercept_fit(x: np.ndarray, y: np.ndarray):
-    """y ~ 0 + beta*x: returns (beta, r2, tstat, alpha, df)."""
-    sxx = float(np.sum(x * x))
-    if sxx == 0:
-        return 0.0, 0.0, 0.0, 1.0, 0
-    beta = float(np.sum(x * y)) / sxx
-    resid = y - beta * x
-    df = max(len(y) - 1, 1)
-    sigma2 = float(np.sum(resid * resid)) / df
-    se = math.sqrt(sigma2 / sxx) if sigma2 > 0 else 0.0
-    tstat = beta / se if se > 0 else float("inf")
-    ss_tot = float(np.sum(y * y))  # zero-intercept R^2 convention
-    r2 = 1.0 - float(np.sum(resid * resid)) / ss_tot if ss_tot > 0 else 0.0
-    alpha = t_sf(tstat, df) if math.isfinite(tstat) else 0.0
-    return beta, r2, tstat, alpha, df
+    """y ~ 0 + beta*x: returns (beta, r2, tstat, alpha, df).  The
+    reference's single-regressor form, kept for the phase fits of
+    floor-free models."""
+    betas, r2, tstats, alphas, df = ls_fit(y, [x])
+    return float(betas[0]), r2, float(tstats[0]), float(alphas[0]), df
 
 
 def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
@@ -174,22 +270,25 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
     data, degraded = load_tsv(path)
     model = model_for(path, model)
     n, p, total, funnel, tube = data.T
-    total_law, funnel_law, tube_law = fit_laws(n, p, model)
+    (tfl, ttl), funnel_law, tube_law = fit_laws(n, p, model)
+    has_floor = has_floor_for(path, model)
 
     report = {"model": model}
     print(f"== {os.path.basename(path)}: {len(n)} runs, "
           f"n in {sorted(int(v) for v in set(n))}, "
           f"p in {sorted(int(v) for v in set(p))}, "
-          f"law model: {model} ==")
+          f"law model: {model}"
+          f"{' + latency floor' if has_floor else ''} ==")
     if degraded:
         print(f"# excluded {degraded} DEGRADED rows "
               "(dispatch-inclusive fallback timing)")
-    for name, y, x in (
-        ("total", total, total_law),
-        ("funnel", funnel, funnel_law),
-        ("tube", tube, tube_law),
+    for name, y, xcols, colnames in (
+        ("total", total, [tfl, ttl], ["funnel", "tube"]),
+        ("funnel", funnel, [funnel_law], ["funnel"]),
+        ("tube", tube, [tube_law], ["tube"]),
     ):
-        if not np.any(x):
+        kept = [(c, nm) for c, nm in zip(xcols, colnames) if np.any(c)]
+        if not kept:
             # Degenerate grid: the law is identically zero here (e.g. a
             # p=1-only sweep, where funnel_law = n(p-1)/p = 0 — this
             # container's pthreads capacity is 1 core).  The hypothesis
@@ -200,12 +299,84 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
                 else "No"
             print(f"{name:>6}: law = 0 over the whole grid; measured mean "
                   f"{float(np.mean(y)):.3e} ms  law holds: {verdict}")
-            report[name] = dict(beta=0.0, r2=0.0, t=0.0, alpha=1.0,
-                                holds=negligible)
+            report[name] = dict(beta=0.0, beta_f=0.0, beta_t=0.0, floor=0.0,
+                                r2=0.0, t=0.0, alpha=1.0, med_log_err=0.0,
+                                signif=negligible, holds=negligible)
             continue
-        beta, r2, tstat, a, df = zero_intercept_fit(x, y)
-        holds = a < alpha_level and beta > 0
-        verdict = "Yes" if holds else "No"
+
+        def fit(cols, names):
+            betas, r2, tstats, alphas, df = ls_fit(y, cols)
+            return list(betas), r2, list(tstats), list(alphas), df, \
+                list(names)
+
+        cols = [c for c, _ in kept]
+        names = [nm for _, nm in kept]
+        if has_floor:
+            # the floor rides each DISPATCHED run: the total always
+            # dispatches, but a phase whose law is 0 at a cell (funnel
+            # at p=1) never runs there — its floor column is the
+            # law-positive indicator, not all-ones
+            if name == "total":
+                fc = np.ones_like(y)
+            else:
+                fc = (cols[0] > 0).astype(float)
+            if np.any(fc):
+                cols = cols + [fc]
+                names = names + ["floor"]
+        betas, r2, tstats, alphas, df, names = fit(cols, names)
+        # floor sanity: the dispatch floor is a LOWER-bound component of
+        # every dispatched run, so the fitted value can never exceed the
+        # smallest dispatched cell's mean (2x margin for noise).  A
+        # "floor" beyond that — or a negative one — is least squares
+        # using the constant column to absorb model misfit in the
+        # large cells (observed: an "82 ms floor" on the einsum sweep,
+        # 300x its smallest cell); drop the column and refit.
+        if "floor" in names:
+            fi = names.index("floor")
+            disp = cols[fi] > 0
+            cell_means = [float(np.mean(y[disp & (n == nn) & (p == pp)]))
+                          for nn in set(n[disp]) for pp in set(p[disp])
+                          if ((n == nn) & (p == pp) & disp).any()]
+            bound = 2.0 * min(cell_means) if cell_means else 0.0
+            if betas[fi] < 0 or betas[fi] > bound:
+                cols.pop(fi)
+                betas, r2, tstats, alphas, df, names = fit(
+                    cols, [nm for nm in names if nm != "floor"])
+        # a law column whose fitted contribution is a negligible share
+        # of the measurement is noise to this fit: a negative or
+        # insignificant coefficient there says nothing about the law
+        # (the einsum funnel is ~0.1% of total next to the Theta(n^2/p)
+        # tube).  Drop negative-negligible columns; exempt
+        # positive-negligible ones from the significance requirement.
+        ymean = max(float(np.mean(y)), 1e-30)
+        while True:
+            shares = {nm: float(np.mean(b * c)) / ymean
+                      for nm, b, c in zip(names, betas, cols)}
+            drop = [nm for nm in names if nm != "floor"
+                    and betas[names.index(nm)] < 0 and shares[nm] > -0.01]
+            if not drop:
+                break
+            i = names.index(drop[0])
+            cols.pop(i)
+            betas, r2, tstats, alphas, df, names = fit(
+                cols, names[:i] + names[i + 1:])
+            if not names:
+                break
+        # significance is demanded only of coefficients that carry a
+        # material share (>= 5%) of the fitted quantity: a term that
+        # explains 1-2% of a noisy measurement can be real physics with
+        # t < 2.6, and failing the whole law on it tests noise, not the
+        # law.  The prediction gate still covers the total behavior.
+        law_ix = [i for i, nm in enumerate(names) if nm != "floor"]
+        major = [i for i in law_ix if abs(shares[names[i]]) >= 0.05]
+        signif = bool(major) and all(
+            alphas[i] < alpha_level and betas[i] > 0 for i in major)
+        yhat = (np.column_stack(cols) @ np.asarray(betas)
+                if names else np.zeros_like(y))
+        gate_ok, med_err = prediction_gate(y, yhat)
+        holds = signif and gate_ok
+        verdict = ("Yes" if holds else
+                   f"No ({'prediction gate' if signif else 'significance'})")
         frac = float(np.mean(y)) / max(float(np.mean(total)), 1e-30)
         if not holds and name != "total" and frac < 0.01:
             # A phase that is a sub-percent sliver of the total sits at
@@ -221,11 +392,23 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
             holds = "untestable"
             verdict = (f"untestable (phase is {frac * 100:.2g}% of "
                        "total — below the timing floor)")
-        print(f"{name:>6}: time ~ {beta:.3e} * law   R^2={r2:.4f}  "
-              f"t={tstat:.1f} (df={df})  alpha={a:.3e}  "
+        terms = "  ".join(
+            f"{nm}={betas[i]:.3e}(t={tstats[i]:.1f},a={alphas[i]:.1e})"
+            for i, nm in enumerate(names))
+        print(f"{name:>6}: {terms}   R^2={r2:.4f} (df={df})  "
+              f"med|log err|={med_err:.3f} (gate {LOG2_GATE:.3f})  "
               f"law holds: {verdict}")
-        report[name] = dict(beta=beta, r2=r2, t=tstat, alpha=a,
-                            holds=holds)
+        get = lambda nm: (betas[names.index(nm)] if nm in names else 0.0)
+        first_law = names[law_ix[0]] if law_ix else None
+        report[name] = dict(
+            beta=get(first_law) if first_law else 0.0,
+            beta_f=get("funnel"), beta_t=get("tube"), floor=get("floor"),
+            r2=r2,
+            t=min((float(tstats[i]) for i in law_ix), default=0.0),
+            alpha=max((float(alphas[i]) for i in major), default=1.0)
+            if major else min((float(alphas[i]) for i in law_ix),
+                              default=1.0),
+            med_log_err=med_err, signif=signif, holds=holds)
 
     # speedup tables (reference: empirical + fitted, per n)
     print("\nspeedup (empirical vs fitted-law):")

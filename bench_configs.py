@@ -81,11 +81,27 @@ def config3_batched():
         yr, yi = fft_batched_planes(c[0], c[1], mesh)
         return yr * inv, yi * inv
 
-    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64, cache=False)
+    # the same transform with the bit-reverse gather left off the timed
+    # path — the flagship config-2 contract (README: "the gather to
+    # natural order stays off the timed path, exactly like the
+    # reference"); reported alongside so both evidence classes are
+    # visible.  Same sharded path as the baseline body, so the delta
+    # measures exactly the gather.
+
+    def body_pi(c):
+        yr, yi = fft_batched_planes(c[0], c[1], mesh, natural=False)
+        return yr * inv, yi * inv
+
+    ms = loop_slope_ms(body, (xr, xi), k1=16, k2=256, reps=5,
+                       min_delta_ms=100.0, cache=False)
+    ms_pi = loop_slope_ms(body_pi, (xr, xi), k1=16, k2=256, reps=5,
+                          min_delta_ms=100.0, cache=False)
     flops = 5 * b * n * np.log2(n)
     return {"config": f"batched FFT {b}x{n} (DP over {mesh.devices.size} devices)",
             "ms": round(ms, 3),
-            "gflops": round(flops / (ms * 1e-3) / 1e9, 1)}
+            "gflops": round(flops / (ms * 1e-3) / 1e9, 1),
+            "ms_pi_layout": round(ms_pi, 3),
+            "gflops_pi_layout": round(flops / (ms_pi * 1e-3) / 1e9, 1)}
 
 
 def config4_fft2d():
@@ -106,7 +122,8 @@ def config4_fft2d():
         yr, yi = fft2_sharded_planes(v[0], v[1], mesh)
         return yr * inv, yi * inv
 
-    ms = loop_slope_ms(body, (xr, xi), k1=8, k2=64, cache=False)
+    ms = loop_slope_ms(body, (xr, xi), k1=16, k2=128, reps=5,
+                       min_delta_ms=100.0, cache=False)
     flops = 5 * r * c * (np.log2(r) + np.log2(c))
     return {"config": f"2D FFT {r}x{c} ({mesh.devices.size}-device slab)",
             "ms": round(ms, 3),
@@ -114,8 +131,11 @@ def config4_fft2d():
 
 
 def config5_poisson():
-    """3D spectral Poisson solve, slab decomposition.  512^3 needs the
-    multi-chip config; on fewer chips the grid shrinks to fit (reported)."""
+    """3D spectral Poisson solve, slab decomposition, at the designed
+    512^3 scale.  A 512^3 f32 grid is 512 MB; v5e's 16 GB HBM holds the
+    solve's working set on ONE chip (single-device slab), so the scale
+    no longer demotes on small meshes (VERDICT r4 item 4) — only a
+    genuine memory shortfall would."""
     import jax
     import jax.numpy as jnp
 
@@ -123,7 +143,17 @@ def config5_poisson():
 
     ndev = min(len(jax.devices()), 8)
     mesh = make_mesh(ndev)
-    side = 512 if ndev >= 8 else 256
+    side = 512
+    # working-set preflight: ~14 plane-sized f32 arrays live across the
+    # solve, slab-sharded over the mesh — each device holds 1/ndev of
+    # every plane, so the per-DEVICE requirement is what gates the scale
+    need_per_device = 14 * side**3 * 4 // ndev
+    try:
+        hbm = jax.devices()[0].memory_stats().get("bytes_limit", 0)
+    except Exception:
+        hbm = 0
+    if hbm and need_per_device > hbm:
+        side = 256
     key = jax.random.PRNGKey(4)
     fsrc = jax.random.normal(key, (side, side, side), jnp.float32)
     ms = loop_slope_ms(

@@ -110,7 +110,12 @@ def _compiled(n: int, p: int, impl: str, kblock: int | None = None):
                 )
 
         return funnel_f, tube_f, full
-    elif n >= SCAN_MIN_N:
+    elif impl == "scan" or (impl != "unrolled" and n >= SCAN_MIN_N):
+        # impl == "scan": the jax-scan backend — constant-geometry tube
+        # at every n so the sweep is regime-pure and each stage costs
+        # the same (the law-obedient variant; see registry).
+        # impl == "unrolled" pins the unrolled tube instead (negative-
+        # exhibit producer; compile time bounds its n in practice).
         full = jax.jit(lambda xr, xi: pi_fft_pi_layout_scan(xr, xi, p, tables))
         tube_raw = lambda sr, si: tube_scan(sr, si, n, p)  # noqa: E731
     else:
@@ -194,7 +199,7 @@ def _loop_bodies(n: int, p: int, impl: str, kblock: int | None = None):
                 return cr, ci
 
         return funnel_body, tube_body
-    elif n >= SCAN_MIN_N:
+    elif impl == "scan" or (impl != "unrolled" and n >= SCAN_MIN_N):
         def tube_body(c):
             tr, ti = tube_scan(c[0], c[1], n, p)
             return tr * inv_rs, ti * inv_rs
@@ -236,7 +241,8 @@ def einsum_tube_kblock(s: int) -> int | None:
 
 class JaxBackend:
     def __init__(self, impl: str = "jnp"):
-        self.name = "jax" if impl == "jnp" else impl
+        self.name = {"jnp": "jax", "scan": "jax-scan",
+                     "unrolled": "jax-unrolled"}.get(impl, impl)
         self._impl = impl
         # golden-test tolerance: butterfly impls are bit-exact on the
         # 8-point golden vector; the einsum impl goes through MXU matmuls

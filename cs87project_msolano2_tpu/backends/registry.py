@@ -8,7 +8,8 @@ from __future__ import annotations
 
 from typing import List
 
-_NAMES = ("serial", "pthreads", "cpu", "jax", "pallas", "einsum")
+_NAMES = ("serial", "pthreads", "cpu", "jax", "jax-scan",
+          "jax-unrolled", "pallas", "einsum")
 
 
 def list_backends() -> List[str]:
@@ -28,6 +29,26 @@ def get_backend(name: str):
         from .jax_backend import JaxBackend
 
         return JaxBackend("jnp")
+    if name == "jax-unrolled":
+        # the unrolled-stage tube pinned at EVERY n (up to the compile
+        # ceiling) — the producer of the committed negative-result
+        # dataset (its stride-dependent stage costs measurably violate
+        # the on-chip law; tests/test_committed_datasets.py asserts the
+        # criterion keeps rejecting it).  Plain "jax" auto-selects
+        # unrolled below SCAN_MIN_N and scan above.
+        from .jax_backend import JaxBackend
+
+        return JaxBackend("unrolled")
+    if name == "jax-scan":
+        # the jnp pi-FFT with the constant-geometry (Pease) scan tube at
+        # EVERY n: each stage has identical shape and cost, so the
+        # backend's wall time obeys the on-chip complexity law by
+        # construction — the law-verification counterpart of the
+        # unrolled tube, whose stride-dependent stage costs the
+        # falsifiable round-5 criterion rejects (see datasets/README).
+        from .jax_backend import JaxBackend
+
+        return JaxBackend("scan")
     if name == "pallas":
         from .jax_backend import JaxBackend
 

@@ -83,3 +83,44 @@ def ifft_planes(xr, xi, p: int = 1, tables=None):
     n = xr.shape[-1]
     yr, yi = fft_planes(xr, -xi, p, tables)
     return yr / n, -yi / n
+
+
+def _pallas_rows_ok(shape) -> bool:
+    import math
+
+    from ..ops.pallas_fft import rows_plan_feasible
+
+    n = shape[-1]
+    return rows_plan_feasible(math.prod(shape[:-1]) or 1, n)
+
+
+def fft_planes_fast(xr, xi, natural: bool = True):
+    """fft_planes with the batched Pallas tile kernel on the hot path.
+
+    The parallel configs (batched / 2-D / Poisson) previously ran
+    unrolled jnp stages plus a bit-reverse gather per pass — ~10x under
+    the flagship kernel (VERDICT r4 item 2).  Any stack of
+    power-of-two rows 128..2^16 long goes through ops.pallas_fft.
+    fft_rows_pallas (each row one in-VMEM DIF); other shapes fall back
+    to the jnp path.  `natural=False` returns pi layout (per-row
+    bit-reversed), skipping the gather pass for pipelines that don't
+    need ordering — only valid on the kernel path, so it requires a
+    kernel-eligible n.
+    """
+    if _pallas_rows_ok(xr.shape):
+        from ..ops.pallas_fft import fft_rows_pallas
+
+        return fft_rows_pallas(xr, xi, natural=natural)
+    if not natural:
+        raise ValueError(
+            f"pi-layout output requires a kernel-eligible shape "
+            f"(power-of-two trailing axis 128..65536 with a Mosaic-legal "
+            f"row grouping), got {xr.shape}")
+    return fft_planes(xr, xi)
+
+
+def ifft_planes_fast(xr, xi):
+    """Inverse of fft_planes_fast (conj trick, same dispatch)."""
+    n = xr.shape[-1]
+    yr, yi = fft_planes_fast(xr, -xi)
+    return yr / n, -yi / n

@@ -35,6 +35,29 @@ from .butterfly import stage_full
 from .twiddle import twiddle_tables
 
 LANE = 128
+
+
+def _out_struct(shape, like):
+    """ShapeDtypeStruct for a pallas_call output, carrying the varying-
+    across-mesh-axes set of the input operand: under shard_map with
+    check_vma=True (the default) pallas outputs must declare their vma,
+    and ours always matches the data operand's (the kernel is pointwise
+    in the sharded batch dimension)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _pvary_like(arrs, like):
+    """Lift constant operands (twiddle tables, tail matrices) to the
+    varying-manual-axes set of the data operand.  Inside shard_map the
+    vma checker requires every value meeting the data to vary over the
+    same axes; constants enter unvarying and must be pvary'd."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if not vma:
+        return list(arrs)
+    return [jax.lax.pvary(a, tuple(vma)) for a in arrs]
 # 256 KiB of re+im per program. Measured on TPU v5e at n=2^20: 2^15 runs at
 # ~3 TFLOP/s, 2^16 ~2.1, and >=2^17 overflows VMEM (remote-compile failure).
 DEFAULT_TILE = 1 << 15
@@ -313,11 +336,62 @@ def _use_interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _choose_block_tiles(ntiles: int, trows: int) -> int | None:
+    """Tiles per grid program: group small tiles so each program still
+    moves ~2^16 elements (512 rows — the flagship block size, measured
+    fastest at n=2^20); tiny blocks leave the grid bound by per-program
+    overhead.  Mosaic's sublane rule constrains the choice: a block's
+    row count must be divisible by 8 or equal the whole array's.
+    Returns the largest feasible power-of-two-multiple divisor of
+    ntiles with block_tiles * trows <= max(1024, trows), or None when no
+    legal grouping exists (caller falls back to one whole-array
+    program if that fits, else to the jnp path)."""
+    import math
+
+    r = 8 // math.gcd(trows, 8)  # block_tiles must be a multiple of r
+    if ntiles % r:
+        return None
+    g = r
+    # 1024-row blocks measured marginally faster than 512 at the 128 MB
+    # batched scale and equal elsewhere; OOMs only appeared at 2048 rows
+    # (and at 1024 under Precision.HIGHEST, which callers pass
+    # explicitly together with their own block_tiles).
+    while (g * 2 * trows <= max(1024, trows)) and ntiles % (g * 2) == 0:
+        g *= 2
+    return g
+
+
+# One whole-array grid program is legal at any row count (the sublane
+# rule's "or equal" arm) but must fit VMEM: in+out re/im blocks, double
+# buffered, plus kernel stack temps.  1024 rows = 4 MB of io blocks.
+_WHOLE_ARRAY_ROWS_MAX = 1024
+
+
+def rows_plan_feasible(nrows: int, n: int) -> bool:
+    """Can fft_rows_pallas lower a (nrows, n)-row workload?  (nrows =
+    number of transforms).  Mirrors tile_fft_grid's block selection so
+    dispatchers (models.fft.fft_planes_fast) can predict the fallback
+    without trying to lower."""
+    if n < LANE or n > MAX_ROW_TILE or n & (n - 1):
+        return False
+    trows = n // LANE
+    if _choose_block_tiles(nrows, trows) is not None:
+        return True
+    return nrows * trows <= _WHOLE_ARRAY_ROWS_MAX
+
+
 def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
-                  precision=None, tail: int = LANE):
+                  precision=None, tail: int = LANE,
+                  block_tiles: int | None = None):
     """Grid the tile kernel over rows: (R, tile//128*...)  Input planes
     shaped (total_rows, 128) with total_rows % (tile/128) == 0; each
     consecutive group of tile/128 rows is one independent tile-point DIF.
+
+    `block_tiles` groups that many consecutive tiles into one grid
+    program (the compute is batch-agnostic — see _tile_fft_compute);
+    None auto-groups toward the measured 512-row block sweet spot.
+    Batched workloads (B transforms of a few thousand points each) would
+    otherwise pay per-program overhead B times.
 
     `precision` controls the MXU tail matmul.  Default is SPLIT3 (the
     error-compensated 3-pass bf16 split, rel err ~4e-6 — see SPLIT3):
@@ -350,11 +424,28 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
 
     assert_disjoint_cover(total_rows, trows, ntiles)
 
-    steps, np_tables = _tile_plan(tile, tail)
-    tables = [jnp.asarray(t) for t in np_tables]
-    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
+    if block_tiles is None:
+        block_tiles = _choose_block_tiles(ntiles, trows)
+        if block_tiles is None:
+            if total_rows <= _WHOLE_ARRAY_ROWS_MAX:
+                block_tiles = ntiles  # one whole-array program
+            else:
+                raise ValueError(
+                    f"no Mosaic-legal block grouping for ntiles={ntiles} "
+                    f"x trows={trows} (block rows must be divisible by 8 "
+                    f"or cover the whole array; use rows_plan_feasible "
+                    f"to pre-check)")
+    if ntiles % block_tiles:
+        raise ValueError(
+            f"block_tiles={block_tiles} must divide ntiles={ntiles}")
+    brows = block_tiles * trows
 
-    in_specs = [pl.BlockSpec((trows, LANE), lambda i: (i, 0))] * 2
+    steps, np_tables = _tile_plan(tile, tail)
+    tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr2d)
+    btr, bti = _pvary_like(
+        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], xr2d)
+
+    in_specs = [pl.BlockSpec((brows, LANE), lambda i: (i, 0))] * 2
     in_specs += [
         pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables
     ]
@@ -362,12 +453,12 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
 
     out = pl.pallas_call(
         partial(_tile_fft_kernel, steps, precision),
-        grid=(ntiles,),
+        grid=(ntiles // block_tiles,),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((trows, LANE), lambda i: (i, 0))] * 2,
+        out_specs=[pl.BlockSpec((brows, LANE), lambda i: (i, 0))] * 2,
         out_shape=[
-            jax.ShapeDtypeStruct((total_rows, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((total_rows, LANE), jnp.float32),
+            _out_struct((total_rows, LANE), xr2d),
+            _out_struct((total_rows, LANE), xi2d),
         ],
         interpret=interpret,
     )(xr2d, xi2d, *tables, btr, bti)
@@ -498,7 +589,8 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
 
     in_specs = [pl.BlockSpec((R, cb), lambda i: (0, i))] * 2
     if separable:
-        ar, ai, br, bi = (jnp.asarray(t) for t in _long_range_factors(R, C))
+        ar, ai, br, bi = _pvary_like(
+            [jnp.asarray(t) for t in _long_range_factors(R, C)], xr2d)
         in_specs += [pl.BlockSpec((R - 1, 1), lambda i: (0, 0))] * 2
         in_specs += [pl.BlockSpec((levels, cb), lambda i: (0, i))] * 2
         kernel = partial(_long_range_kernel_sep, levels, R)
@@ -514,7 +606,7 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
             pl.BlockSpec((t.shape[0], cb), lambda i: (0, i)) for t in tables
         ]
         kernel = partial(_long_range_kernel, levels)
-        operands = tuple(tables)
+        operands = tuple(_pvary_like(tables, xr2d))
 
     out = pl.pallas_call(
         kernel,
@@ -522,8 +614,8 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((R, cb), lambda i: (0, i))] * 2,
         out_shape=[
-            jax.ShapeDtypeStruct((R, C), jnp.float32),
-            jax.ShapeDtypeStruct((R, C), jnp.float32),
+            _out_struct((R, C), xr2d),
+            _out_struct((R, C), xi2d),
         ],
         interpret=interpret,
     )(xr2d, xi2d, *operands)
@@ -567,8 +659,9 @@ def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret):
 
     R, Q, _ = x3r.shape
     steps, np_tables = _tile_plan(tile, tail)
-    tables = [jnp.asarray(t) for t in np_tables]
-    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
+    tables = _pvary_like([jnp.asarray(t) for t in np_tables], x3r)
+    btr, bti = _pvary_like(
+        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], x3r)
     in_specs = [pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2
     in_specs += [pl.BlockSpec(t.shape, lambda j: (0, 0)) for t in tables]
     in_specs += [pl.BlockSpec((tail, tail), lambda j: (0, 0))] * 2
@@ -578,8 +671,8 @@ def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret):
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2,
         out_shape=[
-            jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
+            _out_struct((R, Q, LANE), x3r),
+            _out_struct((R, Q, LANE), x3i),
         ],
         interpret=interpret,
     )(x3r, x3i, *tables, btr, bti)
@@ -641,7 +734,8 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
 
     if R > 1:
         levels = ilog2(R)
-        ar, ai, br, bi = (jnp.asarray(t) for t in _long_range_factors(R, tile))
+        ar, ai, br, bi = _pvary_like(
+            [jnp.asarray(t) for t in _long_range_factors(R, tile)], xr)
         b3r = br.reshape(levels, Q, LANE)
         b3i = bi.reshape(levels, Q, LANE)
         a3r = ar.reshape(R - 1, 1, 1)
@@ -655,8 +749,8 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
             in_specs=in_specs,
             out_specs=[pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2,
             out_shape=[
-                jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
-                jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
+                _out_struct((R, Q, LANE), x3r),
+                _out_struct((R, Q, LANE), x3i),
             ],
             interpret=interpret,
         )(x3r, x3i, a3r, a3i, b3r, b3i)
@@ -852,8 +946,9 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
             f"cb={cb} gives a {qb}-row A block; Mosaic needs sublane "
             f"blocks divisible by 8 — use cb >= {8 * LANE}"
         )
-    br, bi = (jnp.asarray(t) for t in dft_funnel_b(R))
-    ar, ai, b2r, b2i = (jnp.asarray(t) for t in dft_funnel_factors(R, n))
+    br, bi = _pvary_like([jnp.asarray(t) for t in dft_funnel_b(R)], xr)
+    ar, ai, b2r, b2i = _pvary_like(
+        [jnp.asarray(t) for t in dft_funnel_factors(R, n)], xr)
     atr, ati = ar.T, ai.T  # (Q, R): lane-dim-legal blocks (see kernel)
     x3r = xr.reshape(R, Q, LANE)
     x3i = xi.reshape(R, Q, LANE)
@@ -868,14 +963,65 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2,
         out_shape=[
-            jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
-            jax.ShapeDtypeStruct((R, Q, LANE), jnp.float32),
+            _out_struct((R, Q, LANE), x3r),
+            _out_struct((R, Q, LANE), x3i),
         ],
         interpret=interpret,
     )(x3r, x3i, br, bi, atr, ati, b2r, b2i)
 
     yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
+
+
+# Largest transform one VMEM tile holds (measured: 2^17 overflows — see
+# DEFAULT_TILE note at top).  fft_rows_pallas handles rows up to this.
+MAX_ROW_TILE = 1 << 16
+
+
+def fft_rows_pallas(xr, xi, interpret: bool | None = None, precision=None,
+                    tail: int | None = None, natural: bool = True,
+                    block_tiles: int | None = None):
+    """Natural-order FFT of every length-n row of (..., n) float planes.
+
+    The batched analogue of the flagship 1-D path (VERDICT r4 item 2:
+    configs 3-5 previously ran unrolled jnp stages with a bit-reverse
+    gather inside every pass).  Each row is one n-point DIF finished
+    entirely in VMEM (tile = n, so there is no long-range kernel), with
+    _choose_block_tiles grouping rows per grid program so short rows
+    don't pay per-program overhead row-by-row.  One HBM round trip for
+    the transform plus one XLA gather pass for the bit-reversal —
+    `natural=False` skips the gather and returns pi layout (per-row
+    bit-reversed), for pipelines that postpone or never need
+    unscrambling (spectral multipliers, see parallel/poisson3d.py).
+
+    Requires power-of-two n with LANE <= n <= MAX_ROW_TILE; callers
+    outside that range fall back to the jnp path
+    (models.fft.fft_planes_fast handles the dispatch).
+    """
+    n = xr.shape[-1]
+    if n < LANE or n > MAX_ROW_TILE or n & (n - 1):
+        raise ValueError(
+            f"fft_rows_pallas needs power-of-two {LANE} <= n <= "
+            f"{MAX_ROW_TILE}, got {n}")
+    if tail is None:
+        # measured at (4096, 4096): tail=128 beats 256 by ~20% (the S=2
+        # tail's strided sub-block gathers cost more than the extra VPU
+        # level saves at short tiles); 256 stays best for long tiles
+        # (the flagship's 2^16 measurement)
+        tail = LANE if n <= 8192 else 256
+    lead = xr.shape[:-1]
+    yr, yi = tile_fft_grid(
+        xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile=n,
+        interpret=interpret, precision=precision, tail=tail,
+        block_tiles=block_tiles,
+    )
+    yr = yr.reshape(*lead, n)
+    yi = yi.reshape(*lead, n)
+    if natural:
+        idx = jnp.asarray(bit_reverse_indices(n))
+        yr = jnp.take(yr, idx, axis=-1)
+        yi = jnp.take(yi, idx, axis=-1)
+    return yr, yi
 
 
 def _choose_tile(seg: int, tile: int | None) -> int:

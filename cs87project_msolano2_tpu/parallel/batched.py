@@ -7,26 +7,39 @@ workload.  Plane-level variant exposed for loop-compatible timing."""
 from __future__ import annotations
 
 import functools
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..models.fft import fft_planes, ifft_planes, jax_complex
+from ..models.fft import fft_planes_fast, ifft_planes_fast, jax_complex
 
 
 def fft_batched_planes(xr, xi, mesh, axis: str = "data",
-                       inverse: bool = False):
+                       inverse: bool = False, natural: bool = True):
     """1-D FFT along the trailing axis of (B, n) re/im planes,
-    batch-sharded over `axis`.  Natural order, same sharding."""
-    f = ifft_planes if inverse else fft_planes
+    batch-sharded over `axis`.  Natural order by default, same
+    sharding; `natural=False` returns pi layout (per-row bit-reversed,
+    forward only — the kernel-native order with the gather left off,
+    mirroring the flagship bench contract)."""
+    if inverse:
+        f = ifft_planes_fast
+    else:
+        f = partial(fft_planes_fast, natural=natural)
 
     fn = shard_map(
         lambda br, bi: f(br, bi),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None)),
         out_specs=(P(axis, None), P(axis, None)),
+        # check_vma=False: the Pallas HLO interpreter (CPU test path)
+        # cannot carry varying-manual-axes through its grid while-loop
+        # (jax hlo_interpreter.py; the error text itself prescribes this
+        # workaround).  The kernel operands/outputs still declare vma
+        # for the compiled path (_out_struct/_pvary_like in ops).
+        check_vma=False,
     )
     return fn(xr, xi)
 

@@ -48,14 +48,17 @@ def parse_grid(spec: str) -> list[int]:
 
 
 def result_path(outdir: str, backend: str,
-                oversubscribe: bool = False) -> str:
+                oversubscribe: bool = False, full: bool = False) -> str:
     """Oversubscribed sweeps get a DISTINCT file: mixing p<=cores rows
     (per-processor regime) and p>cores rows (serialized regime) in one
     TSV across resumes would leave no single law that fits it.  The
     `-oversub-` stem also auto-selects the serialized model in
-    analyze_results.model_for / the awk fallback."""
+    analyze_results.model_for / the awk fallback.  `full` marks the
+    reference-style deep-replication dataset (…-results-full.tsv, cf.
+    the reference's 256-rep …-results-full.csv)."""
     stem = f"{backend}-oversub" if oversubscribe else backend
-    return os.path.join(outdir, f"fourier-parallel-pi-{stem}-results.tsv")
+    tail = "-results-full.tsv" if full else "-results.tsv"
+    return os.path.join(outdir, f"fourier-parallel-pi-{stem}{tail}")
 
 
 def done_counts(path: str) -> Counter:
@@ -154,7 +157,7 @@ def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
 
 def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
           outdir: str, resume: bool, seed: int,
-          oversubscribe: bool = False) -> str:
+          oversubscribe: bool = False, full: bool = False) -> str:
     """Timing pass: append TSV rows, NO result fetches (on remote
     accelerators the first device->host transfer permanently inflates
     per-dispatch latency — see Backend.run; verification is a separate
@@ -162,7 +165,7 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
     os.makedirs(outdir, exist_ok=True)
     backend, cells, oversubscribed = grid_cells(
         backend_name, ns, ps, oversubscribe)
-    path = result_path(outdir, backend_name, oversubscribed)
+    path = result_path(outdir, backend_name, oversubscribed, full)
     done = done_counts(path) if resume else Counter()
 
     todo = sum(max(reps - done[c], 0) for c in cells)
@@ -244,6 +247,9 @@ def main(argv=None) -> int:
     ap.add_argument("--oversubscribe", action="store_true",
                     help="run p > capacity anyway (serialized-law regime; "
                          "see grid_cells)")
+    ap.add_argument("--full", action="store_true",
+                    help="write the deep-replication …-results-full.tsv "
+                         "(reference parity: gpu/cuda …-results-full.csv)")
     args = ap.parse_args(argv)
 
     ns = parse_grid(args.n_grid)
@@ -252,7 +258,8 @@ def main(argv=None) -> int:
     # ALL timing before ANY verification fetch (see sweep docstring)
     for b in backends:
         path = sweep(b, ns, ps, args.reps, args.out,
-                     not args.no_resume, args.seed, args.oversubscribe)
+                     not args.no_resume, args.seed, args.oversubscribe,
+                     args.full)
         print(path)
     if args.verify:
         for b in backends:

@@ -139,13 +139,20 @@ def main(argv=None) -> int:
     ap.add_argument("-T", "--reps", type=int, default=10)
     ap.add_argument("--out", default=os.path.join(REPO, "datasets"))
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--full", action="store_true",
+                    help="write the reference-style deep-replication "
+                         "dataset (…-results-full.tsv, cf. the "
+                         "reference's 256-rep …-results-full.csv) "
+                         "instead of the standard 10-rep file")
     args = ap.parse_args(argv)
 
     mesh_crosscheck()
 
     os.makedirs(args.out, exist_ok=True)
+    stem = "full" if args.full else ""
     path = os.path.join(
-        args.out, "fourier-parallel-pi-sharded-results.tsv"
+        args.out,
+        f"fourier-parallel-pi-sharded-results{'-' + stem if stem else ''}.tsv",
     )
     done = done_counts(path)
 
