@@ -358,10 +358,11 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
                 break
             i = names.index(drop[0])
             cols.pop(i)
-            betas, r2, tstats, alphas, df, names = fit(
-                cols, names[:i] + names[i + 1:])
-            if not names:
-                break
+            remaining = names[:i] + names[i + 1:]
+            if not remaining:
+                names = []
+                break  # nothing left to fit (corrupt data reached here)
+            betas, r2, tstats, alphas, df, names = fit(cols, remaining)
         # significance is demanded only of coefficients that carry a
         # material share (>= 5%) of the fitted quantity: a term that
         # explains 1-2% of a noisy measurement can be real physics with
@@ -499,11 +500,26 @@ def main(argv=None) -> int:
                          "for the einsum backend, on-chip for the other "
                          "single-accelerator backends (jax/pallas), and "
                          "per-processor otherwise")
+    ap.add_argument("--allow-fail", action="append", default=[],
+                    help="filename substring whose total-fit FAILURE is "
+                         "expected (documented negative results, e.g. "
+                         "-jax-unrolled-); such a file failing keeps the "
+                         "exit code 0, and PASSING flips it to 1 — the "
+                         "criterion must keep its teeth")
     args = ap.parse_args(argv)
     ok = True
     for path in args.tsv:
         report = analyze(path, args.alpha, args.plots, args.model)
-        ok &= report["total"]["holds"]
+        expected_fail = any(sub in os.path.basename(path)
+                            for sub in args.allow_fail)
+        if expected_fail:
+            if report["total"]["holds"]:
+                print(f"# {os.path.basename(path)}: documented law "
+                      "violation PASSED the fit — criterion lost its "
+                      "teeth", file=sys.stderr)
+                ok = False
+            continue
+        ok &= bool(report["total"]["holds"])
     return 0 if ok else 1
 
 

@@ -37,6 +37,7 @@ def measure_tpu_ms() -> float:
 
     from cs87project_msolano2_tpu.ops.pallas_fft import (
         fft_pi_layout_pallas2,
+        fft_pi_layout_pallas_fused,
         fft_pi_layout_pallas_mf,
         fft_pi_layout_pallas_rql,
     )
@@ -64,7 +65,16 @@ def measure_tpu_ms() -> float:
     # interleave of 1-row slabs measured 3x slower than finishing the
     # last pre-tail levels radix-4 — with that guard tail=128 measures
     # ~0.085 ms, on par with tail=256)
+    # fused = the round-5 single-pallas_call path (VMEM scratch carries
+    # the transform between the long-range and tile phases, so the rql
+    # intermediate's ~16 MB HBM round trip never happens — see
+    # _fused_fft_kernel); its cb slot holds qb (columns per phase-A
+    # step).  tile <= 2^15 keeps scratch + tile stage temps inside VMEM.
     configs = (
+        ("fused", 1 << 15, 32, 256),
+        ("fused", 1 << 15, 16, 256),
+        ("fused", 1 << 15, 32, 128),
+        ("fused", 1 << 14, 32, 256),
         ("rql", 1 << 16, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 12, 256),
         ("rql", 1 << 15, 1 << 13, 256),
@@ -81,7 +91,10 @@ def measure_tpu_ms() -> float:
     for impl, tile, cb, tail in configs:
         try:
             def body(c, impl=impl, t=tile, cb=cb, tail=tail):
-                if impl == "mf":
+                if impl == "fused":
+                    yr, yi = fft_pi_layout_pallas_fused(
+                        c[0], c[1], tile=t, qb=cb, tail=tail)
+                elif impl == "mf":
                     yr, yi = fft_pi_layout_pallas_mf(
                         c[0], c[1], R=t, cb=cb, tail=tail)
                 elif impl == "rql":

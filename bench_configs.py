@@ -152,7 +152,11 @@ def config5_poisson():
         hbm = jax.devices()[0].memory_stats().get("bytes_limit", 0)
     except Exception:
         hbm = 0
-    if hbm and need_per_device > hbm:
+    on_accel = jax.default_backend() not in ("cpu",)
+    if (hbm and need_per_device > hbm) or (not hbm and not on_accel):
+        # demote when memory is positively short — or UNKNOWN on a
+        # non-accelerator backend (a 512^3 interpret-mode solve on a
+        # dev CPU is ~7.5 GB and effectively hangs; fail closed there)
         side = 256
     key = jax.random.PRNGKey(4)
     fsrc = jax.random.normal(key, (side, side, side), jnp.float32)

@@ -761,6 +761,143 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     return yr.reshape(n), yi.reshape(n)
 
 
+def _fused_fft_kernel(levels, R, QB, qb, steps, precision, *refs):
+    """Single-pass whole-FFT kernel body (VERDICT r4 item 1, by the
+    sequential-grid route): the TPU grid is sequential, so an 8 MB
+    VMEM scratch can CARRY the transform between its two phases inside
+    ONE pallas_call —
+
+      steps 0..QB-1   (phase A): long-range separable-twiddle stages on
+                      one (R, qb, LANE) column block each, stored into
+                      the scratch at its column offset;
+      steps QB..QB+R-1 (phase B): one tile-point DIF per step, read
+                      straight out of the scratch row — the inter-kernel
+                      HBM round trip of the rql path (intermediate
+                      (R, Q, LANE) arrays written and re-read, ~16 MB of
+                      traffic at n=2^20) never happens.
+
+    The monolithic single-program fusion was measured VMEM-infeasible in
+    round 4 (whole-transform blocks plus Mosaic's stack temps); the
+    scratch-carry design keeps blocks small while the DATA stays
+    resident."""
+    from jax.experimental import pallas as pl
+
+    ntab = sum(6 if k in ("r8", "r4") else 2 for k, _ in steps)
+    xr_ref, xi_ref, ar_ref, ai_ref, br_ref, bi_ref = refs[:6]
+    tw = refs[6:6 + ntab]
+    btr_ref, bti_ref = refs[6 + ntab], refs[7 + ntab]
+    or_ref, oi_ref = refs[8 + ntab], refs[9 + ntab]
+    sr_ref, si_ref = refs[10 + ntab], refs[11 + ntab]
+    i = pl.program_id(0)
+
+    @pl.when(i < QB)
+    def _phase_a():
+        xr = xr_ref[...]
+        xi = xi_ref[...]
+        rest = xr.shape[1:]
+        for l in range(levels):
+            half = R >> (l + 1)
+            o = R - (R >> l)
+            a_r = ar_ref[...][o:o + half].reshape(half, 1, 1)
+            a_i = ai_ref[...][o:o + half].reshape(half, 1, 1)
+            b_r = br_ref[...][l:l + 1]
+            b_i = bi_ref[...][l:l + 1]
+            wr = a_r * b_r - a_i * b_i
+            wi = a_r * b_i + a_i * b_r
+            xr4 = xr.reshape(-1, 2, half, *rest)
+            xi4 = xi.reshape(-1, 2, half, *rest)
+            ar, br = xr4[:, 0], xr4[:, 1]
+            ai, bi = xi4[:, 0], xi4[:, 1]
+            tr, ti = ar + br, ai + bi
+            dr, di = ar - br, ai - bi
+            ur = dr * wr - di * wi
+            ui = dr * wi + di * wr
+            xr = jnp.stack((tr, ur), axis=1).reshape(R, *rest)
+            xi = jnp.stack((ti, ui), axis=1).reshape(R, *rest)
+        sr_ref[:, pl.dslice(i * qb, qb), :] = xr
+        si_ref[:, pl.dslice(i * qb, qb), :] = xi
+
+    @pl.when(i >= QB)
+    def _phase_b():
+        j = i - QB
+        zr = sr_ref[j]
+        zi = si_ref[j]
+        yr, yi = _tile_fft_compute(
+            zr, zi, steps, tw, btr_ref[:, :], bti_ref[:, :], precision
+        )
+        or_ref[...] = yr.reshape(or_ref.shape)
+        oi_ref[...] = yi.reshape(oi_ref.shape)
+
+
+def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
+                               qb: int = 32, interpret=None,
+                               precision=None, tail: int = 256):
+    """Whole-FFT in ONE pallas_call with a VMEM-resident scratch carry
+    (see _fused_fft_kernel).  Feasible while the n-point re+im scratch
+    fits VMEM next to the tile temps: n <= 2^20 with tile <= 2^15
+    (scratch 8 MB + ~22 stage temps of tile/LANE rows).  Larger n
+    should use fft_pi_layout_pallas_rql."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _use_interpret()
+    if precision is None:
+        precision = SPLIT3
+    n = xr.shape[-1]
+    if tile is None:
+        tile = min(n, DEFAULT_TILE)
+    _check_tail(tail, tile)
+    R = n // tile
+    if R < 2:
+        # no long-range phase: the plain tile grid IS single-pass
+        yr, yi = tile_fft_grid(xr.reshape(-1, LANE), xi.reshape(-1, LANE),
+                               tile, interpret, precision, tail)
+        return yr.reshape(n), yi.reshape(n)
+    Q = tile // LANE
+    if Q % qb:
+        raise ValueError(f"qb={qb} must divide Q={Q}")
+    QB = Q // qb
+    levels = ilog2(R)
+
+    steps, np_tables = _tile_plan(tile, tail)
+    tables = [jnp.asarray(t) for t in np_tables]
+    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
+    ar, ai, br, bi = (jnp.asarray(t) for t in _long_range_factors(R, tile))
+    b3r = br.reshape(levels, Q, LANE)
+    b3i = bi.reshape(levels, Q, LANE)
+    a3r = ar.reshape(R - 1, 1, 1)
+    a3i = ai.reshape(R - 1, 1, 1)
+    x3r = xr.reshape(R, Q, LANE)
+    x3i = xi.reshape(R, Q, LANE)
+
+    def in_col(i):
+        return (0, jnp.minimum(i, QB - 1), 0)
+
+    in_specs = [pl.BlockSpec((R, qb, LANE), in_col)] * 2
+    in_specs += [pl.BlockSpec((R - 1, 1, 1), lambda i: (0, 0, 0))] * 2
+    in_specs += [pl.BlockSpec((levels, qb, LANE), in_col)] * 2
+    in_specs += [pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables]
+    in_specs += [pl.BlockSpec((tail, tail), lambda i: (0, 0))] * 2
+
+    def out_row(i):
+        return (jnp.maximum(i - QB, 0), 0, 0)
+
+    out = pl.pallas_call(
+        partial(_fused_fft_kernel, levels, R, QB, qb, steps, precision),
+        grid=(QB + R,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, Q, LANE), out_row)] * 2,
+        out_shape=[
+            _out_struct((R, Q, LANE), xr),
+            _out_struct((R, Q, LANE), xi),
+        ],
+        scratch_shapes=[pltpu.VMEM((R, Q, LANE), jnp.float32)] * 2,
+        interpret=interpret,
+    )(x3r, x3i, a3r, a3i, b3r, b3i, *tables, btr, bti)
+    return out[0].reshape(n), out[1].reshape(n)
+
+
 @lru_cache(maxsize=8)
 def dft_funnel_matrices(R: int, n: int):
     """Four-step funnel factors: the first log2(R) DIF stages of an

@@ -31,11 +31,21 @@ def test_fft_vs_naive_dft(n):
 
 @pytest.mark.parametrize("p", [1, 2, 8, 64, 1024])
 def test_p_invariance(p):
-    """The paper's claim: the decomposition is exact for every p."""
+    """The paper's claim: the decomposition is exact for every p.
+
+    Both sides go through the stage-by-stage pi path (explicit tables
+    pin it): at the default p=1, fft() now dispatches to the Pallas
+    kernel, whose SPLIT3 tail differs from the jnp stages by ~4e-6 —
+    an implementation delta, not a decomposition delta.  The kernel's
+    own accuracy is asserted separately (tests/test_pallas.py, 1e-5 vs
+    numpy)."""
+    from cs87project_msolano2_tpu.ops.twiddle import twiddle_tables
+
     n = 1024
     x = rand(n, seed=1)
-    base = np.asarray(fft(x, p=1))
-    other = np.asarray(fft(x, p=p))
+    tables = twiddle_tables(n)
+    base = np.asarray(fft(x, p=1, tables=tables))
+    other = np.asarray(fft(x, p=p, tables=tables))
     assert rel_err(other, base.astype(np.complex128)) < 1e-6
 
 

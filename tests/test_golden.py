@@ -8,7 +8,7 @@ import pytest
 from cs87project_msolano2_tpu.backends.registry import get_backend
 from cs87project_msolano2_tpu.utils import verify
 
-BACKENDS = ["serial", "pthreads", "jax"]
+BACKENDS = ["serial", "pthreads", "jax", "jax-scan", "jax-unrolled"]
 
 
 @pytest.mark.parametrize("backend", BACKENDS)

@@ -255,3 +255,30 @@ def test_fft_planes_fast_dispatch():
         zr, zi = ifft_planes_fast(yr, yi)
         ierr = np.max(np.abs(to_complex(zr, zi) - x)) / np.max(np.abs(x))
         assert ierr < 1e-5, (shape, ierr)
+
+
+def test_fft_pallas_fused_single_pass():
+    """The single-pallas_call whole-FFT (VMEM scratch carry between the
+    long-range and tile phases — VERDICT r4 item 1) must agree with
+    numpy across R = n/tile splits, including the R = 1 degenerate
+    (pure tile grid) case."""
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+    from cs87project_msolano2_tpu.ops.pallas_fft import (
+        fft_pi_layout_pallas_fused,
+    )
+
+    rng = np.random.default_rng(5)
+    for n, tile, qb in [(1 << 15, 1 << 12, 8), (1 << 17, 1 << 13, 16),
+                        (1 << 13, 1 << 13, 32)]:
+        x = (rng.standard_normal(n) + 1j * rng.standard_normal(n)).astype(
+            np.complex64
+        )
+        yr, yi = fft_pi_layout_pallas_fused(
+            jnp.asarray(x.real), jnp.asarray(x.imag), tile=tile, qb=qb
+        )
+        y = np.asarray(yr) + 1j * np.asarray(yi)
+        ref = np.fft.fft(x.astype(np.complex128))[bit_reverse_indices(n)]
+        err = np.max(np.abs(y - ref)) / np.max(np.abs(ref))
+        assert err < 1e-5, (n, tile, err)
