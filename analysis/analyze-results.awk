@@ -131,6 +131,7 @@ FNR == 1 {
     newmodel = (force_model != "") ? force_model : \
                (base ~ /-oversub-/) ? "serialized" : \
                (base ~ /-einsum-/) ? "einsum-dense" : \
+               (base ~ /-jax-scan-/) ? "per-processor" : \
                (base ~ /-(jax|pallas)-/) ? "on-chip" : \
                (base ~ /-serial-/) ? "serialized" : "per-processor"
     if (model != "" && newmodel != model) mixed = 1
@@ -138,7 +139,7 @@ FNR == 1 {
     # floor column: jax-dispatch-timed files (mirrors has_floor_for)
     floorfile = (base ~ /-(serial|pthreads)-/) ? 0 : \
                 (model == "on-chip" || model == "einsum-dense" || \
-                 base ~ /-sharded-/) ? 1 : 0
+                 base ~ /-sharded-/ || base ~ /-jax-scan-/) ? 1 : 0
 }
 
 $1 ~ /^[0-9]+$/ && NF == 6 && $6 == "DEGRADED" { degraded += 1; next }

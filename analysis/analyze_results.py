@@ -119,6 +119,16 @@ def model_for(path: str, requested: str = "auto") -> str:
         return "serialized"
     if "-einsum-" in base:
         return "einsum-dense"
+    if "-jax-scan-" in base:
+        # measured (round 5): the constant-geometry scan tube's stage
+        # ops carry a leading p dimension the VPU absorbs — at fixed n
+        # its time falls ~2x per p-doubling, the PER-PROCESSOR law, not
+        # the total-work law (same mechanism as the einsum s^2 tube:
+        # the chip is unsaturated by one chain, so the p virtual
+        # processors run physically in parallel on the vector units).
+        # The pallas backend, whose sequential grid programs DO
+        # saturate the chip, keeps the total-work on-chip model below.
+        return "per-processor"
     if any(f"-{b}-" in base for b in ON_CHIP_BACKENDS):
         return "on-chip"
     if any(f"-{b}-" in base for b in SERIALIZED_BACKENDS):
@@ -191,7 +201,8 @@ def has_floor_for(path: str, model: str) -> bool:
     base = os.path.basename(path)
     if any(tag in base for tag in NATIVE_TIMED):
         return False
-    return model in FLOOR_MODELS or "-sharded-" in base
+    return (model in FLOOR_MODELS or "-sharded-" in base
+            or "-jax-scan-" in base)
 
 
 def ls_fit(y: np.ndarray, cols: list[np.ndarray]):

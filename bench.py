@@ -69,12 +69,18 @@ def measure_tpu_ms() -> float:
     # the transform between the long-range and tile phases, so the rql
     # intermediate's ~16 MB HBM round trip never happens — see
     # _fused_fft_kernel); its cb slot holds qb (columns per phase-A
-    # step).  tile <= 2^15 keeps scratch + tile stage temps inside VMEM.
+    # step).
+    # measured 2026-07-31 (v5e, same-session comparisons): fused t16
+    # qb32 unaliased = 78.8-79.3 us (1323-1331 GF) vs rql t16 = 91-98 us
+    # in the same sessions — but that config sits AT the 16 MB
+    # scoped-VMEM cliff and compiles nondeterministically (16.70-16.72M
+    # observed), hence the aliased variant (reliable, 94-98 us) and rql
+    # as fallbacks; smaller-tile fused variants measured strictly slower
+    # (t15 qb32 = 109 us, t14 = 167 us).
     configs = (
-        ("fused", 1 << 15, 32, 256),
-        ("fused", 1 << 15, 16, 256),
-        ("fused", 1 << 15, 32, 128),
-        ("fused", 1 << 14, 32, 256),
+        ("fused", 1 << 16, 32, 256),
+        ("fused-alias", 1 << 16, 32, 256),
+        ("fused-alias", 1 << 16, 64, 256),
         ("rql", 1 << 16, 1 << 13, 256),
         ("rql", 1 << 16, 1 << 12, 256),
         ("rql", 1 << 15, 1 << 13, 256),
@@ -91,9 +97,10 @@ def measure_tpu_ms() -> float:
     for impl, tile, cb, tail in configs:
         try:
             def body(c, impl=impl, t=tile, cb=cb, tail=tail):
-                if impl == "fused":
+                if impl.startswith("fused"):
                     yr, yi = fft_pi_layout_pallas_fused(
-                        c[0], c[1], tile=t, qb=cb, tail=tail)
+                        c[0], c[1], tile=t, qb=cb, tail=tail,
+                        alias_io=impl.endswith("alias"))
                 elif impl == "mf":
                     yr, yi = fft_pi_layout_pallas_mf(
                         c[0], c[1], R=t, cb=cb, tail=tail)

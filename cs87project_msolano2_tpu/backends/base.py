@@ -32,7 +32,7 @@ class Backend(Protocol):
         ...
 
     def run(self, x: np.ndarray, p: int, reps: int = 1,
-            fetch: bool = True) -> RunResult:
+            fetch: bool = True, timers: bool = True) -> RunResult:
         """pi-DFT of complex64 `x` (power-of-two length) with p virtual
         processors.  `reps`: timed repetitions (best-of); the output is
         from the last rep.

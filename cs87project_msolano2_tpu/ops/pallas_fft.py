@@ -831,12 +831,15 @@ def _fused_fft_kernel(levels, R, QB, qb, steps, precision, *refs):
 
 def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
                                qb: int = 32, interpret=None,
-                               precision=None, tail: int = 256):
+                               precision=None, tail: int = 256,
+                               alias_io: bool = False):
     """Whole-FFT in ONE pallas_call with a VMEM-resident scratch carry
     (see _fused_fft_kernel).  Feasible while the n-point re+im scratch
-    fits VMEM next to the tile temps: n <= 2^20 with tile <= 2^15
-    (scratch 8 MB + ~22 stage temps of tile/LANE rows).  Larger n
-    should use fft_pi_layout_pallas_rql."""
+    fits VMEM next to the tile temps: n <= 2^20 (8 MB scratch).  At
+    n=2^20 tile=2^16 is the measured-fastest shape but sits at the
+    16 MB scoped-VMEM cliff unaliased (see alias_io); tile=2^15 has
+    comfortable headroom and measured ~35% slower.  Larger n should
+    use fft_pi_layout_pallas_rql."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -893,6 +896,17 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
             _out_struct((R, Q, LANE), xi),
         ],
         scratch_shapes=[pltpu.VMEM((R, Q, LANE), jnp.float32)] * 2,
+        # alias_io folds the x planes onto the outputs: phase A consumes
+        # the inputs, phase B writes the outputs — never the same grid
+        # step — and the saved double-buffered block pair moves the
+        # n=2^20/tile=2^16 config from the 16 MB scoped-VMEM cliff
+        # (measured 16.70-16.72 MB unaliased: compiles or OOMs
+        # nondeterministically) to a reliable 15.7 MB.  The alias costs
+        # ~15-18 us at n=2^20 (measured: 79 us unaliased vs 94-98
+        # aliased — the pipeline loses read/write overlap), so bench.py
+        # tries the fast unaliased config first and this one as the
+        # reliable fallback.
+        input_output_aliases={0: 0, 1: 1} if alias_io else {},
         interpret=interpret,
     )(x3r, x3i, a3r, a3i, b3r, b3i, *tables, btr, bti)
     return out[0].reshape(n), out[1].reshape(n)

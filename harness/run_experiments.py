@@ -128,7 +128,7 @@ def grid_cells(backend_name: str, ns: list[int], ps: list[int],
 
 
 def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
-                   fetch: bool = False):
+                   fetch: bool = False, timers: bool = True):
     """backend.run with retries on transient infrastructure errors.
 
     Remote-accelerator relays drop connections under long sweeps
@@ -142,7 +142,7 @@ def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
     """
     for attempt in range(attempts):
         try:
-            return backend.run(x, p, fetch=fetch)
+            return backend.run(x, p, fetch=fetch, timers=timers)
         except ValueError:
             raise
         except Exception as e:
@@ -214,7 +214,10 @@ def verify_pass(backend_name: str, ns: list[int], ps: list[int],
         x = make_input(n, seed)
         ref = np.fft.fft(x.astype(np.complex128))
         try:
-            res = run_with_retry(backend, x, p, fetch=True)
+            # timers=False: verification needs the output, not another
+            # loop-slope pass — re-timing every verified cell measured
+            # ~20+ min of a big-n sweep's wall clock on the relay
+            res = run_with_retry(backend, x, p, fetch=True, timers=False)
         except ValueError as e:
             print(f"# {backend_name} n={n} p={p} verify skipped: {e}",
                   file=sys.stderr)

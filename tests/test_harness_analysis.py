@@ -278,7 +278,7 @@ def test_harness_marks_degraded_rows(tmp_path, monkeypatch):
         def capacity(self):
             return None
 
-        def run(self, x, p, reps=1, fetch=True):
+        def run(self, x, p, reps=1, fetch=True, timers=True):
             return RunResult(out=None, total_ms=100.0, funnel_ms=50.0,
                              tube_ms=50.0, degraded=True)
 
