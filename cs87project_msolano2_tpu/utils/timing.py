@@ -65,9 +65,16 @@ class LoopSlopeUnresolved(RuntimeError):
     relay's noise floor at any feasible iteration count."""
 
 
-def _timed_fetch(fn: Callable, *args, reps: int) -> float:
-    """Best-of wall time of a scalar-returning jit fn, fetch included."""
-    float(fn(*args))  # compile + warm (and, on axon, enter sync mode)
+def _timed_fetch(fn: Callable, *args, reps: int, warm: bool = True) -> float:
+    """Best-of wall time of a scalar-returning jit fn, fetch included.
+
+    `warm=False` skips the unmeasured warm call — correct ONLY for a
+    program that has already executed in this process (compile done,
+    relay sync mode entered).  A 10-replication sweep cell re-runs the
+    same cached programs; warming each of the ~8 fetches per replication
+    doubled the per-rep relay cost for nothing."""
+    if warm:
+        float(fn(*args))  # compile + warm (and, on axon, enter sync mode)
     best = float("inf")
     for _ in range(max(reps, 1)):
         t0 = time.perf_counter()
@@ -193,8 +200,10 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
     twiddle constants) until eviction.
     """
     window = None
+    warmed = None
     if body is not None:
         raw_make = make
+        warmed = set()
 
         def make(k):
             key = (kind, body, k)
@@ -205,6 +214,7 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
                 fn = _PROGRAM_CACHE[key] = raw_make(k)
             else:
                 _PROGRAM_CACHE.move_to_end(key)
+                warmed.add(k)  # cache hit: this program has already run
             return fn
 
         window = _WINDOW_CACHE.get((kind, body))
@@ -221,12 +231,19 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
         # k2-budget rescale below still shrinks k2 once t1 is known.
         k2 = max(k2, _GLOBAL_WINDOW[kind][1])
 
+    def fetch(k, fn):
+        t = _timed_fetch(fn, args, reps=reps,
+                         warm=not (warmed is not None and k in warmed))
+        if warmed is not None:
+            warmed.add(k)  # it has now run: later fetches skip the warm
+        return t
+
     f1 = make(k1)
-    t1 = _timed_fetch(f1, args, reps=reps)
+    t1 = fetch(k1, f1)
     if t1 > max_program_ms and k1 > 1:
         k1, k2 = 1, 4
         f1 = make(k1)
-        t1 = _timed_fetch(f1, args, reps=reps)
+        t1 = fetch(k1, f1)
     # cap k2 so the k2 program itself stays within the relay's budget.
     # The per-op estimate SUBTRACTS the fixed fetch overhead (tracked as
     # the running minimum of all t1 measurements — for a tiny op at
@@ -252,7 +269,7 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
         k2_budget = int(max_program_ms / per_op)
         k2 = max(k1 + 3, min(k2, k2_budget))
     while True:
-        t2 = _timed_fetch(make(k2), args, reps=reps)
+        t2 = fetch(k2, make(k2))
         if t2 - t1 >= min_delta_ms:
             if body is not None:
                 while len(_WINDOW_CACHE) >= _WINDOW_CACHE_MAX:
@@ -271,4 +288,4 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
         # fresh re-measurement (not a running min): both slope endpoints
         # must come from the same number of samples, else t1 is biased
         # low and the slope high
-        t1 = _timed_fetch(f1, args, reps=reps)
+        t1 = fetch(k1, f1)
