@@ -200,22 +200,27 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
     twiddle constants) until eviction.
     """
     window = None
-    warmed = None
+    entries = None
     if body is not None:
         raw_make = make
-        warmed = set()
+        entries = {}
 
         def make(k):
             key = (kind, body, k)
-            fn = _PROGRAM_CACHE.get(key)
-            if fn is None:
+            ent = _PROGRAM_CACHE.get(key)
+            if ent is None:
                 while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
                     _PROGRAM_CACHE.popitem(last=False)
-                fn = _PROGRAM_CACHE[key] = raw_make(k)
+                # [program, has_run]: has_run flips only after a fetch
+                # SUCCEEDS — a cache hit alone does not prove the
+                # program executed (its first fetch may have raised
+                # before running, and timing an un-warmed program times
+                # its remote compile)
+                ent = _PROGRAM_CACHE[key] = [raw_make(k), False]
             else:
                 _PROGRAM_CACHE.move_to_end(key)
-                warmed.add(k)  # cache hit: this program has already run
-            return fn
+            entries[k] = ent
+            return ent[0]
 
         window = _WINDOW_CACHE.get((kind, body))
     if window is not None:
@@ -232,10 +237,11 @@ def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
         k2 = max(k2, _GLOBAL_WINDOW[kind][1])
 
     def fetch(k, fn):
+        ent = entries.get(k) if entries is not None else None
         t = _timed_fetch(fn, args, reps=reps,
-                         warm=not (warmed is not None and k in warmed))
-        if warmed is not None:
-            warmed.add(k)  # it has now run: later fetches skip the warm
+                         warm=not (ent is not None and ent[1]))
+        if ent is not None:
+            ent[1] = True  # ran successfully: later fetches skip the warm
         return t
 
     f1 = make(k1)

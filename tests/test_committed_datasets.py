@@ -95,9 +95,19 @@ def test_contract_and_composing_timers(path):
 # law-obeying counterpart.
 NEGATIVE_RESULTS = {
     "fourier-parallel-pi-jax-unrolled-results.tsv": ("total",),
-    # plain "jax" auto-selects the unrolled tube below SCAN_MIN_N, so a
-    # default-grid sweep of it reproduces the same violation
+    # DEFENSIVE, currently inert (no such file is committed): plain
+    # "jax" auto-selects the unrolled tube below SCAN_MIN_N, so if a
+    # future sweep commits a default-grid dataset under this name it
+    # reproduces the same violation and must keep failing
     "fourier-parallel-pi-jax-results.tsv": ("total",),
+    # the pallas backend is a HYBRID: its tube is the Pallas kernel
+    # (obeys the on-chip law; gated above) but its FUNNEL phase is XLA
+    # stage_half code whose (p, n) replication crosses the
+    # VMEM-residency boundary inside the sweep grid (128 MB/plane at
+    # p=32, n=2^20 — measured 5x jump from p=16), so no single law
+    # spans the funnel column.  total and tube must PASS; the funnel's
+    # documented rejection is asserted here (datasets/README.md).
+    "fourier-parallel-pi-pallas-results-full.tsv": ("funnel",),
 }
 
 
@@ -116,8 +126,9 @@ def test_law_fits_pass(path):
                 "lost its falsifying power (see NEGATIVE_RESULTS)"
             )
             continue
-        if os.path.basename(path) in NEGATIVE_RESULTS:
-            continue  # other phases of a negative exhibit: not gated
+        if (os.path.basename(path) in NEGATIVE_RESULTS
+                and "total" in must_fail):
+            continue  # full negative exhibit: other phases not gated
         assert holds in (True, "untestable"), (
             f"{os.path.basename(path)} {phase}: law fit failed "
             f"(R^2={rep[phase]['r2']:.3f}, alpha={rep[phase]['alpha']:.2e}, "
