@@ -40,27 +40,51 @@ def config2_pallas_2e20():
     import jax
     import jax.numpy as jnp
 
-    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_rql
+    from cs87project_msolano2_tpu.ops.pallas_fft import (
+        fft_pi_layout_pallas_fused,
+        fft_pi_layout_pallas_rql,
+    )
 
-    # the flagship rql path at the bench.py-winning shape (round-2/3
-    # measured the superseded fft_pi_layout_pallas here, understating
-    # the framework 3.5x); tail matmul in the SPLIT3 default precision
+    # the round-5 fused single-pass flagship (VMEM scratch carry), with
+    # the aliased variant and the rql two-kernel path as fallbacks —
+    # the same ladder bench.py climbs (the fast unaliased config sits at
+    # the 16 MB scoped-VMEM cliff and compiles nondeterministically)
     n = 1 << 20
     key = jax.random.PRNGKey(0)
     xr = jax.random.normal(key, (n,), jnp.float32)
     xi = jax.random.normal(jax.random.fold_in(key, 1), (n,), jnp.float32)
     inv = np.float32(1.0 / np.sqrt(n))
 
-    def body(c):
-        yr, yi = fft_pi_layout_pallas_rql(c[0], c[1], tile=1 << 16,
-                                          cb=1 << 13, tail=256)
-        return yr * inv, yi * inv
+    variants = (
+        ("fused", lambda c: fft_pi_layout_pallas_fused(
+            c[0], c[1], tile=1 << 16, qb=32, tail=256)),
+        ("fused-alias", lambda c: fft_pi_layout_pallas_fused(
+            c[0], c[1], tile=1 << 16, qb=32, tail=256, alias_io=True)),
+        ("rql", lambda c: fft_pi_layout_pallas_rql(
+            c[0], c[1], tile=1 << 16, cb=1 << 13, tail=256)),
+    )
+    best, best_name = float("inf"), None
+    for name, fn in variants:
+        try:
+            def body(c, fn=fn):
+                yr, yi = fn(c)
+                return yr * inv, yi * inv
 
-    ms = loop_slope_ms(body, (xr, xi), k1=64, k2=1024, reps=5,
-                       min_delta_ms=100.0, cache=False)
-    return {"config": "1D FFT N=2^20 complex64 (single-chip Pallas rql)",
-            "ms": round(ms, 4),
-            "gflops": round(5 * n * 20 / (ms * 1e-3) / 1e9, 1)}
+            ms = loop_slope_ms(body, (xr, xi), k1=64, k2=1024, reps=5,
+                               min_delta_ms=100.0, cache=False)
+            if ms < best:
+                best, best_name = ms, name
+        except Exception as e:
+            print(f"# config2 {name} failed: {type(e).__name__}: "
+                  f"{str(e)[:160]}", file=sys.stderr)
+    if best_name is None:
+        # every variant failed: propagate so main() records an error
+        # entry instead of writing ms=Infinity into the JSON
+        raise RuntimeError("no config2 variant compiled (see stderr)")
+    return {"config": "1D FFT N=2^20 complex64 (single-chip Pallas "
+                      f"{best_name})",
+            "ms": round(best, 4),
+            "gflops": round(5 * n * 20 / (best * 1e-3) / 1e9, 1)}
 
 
 def config3_batched():
