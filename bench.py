@@ -1,12 +1,11 @@
 """Headline benchmark: 1-D complex FFT, N = 2^20, single TPU chip.
 
-Measures the framework's flagship path (the composed two-kernel Pallas
-pi-FFT on the shared (R, Q, 128) layout, pi-layout output — gather
+Measures the framework's flagship path (pi-layout output — gather
 excluded exactly as the reference excludes it from timing) against TWO
 baselines on this host and prints ONE JSON line:
 
     {"metric": ..., "value": GFLOP/s, "unit": ...,
-     "vs_baseline": ..., "vs_xla_fft": ..., "xla_fft_ms": ...}
+     "vs_baseline": ..., "vs_xla_fft": ..., "xla_fft_ms": ..., "plan": ...}
 
 * vs_baseline — wall-clock speedup over the native C backend at the same
   N (BASELINE.md north star: >= 10x; GFLOP/s uses the standard
@@ -14,6 +13,14 @@ baselines on this host and prints ONE JSON line:
 * vs_xla_fft — wall-clock speedup over `jnp.fft.fft` ON THE SAME CHIP at
   the same N: the strongest same-hardware comparison (XLA's own FFT is
   the production alternative a user would otherwise call).
+
+Kernel selection goes through the plan subsystem
+(cs87project_msolano2_tpu.plans): `plans.tune` races the shared
+candidate ladder (plans/ladder.py — the single source of truth this file
+used to own) ONCE per (device kind, n, layout) key and persists the
+winner, so a warm session reaches its first timed FFT on a cache hit
+with no re-race; this file just tunes-or-loads and reports the winning
+plan.
 
 Measurement method: loop-slope (utils/timing.py) — on the axon TPU relay
 block_until_ready is not a real barrier, so the FFT is iterated K times
@@ -31,95 +38,13 @@ import numpy as np
 N = 1 << 20
 
 
-def measure_tpu_ms() -> float:
-    import jax
-    import jax.numpy as jnp
+def measure_tpu_ms() -> tuple:
+    """(ms, plan) for the flagship key, via the plans subsystem's shared
+    measurement policy (tuned-race ms reused, cached plans re-timed with
+    the tuner's own timer, a non-compiling cached winner re-raced)."""
+    from cs87project_msolano2_tpu import plans
 
-    from cs87project_msolano2_tpu.ops.pallas_fft import (
-        fft_pi_layout_pallas2,
-        fft_pi_layout_pallas_fused,
-        fft_pi_layout_pallas_mf,
-        fft_pi_layout_pallas_rql,
-    )
-    from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
-
-    # (impl, tile_or_R, cb, tail): rql = the retiling-free (R, Q, 128)
-    # composed path (tile_or_R = tile).  tail=256 moves two VPU stage
-    # traversals onto the MXU as a 2x2-blocked 256-point DIF matmul; the
-    # tail matmul runs in SPLIT3 precision (3-pass bf16 error split,
-    # rel err ~4e-6 — pallas_fft.SPLIT3), which round-4 measurements
-    # showed cuts the tile pass by ~2x vs Precision.HIGHEST (XLA's
-    # 6-pass f32 emulation was the single largest cost in the whole
-    # transform).  rql fastest measured with split3: 0.081-0.092 ms at
-    # tile=2^16 cb=2^12..13 (~1180-1300 GF), rel_err 3.9e-06 vs numpy.
-    #
-    # The matmul-funnel path (fft_pi_layout_pallas_mf) is NOT in the
-    # config list: round 3's mf configs OOM'd scoped VMEM on hardware
-    # (24.12M vs the 16M limit); round 4 fixed it with the separable
-    # A/B2 twiddle factorization (dft_funnel_factors) and a VMEM guard,
-    # but the surviving lowerable shape (R=128, cb=1024 — Mosaic stack
-    # intermediates force 1 MB blocks) measures 0.108 ms (split3) vs
-    # rql's 0.089 ms at N=2^20: correct and supported (tests/
-    # test_pallas.py), just not the headline.
-    # (the tile plan keeps radix-8 stages off sub-2-row slabs: an 8-way
-    # interleave of 1-row slabs measured 3x slower than finishing the
-    # last pre-tail levels radix-4 — with that guard tail=128 measures
-    # ~0.085 ms, on par with tail=256)
-    # fused = the round-5 single-pallas_call path (VMEM scratch carries
-    # the transform between the long-range and tile phases, so the rql
-    # intermediate's ~16 MB HBM round trip never happens — see
-    # _fused_fft_kernel); its cb slot holds qb (columns per phase-A
-    # step).
-    # measured 2026-07-31 (v5e, same-session comparisons): fused t16
-    # qb32 unaliased = 78.8-79.3 us (1323-1331 GF) vs rql t16 = 91-98 us
-    # in the same sessions — but that config sits AT the 16 MB
-    # scoped-VMEM cliff and compiles nondeterministically (16.70-16.72M
-    # observed), hence the aliased variant (reliable, 94-98 us) and rql
-    # as fallbacks; smaller-tile fused variants measured strictly slower
-    # (t15 qb32 = 109 us, t14 = 167 us).
-    configs = (
-        ("fused", 1 << 16, 32, 256),
-        ("fused-alias", 1 << 16, 32, 256),
-        ("fused-alias", 1 << 16, 64, 256),
-        ("rql", 1 << 16, 1 << 13, 256),
-        ("rql", 1 << 16, 1 << 12, 256),
-        ("rql", 1 << 15, 1 << 13, 256),
-        ("rql", 1 << 16, 1 << 13, 128),
-        ("two-kernel", 1 << 16, 1 << 14, 128),
-    )
-
-    key = jax.random.PRNGKey(0)
-    xr = jax.random.normal(key, (N,), jnp.float32)
-    xi = jax.random.normal(jax.random.fold_in(key, 1), (N,), jnp.float32)
-
-    inv_rn = np.float32(1.0 / np.sqrt(N))  # keep loop iterates in range
-    best = float("inf")
-    for impl, tile, cb, tail in configs:
-        try:
-            def body(c, impl=impl, t=tile, cb=cb, tail=tail):
-                if impl.startswith("fused"):
-                    yr, yi = fft_pi_layout_pallas_fused(
-                        c[0], c[1], tile=t, qb=cb, tail=tail,
-                        alias_io=impl.endswith("alias"))
-                elif impl == "mf":
-                    yr, yi = fft_pi_layout_pallas_mf(
-                        c[0], c[1], R=t, cb=cb, tail=tail)
-                elif impl == "rql":
-                    yr, yi = fft_pi_layout_pallas_rql(
-                        c[0], c[1], tile=t, cb=cb, tail=tail)
-                else:
-                    yr, yi = fft_pi_layout_pallas2(c[0], c[1], tile=t, cb=cb)
-                return yr * inv_rn, yi * inv_rn
-
-            ms = loop_slope_ms(body, (xr, xi), k1=64, k2=1024, reps=5,
-                               min_delta_ms=100.0, cache=False)
-            best = min(best, ms)
-        except Exception as e:  # a config failing to compile is not fatal
-            print(f"# {impl} tile={tile} cb={cb} tail={tail} failed: "
-                  f"{type(e).__name__}", file=sys.stderr)
-    if not np.isfinite(best):
-        raise RuntimeError("no benchmark configuration compiled")
-    return best
+    return plans.measured_ms(plans.make_key(N, layout="pi"))
 
 
 def measure_xla_fft_ms():
@@ -193,31 +118,16 @@ def measure_xla_fft_ms():
 
 def measure_large_n_ms() -> dict:
     """Large-n reach rows (the reference's pthreads analysis goes to
-    n=2^24): rql wall time at 2^22 and 2^24 with the VMEM-aware default
-    cb.  Best-effort — a failure drops the fields, not the bench."""
-    import jax
-    import jax.numpy as jnp
-
-    from cs87project_msolano2_tpu.ops.pallas_fft import fft_pi_layout_pallas_rql
-    from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+    n=2^24): per-key plans at 2^22 and 2^24 — each n gets the plan tuned
+    (or statically chosen) for ITS key, not the flagship's shape.
+    Best-effort — a failure drops the fields, not the bench."""
+    from cs87project_msolano2_tpu import plans
 
     out = {}
     for logn in (22, 24):
         nn = 1 << logn
         try:
-            key = jax.random.PRNGKey(3)
-            xr = jax.random.normal(key, (nn,), jnp.float32)
-            xi = jax.random.normal(jax.random.fold_in(key, 1), (nn,),
-                                   jnp.float32)
-            inv = np.float32(1.0 / np.sqrt(nn))
-
-            def body(c):
-                yr, yi = fft_pi_layout_pallas_rql(c[0], c[1], tile=1 << 16,
-                                                  tail=256)
-                return yr * inv, yi * inv
-
-            ms = loop_slope_ms(body, (xr, xi), k1=16, k2=256, reps=5,
-                               min_delta_ms=100.0, cache=False)
+            ms, _ = plans.measured_ms(plans.make_key(nn, layout="pi"))
             out[f"n2^{logn}_ms"] = round(ms, 4)
             out[f"n2^{logn}_gflops"] = round(
                 5.0 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
@@ -240,7 +150,7 @@ def measure_c_baseline_ms() -> float:
 
 
 def main() -> int:
-    tpu_ms = measure_tpu_ms()
+    tpu_ms, plan = measure_tpu_ms()
     xla_ms = measure_xla_fft_ms()
     large = measure_large_n_ms()
     c_ms = measure_c_baseline_ms()
@@ -250,6 +160,7 @@ def main() -> int:
         "value": round(gflops, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(c_ms / tpu_ms, 1),
+        "plan": plan.describe(),
     }
     if xla_ms is not None:
         record["vs_xla_fft"] = round(xla_ms / tpu_ms, 2)

@@ -37,54 +37,21 @@ def config1_direct_dft_f64():
 
 def config2_pallas_2e20():
     """1D radix-2 FFT, N=2^20, complex64, single-chip Pallas."""
-    import jax
-    import jax.numpy as jnp
+    from cs87project_msolano2_tpu import plans
 
-    from cs87project_msolano2_tpu.ops.pallas_fft import (
-        fft_pi_layout_pallas_fused,
-        fft_pi_layout_pallas_rql,
-    )
-
-    # the round-5 fused single-pass flagship (VMEM scratch carry), with
-    # the aliased variant and the rql two-kernel path as fallbacks —
-    # the same ladder bench.py climbs (the fast unaliased config sits at
-    # the 16 MB scoped-VMEM cliff and compiles nondeterministically)
+    # kernel choice via the plan subsystem: the SAME ladder bench.py
+    # races (plans/ladder.py — one source of truth), tuned once per
+    # device key and served from the persistent cache thereafter, with
+    # the shared measurement policy (plans.measured_ms) handling tuned-
+    # race reuse and the re-race of a cached winner that stopped
+    # compiling
     n = 1 << 20
-    key = jax.random.PRNGKey(0)
-    xr = jax.random.normal(key, (n,), jnp.float32)
-    xi = jax.random.normal(jax.random.fold_in(key, 1), (n,), jnp.float32)
-    inv = np.float32(1.0 / np.sqrt(n))
-
-    variants = (
-        ("fused", lambda c: fft_pi_layout_pallas_fused(
-            c[0], c[1], tile=1 << 16, qb=32, tail=256)),
-        ("fused-alias", lambda c: fft_pi_layout_pallas_fused(
-            c[0], c[1], tile=1 << 16, qb=32, tail=256, alias_io=True)),
-        ("rql", lambda c: fft_pi_layout_pallas_rql(
-            c[0], c[1], tile=1 << 16, cb=1 << 13, tail=256)),
-    )
-    best, best_name = float("inf"), None
-    for name, fn in variants:
-        try:
-            def body(c, fn=fn):
-                yr, yi = fn(c)
-                return yr * inv, yi * inv
-
-            ms = loop_slope_ms(body, (xr, xi), k1=64, k2=1024, reps=5,
-                               min_delta_ms=100.0, cache=False)
-            if ms < best:
-                best, best_name = ms, name
-        except Exception as e:
-            print(f"# config2 {name} failed: {type(e).__name__}: "
-                  f"{str(e)[:160]}", file=sys.stderr)
-    if best_name is None:
-        # every variant failed: propagate so main() records an error
-        # entry instead of writing ms=Infinity into the JSON
-        raise RuntimeError("no config2 variant compiled (see stderr)")
+    ms, plan = plans.measured_ms(plans.make_key(n, layout="pi"))
     return {"config": "1D FFT N=2^20 complex64 (single-chip Pallas "
-                      f"{best_name})",
-            "ms": round(best, 4),
-            "gflops": round(5 * n * 20 / (best * 1e-3) / 1e9, 1)}
+                      f"{plan.variant})",
+            "ms": round(ms, 4),
+            "gflops": round(5 * n * 20 / (ms * 1e-3) / 1e9, 1),
+            "plan": plan.describe()}
 
 
 def config3_batched():
@@ -182,14 +149,30 @@ def config5_poisson():
         # non-accelerator backend (a 512^3 interpret-mode solve on a
         # dev CPU is ~7.5 GB and effectively hangs; fail closed there)
         side = 256
-    key = jax.random.PRNGKey(4)
-    fsrc = jax.random.normal(key, (side, side, side), jnp.float32)
-    ms = loop_slope_ms(
-        lambda v: (poisson_solve_sharded(v[0], mesh),), (fsrc,), k1=4, k2=32,
-        cache=False
-    )
-    return {"config": f"3D Poisson {side}^3 slab solve ({ndev} device(s))",
-            "ms": round(ms, 2)}
+
+    def measure(s):
+        key = jax.random.PRNGKey(4)
+        fsrc = jax.random.normal(key, (s, s, s), jnp.float32)
+        ms = loop_slope_ms(
+            lambda v: (poisson_solve_sharded(v[0], mesh),), (fsrc,),
+            k1=4, k2=32, cache=False
+        )
+        return {"config": f"3D Poisson {s}^3 slab solve ({ndev} device(s))",
+                "ms": round(ms, 2)}
+
+    try:
+        return measure(side)
+    except Exception as e:
+        if side == 512 and on_accel and not hbm:
+            # an accelerator whose memory_stats() lacks bytes_limit used
+            # to fail OPEN here (attempt 512^3 and die mid-bench); the
+            # attempt stays, but its OOM now demotes to the 256^3 scale
+            # instead of killing the config
+            print(f"# config5: side=512 failed on accelerator with "
+                  f"unknown HBM ({type(e).__name__}: {str(e)[:120]}); "
+                  f"retrying at side=256", file=sys.stderr)
+            return measure(256)
+        raise
 
 
 def main() -> int:

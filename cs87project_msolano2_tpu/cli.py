@@ -3,11 +3,17 @@
 
     python -m cs87project_msolano2_tpu { -n <n> -p <p> [-o] [-b <backend>]
                                          [--reps R] | -t [-b <backend>] }
+    python -m cs87project_msolano2_tpu plan {show | warm | clear} [...]
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
 (reference …pthreads.c:487-491).  Test mode runs the reference's 8-point
 golden test through the chosen backend and prints pass/fail.
+
+The `plan` subcommand manages the FFT plan cache (the plans/ subsystem):
+`show` lists the persistent store for this device kind, `warm` tunes a
+key now so serving sessions start on a cache hit, `clear` wipes the
+on-disk store.
 """
 
 from __future__ import annotations
@@ -49,7 +55,89 @@ def run_golden(backend_name: str) -> int:
     return 0 if ok_all else 1
 
 
+def _parse_n(s: str) -> int:
+    """Accept plain ints and the 2^k spelling the bench docs use."""
+    if "^" in s:
+        base, exp = s.split("^", 1)
+        return int(base) ** int(exp)
+    return int(s, 0)
+
+
+def plan_main(argv) -> int:
+    """`plan {show|warm|clear}` — manage the persistent FFT plan cache."""
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu plan",
+        description="show / warm / clear the FFT plan cache "
+                    "(tune once, serve forever)",
+    )
+    ap.add_argument("action", choices=("show", "warm", "clear"))
+    ap.add_argument("-n", type=_parse_n, default=1 << 20,
+                    help="transform length for warm (int or 2^k)")
+    ap.add_argument("--batch", type=int, nargs="*", default=[],
+                    help="leading batch dims for warm (default: none)")
+    ap.add_argument("--layout", choices=("natural", "pi"), default="pi",
+                    help="output order the plan is tuned for")
+    ap.add_argument("--precision",
+                    choices=("split3", "highest", "default", "fp32"),
+                    default=None)
+    ap.add_argument("--force", action="store_true",
+                    help="warm: re-tune even on a cache hit")
+    args = ap.parse_args(argv)
+
+    from . import plans
+
+    if args.action == "clear":
+        removed = plans.cache.clear(memory=True, disk=True)
+        for path in removed:
+            print(f"removed {path}")
+        if not removed:
+            print("plan cache already empty "
+                  f"(dir: {plans.cache.cache_dir() or 'disabled'})")
+        return 0
+
+    kind = plans.current_device_kind()
+    if args.action == "show":
+        path = plans.cache.store_path(kind)
+        print(f"device kind:  {kind}")
+        print(f"cache dir:    {plans.cache.cache_dir() or 'DISABLED'} "
+              f"(PIFFT_PLAN_CACHE overrides)")
+        entries = plans.cache.disk_entries(kind)
+        if not entries:
+            print("store:        empty (plans will come from static "
+                  "defaults until warmed)")
+            return 0
+        print(f"store:        {path} ({len(entries)} plan(s))")
+        for token, rec in sorted(entries.items()):
+            key = plans.PlanKey.from_token(token)
+            ms = rec.get("ms")
+            print(f"  n={key.n} batch={key.batch} {key.layout} "
+                  f"{key.precision}: {rec['variant']} {rec['params']}"
+                  + (f" ({ms:.4f} ms)" if ms is not None else ""))
+        return 0
+
+    # warm
+    key = plans.make_key(args.n, tuple(args.batch), layout=args.layout,
+                         precision=args.precision)
+    try:
+        plan = plans.tune(key, force=args.force)
+    except plans.TuningUnavailable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except plans.TuningError as e:
+        print(f"error: {e}", file=sys.stderr)
+        for r in e.results:
+            print(f"  {r.variant} {r.params}: {r.reason}", file=sys.stderr)
+        return 1
+    d = plan.describe()
+    print(f"warmed {key.token()}\n  -> {d}")
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "plan":
+        return plan_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="cs87project_msolano2_tpu",
         description="communication-free pi-FFT over the backend-dispatch boundary",

@@ -4,6 +4,24 @@ These are the user-facing transforms (complex64 in, complex64 out, natural
 frequency order) — what ``jnp.fft`` users reach for, built on the same
 funnel/tube stages the benchmarks measure.  The bit-reversal gather lives
 here, at the API boundary, never inside the timed phases.
+
+Dispatch goes through the plan subsystem (:mod:`..plans`):
+``plans.plan_for(shape)`` resolves the kernel variant + parameters for
+this (device kind, n, batch, layout, precision) key — a cached tuned
+winner when one exists, measured-good static defaults otherwise — and
+``plan.execute`` is the single dispatch point.  There is no per-call
+variant retry anywhere on this path.
+
+Precision (the documented escape hatch — previously the only opt-out
+from the kernel's bf16-split tail was an undocumented ``tables=``
+workaround):
+
+* ``precision=None`` / ``"split3"`` — the default error-compensated
+  3-pass bf16 tail, rel err ~4e-6 (ops.pallas_fft.SPLIT3);
+* ``"highest"`` — XLA's 6-pass f32 emulation on the MXU tail (~2x the
+  tile-pass cost, bit-tighter accuracy);
+* ``"fp32"`` — the all-float32 jnp stage path: no MXU tail at all, full
+  f32 end to end (what ``fft`` always did before the kernel dispatch).
 """
 
 from __future__ import annotations
@@ -14,27 +32,34 @@ from ..ops.bits import bit_reverse_indices
 from .pi_fft import pi_fft_pi_layout
 
 
-def fft(x, p: int = 1, tables=None):
+def fft(x, p: int = 1, tables=None, plan=None, precision: str | None = None):
     """1-D DFT over the trailing axis (complex in/out, natural order).
 
     `p` chooses the virtual-processor decomposition; the result is
     p-invariant (that is the paper's claim, and tests assert it).  At
-    the default p=1 with a kernel-eligible shape the transform runs on
-    the Pallas tile kernel (fft_planes_fast); an explicit p keeps the
-    stage-by-stage pi decomposition so the virtual-processor structure
-    stays inspectable.
+    the default p=1 the transform dispatches through the plan subsystem
+    (``plans.plan_for``): the Pallas kernel family on kernel-eligible
+    shapes, the jnp stage path elsewhere.  An explicit `p` (or a
+    `tables` override) keeps the stage-by-stage pi decomposition so the
+    virtual-processor structure stays inspectable.
+
+    `plan` pins an explicit ``plans.Plan``; `precision` picks the
+    kernel precision mode ("split3" default / "highest" / "fp32" — see
+    module docstring).  Both apply to the p=1 plan path only.
     """
     x = jnp.asarray(x)
     if not jnp.iscomplexobj(x):
         x = x.astype(jnp.complex64)
-    n = x.shape[-1]
     xr = jnp.real(x).astype(jnp.float32)
     xi = jnp.imag(x).astype(jnp.float32)
-    if p == 1 and tables is None and _pallas_rows_ok(xr.shape):
-        from ..ops.pallas_fft import fft_rows_pallas
+    if p == 1 and tables is None:
+        from .. import plans
 
-        yr, yi = fft_rows_pallas(xr, xi)
+        pl = plan if plan is not None else plans.plan_for(
+            xr.shape, layout="natural", precision=precision)
+        yr, yi = pl.execute(xr, xi)
         return jax_complex(yr, yi)
+    n = x.shape[-1]
     yr, yi = pi_fft_pi_layout(xr, xi, p, tables)
     idx = jnp.asarray(bit_reverse_indices(n))
     yr = jnp.take(yr, idx, axis=-1)
@@ -42,11 +67,12 @@ def fft(x, p: int = 1, tables=None):
     return jax_complex(yr, yi)
 
 
-def ifft(x, p: int = 1, tables=None):
+def ifft(x, p: int = 1, tables=None, plan=None,
+         precision: str | None = None):
     """Inverse DFT via conjugation: ifft(x) = conj(fft(conj(x))) / n."""
     x = jnp.asarray(x)
     n = x.shape[-1]
-    return jnp.conj(fft(jnp.conj(x), p, tables)) / n
+    return jnp.conj(fft(jnp.conj(x), p, tables, plan, precision)) / n
 
 
 def fft2(x, p: int = 1):
@@ -75,7 +101,8 @@ def jax_complex(re, im):
 def fft_planes(xr, xi, p: int = 1, tables=None):
     """Natural-order DFT on split re/im float32 planes (trailing axis).
 
-    The plane-level core the complex `fft` wraps.  Exposed because (a)
+    The all-float32 jnp stage core — the plan subsystem's "jnp" variant
+    and the ``precision="fp32"`` escape hatch.  Exposed because (a)
     float planes are the TPU-native representation end-to-end, and (b)
     the axon relay's While-loop lowering lacks complex support, so
     anything that must run inside `lax.fori_loop` (loop-slope timing,
@@ -94,42 +121,33 @@ def ifft_planes(xr, xi, p: int = 1, tables=None):
     return yr / n, -yi / n
 
 
-def _pallas_rows_ok(shape) -> bool:
-    import math
+def fft_planes_fast(xr, xi, natural: bool = True, plan=None,
+                    precision: str | None = None):
+    """Plane-level FFT through the plan subsystem — the hot path the
+    parallel configs (batched / 2-D / Poisson) build on.
 
-    from ..ops.pallas_fft import rows_plan_feasible
-
-    n = shape[-1]
-    return rows_plan_feasible(math.prod(shape[:-1]) or 1, n)
-
-
-def fft_planes_fast(xr, xi, natural: bool = True):
-    """fft_planes with the batched Pallas tile kernel on the hot path.
-
-    The parallel configs (batched / 2-D / Poisson) previously ran
-    unrolled jnp stages plus a bit-reverse gather per pass — ~10x under
-    the flagship kernel (VERDICT r4 item 2).  Any stack of
-    power-of-two rows 128..2^16 long goes through ops.pallas_fft.
-    fft_rows_pallas (each row one in-VMEM DIF); other shapes fall back
-    to the jnp path.  `natural=False` returns pi layout (per-row
-    bit-reversed), skipping the gather pass for pipelines that don't
-    need ordering — only valid on the kernel path, so it requires a
-    kernel-eligible n.
+    The plan for this shape's key picks the kernel: any stack of
+    power-of-two rows 128..2^16 long runs ops.pallas_fft.fft_rows_pallas
+    (each row one in-VMEM DIF), large 1-D transforms the composed
+    whole-FFT paths on hardware, everything else the jnp stage path.
+    `natural=False` returns pi layout (per-row bit-reversed), skipping
+    the gather pass for pipelines that don't need ordering — only valid
+    on a kernel path, so it requires a kernel-eligible shape.
     """
-    if _pallas_rows_ok(xr.shape):
-        from ..ops.pallas_fft import fft_rows_pallas
+    if plan is None:
+        from .. import plans
 
-        return fft_rows_pallas(xr, xi, natural=natural)
-    if not natural:
-        raise ValueError(
-            f"pi-layout output requires a kernel-eligible shape "
-            f"(power-of-two trailing axis 128..65536 with a Mosaic-legal "
-            f"row grouping), got {xr.shape}")
-    return fft_planes(xr, xi)
+        plan = plans.plan_for(
+            xr.shape, layout="natural" if natural else "pi",
+            precision=precision)
+    return plan.execute(xr, xi)
 
 
-def ifft_planes_fast(xr, xi):
-    """Inverse of fft_planes_fast (conj trick, same dispatch)."""
-    n = xr.shape[-1]
-    yr, yi = fft_planes_fast(xr, -xi)
-    return yr / n, -yi / n
+def ifft_planes_fast(xr, xi, plan=None, precision: str | None = None):
+    """Inverse of fft_planes_fast (conj trick, same plan dispatch)."""
+    if plan is None:
+        from .. import plans
+
+        plan = plans.plan_for(xr.shape, layout="natural",
+                              precision=precision)
+    return plan.execute_inverse(xr, xi)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compat import pvary_all, shape_struct, vma_of
 from .bits import bit_reverse_indices, ilog2
 from .butterfly import stage_full
 from .twiddle import twiddle_tables
@@ -42,11 +43,9 @@ def _out_struct(shape, like):
     across-mesh-axes set of the input operand: under shard_map with
     check_vma=True (the default) pallas outputs must declare their vma,
     and ours always matches the data operand's (the kernel is pointwise
-    in the sharded batch dimension)."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
-    return jax.ShapeDtypeStruct(shape, jnp.float32)
+    in the sharded batch dimension).  On JAX versions without vma
+    tracking this degrades to a plain struct (utils.compat)."""
+    return shape_struct(shape, jnp.float32, vma_of(like))
 
 
 def _pvary_like(arrs, like):
@@ -54,10 +53,7 @@ def _pvary_like(arrs, like):
     varying-manual-axes set of the data operand.  Inside shard_map the
     vma checker requires every value meeting the data to vary over the
     same axes; constants enter unvarying and must be pvary'd."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if not vma:
-        return list(arrs)
-    return [jax.lax.pvary(a, tuple(vma)) for a in arrs]
+    return pvary_all(arrs, vma_of(like))
 # 256 KiB of re+im per program. Measured on TPU v5e at n=2^20: 2^15 runs at
 # ~3 TFLOP/s, 2^16 ~2.1, and >=2^17 overflows VMEM (remote-compile failure).
 DEFAULT_TILE = 1 << 15
@@ -439,6 +435,14 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
         raise ValueError(
             f"block_tiles={block_tiles} must divide ntiles={ntiles}")
     brows = block_tiles * trows
+    if brows % 8 and brows != total_rows:
+        # the same Mosaic sublane rule _choose_block_tiles enforces for
+        # the auto path, applied to EXPLICIT block_tiles too — without
+        # this the bad value surfaces as an opaque Mosaic lowering error
+        raise ValueError(
+            f"block_tiles={block_tiles} gives {brows}-row blocks; "
+            f"Mosaic's sublane rule needs block rows divisible by 8 or "
+            f"covering the whole array ({total_rows} rows)")
 
     steps, np_tables = _tile_plan(tile, tail)
     tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr2d)

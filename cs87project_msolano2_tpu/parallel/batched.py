@@ -2,19 +2,24 @@
 ("Batched 1D FFT, batch x N over TPU cores").  Each device transforms its
 own batch shard locally — like the pi funnel, this needs no collectives;
 it is the honest multi-chip analogue of the paper's claim for the batched
-workload.  Plane-level variant exposed for loop-compatible timing."""
+workload.  Plane-level variant exposed for loop-compatible timing.
+
+Kernel dispatch: one plan is fetched for the PER-SHARD shape (the shape
+each device actually transforms — tile/tail tuned for that key, not for
+the flagship's), and ``plan.execute`` runs inside the shard_map body.
+"""
 
 from __future__ import annotations
 
 import functools
-from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..models.fft import fft_planes_fast, ifft_planes_fast, jax_complex
+from .. import plans
+from ..models.fft import jax_complex
+from ..utils.compat import shard_map
 
 
 def fft_batched_planes(xr, xi, mesh, axis: str = "data",
@@ -24,22 +29,30 @@ def fft_batched_planes(xr, xi, mesh, axis: str = "data",
     sharding; `natural=False` returns pi layout (per-row bit-reversed,
     forward only — the kernel-native order with the gather left off,
     mirroring the flagship bench contract)."""
-    if inverse:
-        f = ifft_planes_fast
-    else:
-        f = partial(fft_planes_fast, natural=natural)
+    nshards = mesh.shape[axis]
+    local = (xr.shape[0] // nshards,) + tuple(xr.shape[1:])
+    plan = plans.plan_for(
+        local, layout="natural" if (natural or inverse) else "pi")
+
+    def device_fn(br, bi):
+        if inverse:
+            return plan.execute_inverse(br, bi)
+        return plan.execute(br, bi)
 
     fn = shard_map(
-        lambda br, bi: f(br, bi),
+        device_fn,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None)),
         out_specs=(P(axis, None), P(axis, None)),
-        # check_vma=False: the Pallas HLO interpreter (CPU test path)
-        # cannot carry varying-manual-axes through its grid while-loop
-        # (jax hlo_interpreter.py; the error text itself prescribes this
-        # workaround).  The kernel operands/outputs still declare vma
-        # for the compiled path (_out_struct/_pvary_like in ops).
-        check_vma=False,
+        # check=False (vma checking off): the Pallas HLO interpreter
+        # (CPU test path) cannot carry varying-manual-axes through its
+        # grid while-loop (jax hlo_interpreter.py; the error text itself
+        # prescribes this workaround).  With the checker off HERE, the
+        # kernels' vma declarations (_out_struct/_pvary_like in ops) are
+        # inert on this entry point — they exist to keep EXTERNAL
+        # check_vma=True embeddings of these kernels working, not to
+        # protect this path.
+        check=False,
     )
     return fn(xr, xi)
 

@@ -10,16 +10,22 @@ path at all).
 Internals run on split re/im float32 planes (the TPU-native
 representation; also required because the axon relay cannot lower
 complex64 inside While loops); complex64 only at the API edge.
+
+Kernel dispatch: the row and column passes transform DIFFERENT per-shard
+shapes — (R/p, C) rows before the transpose, (C/p, R) columns after —
+so each pass fetches its own plan for its own key instead of sharing one
+module-level default.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..models.fft import fft_planes_fast, ifft_planes_fast, jax_complex
+from .. import plans
+from ..models.fft import jax_complex
+from ..utils.compat import shard_map
 
 
 def _a2a(v, axis, split_axis, concat_axis):
@@ -32,14 +38,23 @@ def fft2_sharded_planes(xr, xi, mesh, axis: str = "p",
     """2-D FFT on (R, C) re/im planes, rows sharded over the mesh axis.
     Returns planes with the same sharding.  R and C must be divisible by
     the axis size."""
-    f = ifft_planes_fast if inverse else fft_planes_fast
+    p = mesh.shape[axis]
+    R, C = xr.shape
+    row_plan = plans.plan_for((R // p, C))
+    col_plan = plans.plan_for((C // p, R))
+
+    def run(plan, br, bi):
+        if inverse:
+            return plan.execute_inverse(br, bi)
+        return plan.execute(br, bi)
 
     def device_fn(br, bi):  # (R/p, C) planes
-        yr, yi = f(br, bi)  # row transforms
+        yr, yi = run(row_plan, br, bi)  # row transforms
         # ICI transpose: (R/p, C) -> (R, C/p)
         yr, yi = _a2a(yr, axis, 1, 0), _a2a(yi, axis, 1, 0)
         # column transforms (axis 0 now fully local)
-        cr, ci = f(jnp.swapaxes(yr, 0, 1), jnp.swapaxes(yi, 0, 1))
+        cr, ci = run(col_plan, jnp.swapaxes(yr, 0, 1),
+                     jnp.swapaxes(yi, 0, 1))
         yr, yi = jnp.swapaxes(cr, 0, 1), jnp.swapaxes(ci, 0, 1)
         # transpose back: (R, C/p) -> (R/p, C)
         return _a2a(yr, axis, 0, 1), _a2a(yi, axis, 0, 1)
@@ -48,12 +63,15 @@ def fft2_sharded_planes(xr, xi, mesh, axis: str = "p",
         device_fn, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None)),
         out_specs=(P(axis, None), P(axis, None)),
-        # check_vma=False: the Pallas HLO interpreter (CPU test path)
-        # cannot carry varying-manual-axes through its grid while-loop
-        # (jax hlo_interpreter.py; the error text itself prescribes this
-        # workaround).  The kernel operands/outputs still declare vma
-        # for the compiled path (_out_struct/_pvary_like in ops).
-        check_vma=False,
+        # check=False (vma checking off): the Pallas HLO interpreter
+        # (CPU test path) cannot carry varying-manual-axes through its
+        # grid while-loop (jax hlo_interpreter.py; the error text itself
+        # prescribes this workaround).  With the checker off HERE, the
+        # kernels' vma declarations (_out_struct/_pvary_like in ops) are
+        # inert on this entry point — they exist to keep EXTERNAL
+        # check_vma=True embeddings of these kernels working, not to
+        # protect this path.
+        check=False,
     )
     return fn(xr, xi)
 

@@ -16,7 +16,8 @@ from functools import partial
 
 import jax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from ..utils.compat import shard_map
 
 from ..models.pi_fft import funnel_single, tube
 from ..ops.twiddle import twiddle_tables

@@ -9,6 +9,11 @@ pipeline.  Two ICI transposes per solve — the textbook slab pattern.
 All spectral arithmetic runs on split re/im float32 planes: the
 multiplier is real, so the whole pipeline is float ops — TPU-native and
 loop-compatible (the axon relay cannot lower complex in While bodies).
+
+Kernel dispatch: every axis pass transforms a different per-shard shape
+((n1/p, n2) rows of n3, (n1/p, n3) rows of n2, (n2/p, n3) rows of n1…),
+and each fetches the plan for ITS shape's key — no shared module-level
+tile/cb defaults.
 """
 
 from __future__ import annotations
@@ -16,10 +21,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..models.fft import fft_planes_fast, ifft_planes_fast
+from .. import plans
+from ..utils.compat import shard_map
 
 
 def _wavenumbers(m: int) -> np.ndarray:
@@ -30,8 +35,13 @@ def _wavenumbers(m: int) -> np.ndarray:
 
 
 def _fft_axis(vr, vi, ax: int, inverse: bool):
-    f = ifft_planes_fast if inverse else fft_planes_fast
-    yr, yi = f(jnp.moveaxis(vr, ax, -1), jnp.moveaxis(vi, ax, -1))
+    vr = jnp.moveaxis(vr, ax, -1)
+    vi = jnp.moveaxis(vi, ax, -1)
+    plan = plans.plan_for(vr.shape)
+    if inverse:
+        yr, yi = plan.execute_inverse(vr, vi)
+    else:
+        yr, yi = plan.execute(vr, vi)
     return jnp.moveaxis(yr, -1, ax), jnp.moveaxis(yi, -1, ax)
 
 
@@ -82,11 +92,14 @@ def poisson_solve_sharded(f, mesh, axis: str = "p"):
     fn = shard_map(
         device_fn, mesh=mesh, in_specs=(P(axis, None, None),),
         out_specs=P(axis, None, None),
-        # check_vma=False: the Pallas HLO interpreter (CPU test path)
-        # cannot carry varying-manual-axes through its grid while-loop
-        # (jax hlo_interpreter.py; the error text itself prescribes this
-        # workaround).  The kernel operands/outputs still declare vma
-        # for the compiled path (_out_struct/_pvary_like in ops).
-        check_vma=False,
+        # check=False (vma checking off): the Pallas HLO interpreter
+        # (CPU test path) cannot carry varying-manual-axes through its
+        # grid while-loop (jax hlo_interpreter.py; the error text itself
+        # prescribes this workaround).  With the checker off HERE, the
+        # kernels' vma declarations (_out_struct/_pvary_like in ops) are
+        # inert on this entry point — they exist to keep EXTERNAL
+        # check_vma=True embeddings of these kernels working, not to
+        # protect this path.
+        check=False,
     )
     return fn(f)

@@ -1,0 +1,142 @@
+"""FFT plan & autotune subsystem (the FFTW/cuFFT "plan" idea for the
+pi-FFT kernel family).
+
+The reference's whole point is choosing the decomposition that makes the
+hardware fastest; this package makes that choice once per *key* —
+(device kind, n, batch shape, dtype, layout, precision) — instead of per
+call or per session:
+
+* ``core``     — :class:`PlanKey` / :class:`Plan`: the key, the chosen
+                 variant + kernel parameters, and the executable.
+* ``ladder``   — the candidate-config table (one source of truth shared
+                 with ``bench.py``) plus measured-good static defaults.
+* ``autotune`` — races the ladder with the loop-slope timer; compile
+                 failures at the scoped-VMEM cliff are recorded
+                 rejections, not fatal errors.
+* ``cache``    — two-level store: in-process LRU plus a JSON file under
+                 ``~/.cache`` (``PIFFT_PLAN_CACHE`` overrides the
+                 directory; ``off`` disables disk), versioned by device
+                 kind and library version.
+
+Consumer entry points:
+
+    plan(n).execute(xr, xi)            # 1-D transform
+    plan_for(shape).execute(xr, xi)    # batched rows over the trailing axis
+    tune(key)                          # explicit tuning race (TPU only)
+
+``plan``/``plan_for``/``get_plan`` NEVER tune implicitly: they serve the
+cache when it has an entry and measured-good static defaults otherwise
+(set ``PIFFT_PLAN_AUTOTUNE=1`` to opt in to tune-on-miss on tunable
+devices).  Offline/CPU mode never tunes, period.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import cache  # noqa: F401
+from .autotune import TuningError, TuningUnavailable, tune  # noqa: F401
+from .core import (  # noqa: F401
+    CandidateResult,
+    Plan,
+    PlanKey,
+    current_device_kind,
+    device_is_tunable,
+)
+
+
+def make_key(n: int, batch: tuple = (), layout: str = "natural",
+             precision: str | None = None,
+             device_kind: str | None = None) -> PlanKey:
+    """PlanKey for an n-point transform over `batch` leading dims on the
+    current (or given) device kind."""
+    return PlanKey(
+        device_kind=device_kind or current_device_kind(),
+        n=int(n),
+        batch=tuple(int(b) for b in batch),
+        layout=layout,
+        precision=precision or "split3",
+    )
+
+
+def get_plan(key: PlanKey) -> Plan:
+    """The plan for `key`: in-process cache, then disk cache, then the
+    measured-good static default.  Never tunes unless the user opted in
+    via PIFFT_PLAN_AUTOTUNE=1 on a tunable device (and even then a
+    tuning failure falls back to the static default)."""
+    opt_in = (os.environ.get("PIFFT_PLAN_AUTOTUNE") == "1"
+              and device_is_tunable())
+    hit = cache.lookup(key)
+    # a memoized static fallback must not veto opted-in tuning: an
+    # earlier failed race parks a static plan in the LRU, and returning
+    # it here would kill the opt-in for the rest of the process
+    if hit is not None and not (opt_in and hit.source == "static"):
+        return hit
+    if opt_in:
+        try:
+            return tune(key)
+        except Exception:
+            pass  # fall through to the static default
+    from . import ladder
+
+    variant, params = ladder.static_default(key)
+    plan = Plan(key=key, variant=variant, params=params, source="static")
+    cache.memoize(plan)
+    return plan
+
+
+def tune_or_static(key: PlanKey, *, force: bool = False,
+                   verbose: bool = True) -> Plan:
+    """``tune(key)``, degrading to the measured-good static default
+    where tuning is refused (offline/CPU, or a key with no candidates).
+    The bench entry points' shared policy: tune when the hardware can
+    answer, never die for lack of it."""
+    import sys
+
+    try:
+        return tune(key, force=force, verbose=verbose)
+    except TuningUnavailable as e:
+        if verbose:
+            print(f"# not tuning ({e}); using static plan",
+                  file=sys.stderr)
+        return get_plan(key)
+
+
+def measured_ms(key: PlanKey, *, verbose: bool = True):
+    """(per-call ms, plan) for `key` — the bench entry points' shared
+    measurement policy: a fresh tune's race already timed the winner
+    (same loop-slope discipline), a cached/static plan is timed directly
+    with the tuner's own timer, and a cached winner that no longer
+    compiles (the scoped-VMEM cliff) triggers one forced re-race, whose
+    winner's ms is taken (the race absorbs per-candidate failures)."""
+    import sys
+
+    from .autotune import default_timer
+
+    plan = tune_or_static(key, verbose=verbose)
+    if plan.source == "tuned" and plan.ms is not None:
+        return plan.ms, plan
+    try:
+        return default_timer(plan.fn, plan.key), plan
+    except Exception as e:
+        if verbose:
+            print(f"# plan {plan.variant} {plan.params} failed "
+                  f"({type(e).__name__}); re-tuning", file=sys.stderr)
+        plan = tune_or_static(key, force=True, verbose=verbose)
+        if plan.ms is None:  # offline static fallback: nothing to race
+            raise
+        return plan.ms, plan
+
+
+def plan(n: int, batch: tuple = (), layout: str = "natural",
+         precision: str | None = None) -> Plan:
+    """The single dispatch point: ``plan(n).execute(xr, xi)``."""
+    return get_plan(make_key(n, batch, layout, precision))
+
+
+def plan_for(shape, layout: str = "natural",
+             precision: str | None = None) -> Plan:
+    """Plan for float-plane arrays of `shape` (trailing axis = transform
+    length, leading axes = batch)."""
+    shape = tuple(shape)
+    return plan(shape[-1], shape[:-1], layout, precision)

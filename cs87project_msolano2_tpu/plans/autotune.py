@@ -1,0 +1,136 @@
+"""The autotuner: race the candidate ladder for a key, record every
+candidate's fate, cache the winner.
+
+Timing uses the loop-slope method (utils.timing) — the only honest
+per-op measurement on the axon relay, and simply lower-noise on hardware
+with real barriers.  A candidate that fails to compile (the 16 MB
+scoped-VMEM cliff is the expected cause — bench history shows the
+fastest flagship config compiles nondeterministically) is recorded as a
+rejection with its reason and the race continues; only a race in which
+NOTHING compiled is an error.
+
+Offline/CPU mode never tunes: interpret-mode timings would poison the
+persistent cache with numbers that mean nothing on hardware.  Tests may
+inject a `timer` and pass `allow_offline=True` to exercise the race
+machinery itself.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Callable, Optional
+
+from . import cache, ladder
+from .core import CandidateResult, Plan, PlanKey, device_is_tunable
+
+
+class TuningUnavailable(RuntimeError):
+    """Tuning was requested where it cannot produce meaningful numbers
+    (offline/CPU mode) or where no candidate exists for the key."""
+
+
+class TuningError(RuntimeError):
+    """Every ladder candidate was rejected; `results` records why."""
+
+    def __init__(self, message: str, results: list):
+        super().__init__(message)
+        self.results = results
+
+
+def _log(verbose: bool, msg: str) -> None:
+    if verbose:
+        print(msg, file=sys.stderr)
+
+
+def default_timer(fn: Callable, key: PlanKey) -> float:
+    """Per-call ms of `fn` on random planes shaped for `key`, via the
+    loop-slope method (bench.py's exact measurement discipline: the body
+    carries scaled planes so loop iterates stay in range, and the
+    bit-reverse gather is wherever the plan's layout puts it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..utils.timing import loop_slope_ms
+
+    shape = key.batch + (key.n,)
+    k0 = jax.random.PRNGKey(0)
+    xr = jax.random.normal(k0, shape, jnp.float32)
+    xi = jax.random.normal(jax.random.fold_in(k0, 1), shape, jnp.float32)
+    inv = np.float32(1.0 / np.sqrt(key.n))
+
+    def body(c):
+        yr, yi = fn(c[0], c[1])
+        return yr * inv, yi * inv
+
+    # window sized to the op: big transforms get a smaller k so the k2
+    # program stays inside the relay's wall-clock budget
+    if (math.prod(shape)) >= (1 << 22):
+        k1, k2 = 16, 256
+    else:
+        k1, k2 = 64, 1024
+    return loop_slope_ms(body, (xr, xi), k1=k1, k2=k2, reps=5,
+                         min_delta_ms=100.0, cache=False)
+
+
+def tune(key: PlanKey, *, force: bool = False,
+         timer: Optional[Callable] = None, verbose: bool = True,
+         allow_offline: bool = False, persist: bool = True) -> Plan:
+    """The tuned plan for `key`: cache hit unless `force`, else race the
+    ladder, record every candidate's fate, store the winner (two-level —
+    a later process skips this entirely)."""
+    if not force:
+        hit = cache.lookup(key)
+        # a memoized static default is NOT a tuning result — get_plan
+        # parks those in the same LRU, and returning one here would let
+        # an earlier untuned call silently veto the race
+        if hit is not None and hit.source == "static":
+            hit = None
+        if hit is not None:
+            _log(verbose, f"# plan cache hit ({hit.source}): "
+                          f"{key.token()} -> {hit.variant} {hit.params}")
+            return hit
+    if not device_is_tunable() and not allow_offline:
+        raise TuningUnavailable(
+            "refusing to autotune in offline/CPU mode (interpret-path "
+            "timings are meaningless); get_plan() serves measured-good "
+            "static defaults there")
+    cands = ladder.candidates(key)
+    if not cands:
+        raise TuningUnavailable(f"no tunable candidates for {key.token()}")
+    timer = timer or default_timer
+
+    results = []
+    for variant, params in cands:
+        label = f"{variant} {params}"
+        try:
+            fn = ladder.build_executor(key, variant, params)
+            ms = float(timer(fn, key))
+        except Exception as e:  # compile/lowering failure: non-fatal
+            reason = f"{type(e).__name__}: {str(e)[:200]}"
+            results.append(CandidateResult(variant, dict(params),
+                                           "rejected", None, reason))
+            _log(verbose, f"# plan candidate {label} rejected: {reason}")
+            continue
+        results.append(CandidateResult(variant, dict(params), "timed", ms))
+        _log(verbose, f"# plan candidate {label}: {ms:.4f} ms")
+
+    timed = [r for r in results if r.status == "timed"]
+    if not timed:
+        raise TuningError(
+            f"no ladder candidate compiled for {key.token()}", results)
+    best = min(timed, key=lambda r: r.ms)
+    for r in timed:
+        if r is best:
+            r.status, r.reason = "won", "fastest measured"
+        else:
+            r.status = "lost"
+            r.reason = f"{r.ms:.4f} ms vs winner {best.ms:.4f} ms"
+
+    plan = Plan(key=key, variant=best.variant, params=dict(best.params),
+                source="tuned", ms=best.ms, tuning=results)
+    cache.store(plan, persist=persist)
+    _log(verbose, f"# plan tuned: {key.token()} -> {best.variant} "
+                  f"{best.params} ({best.ms:.4f} ms)")
+    return plan

@@ -1,0 +1,189 @@
+"""Two-level plan cache: in-process LRU + a JSON disk store.
+
+The disk store makes tuning a once-per-machine event: a second process
+finds the winner on disk and reaches its first FFT without racing the
+ladder.  Layout:
+
+    <cache dir>/plans-<device-kind-slug>.json
+    {"schema": 1, "library_version": "0.1.0",
+     "device_kind": "TPU v5e", "plans": {<key token>: <plan record>}}
+
+`cache dir` is ``$PIFFT_PLAN_CACHE`` when set to a path,
+``$XDG_CACHE_HOME/cs87project-msolano2-tpu`` (default
+``~/.cache/cs87project-msolano2-tpu``) otherwise;
+``PIFFT_PLAN_CACHE=off`` disables the disk level entirely (the tests'
+tier-1 default — see tests/conftest.py).  A store whose schema, library
+version, or device kind does not match is ignored wholesale (stale
+tunings must never outlive the code that produced them); corrupt JSON is
+treated as absent, never an error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: merges fall back to last-writer-wins
+    fcntl = None
+from collections import OrderedDict
+from typing import Optional
+
+from .core import SCHEMA_VERSION, Plan, PlanKey
+
+_MEM: OrderedDict = OrderedDict()
+_MEM_MAX = 128
+_LOCK = threading.Lock()
+
+_OFF_VALUES = ("off", "0", "none", "disabled")
+
+
+def _library_version() -> str:
+    from .. import __version__
+
+    return __version__
+
+
+def cache_dir() -> Optional[str]:
+    """Resolved disk-cache directory, or None when disabled.  Read from
+    the environment on every call so tests (and long-lived processes)
+    can re-point it without reloading the module."""
+    env = os.environ.get("PIFFT_PLAN_CACHE", "").strip()
+    if env.lower() in _OFF_VALUES:
+        return None
+    if env:
+        return env
+    xdg = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(xdg, "cs87project-msolano2-tpu")
+
+
+def _slug(device_kind: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "-", device_kind).strip("-") or "dev"
+
+
+def store_path(device_kind: str) -> Optional[str]:
+    d = cache_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"plans-{_slug(device_kind)}.json")
+
+
+def _load_store(device_kind: str) -> dict:
+    """The validated plans dict for `device_kind`, or {} when the store
+    is absent, disabled, corrupt, or versioned for different code."""
+    path = store_path(device_kind)
+    if path is None or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    if (data.get("schema") != SCHEMA_VERSION
+            or data.get("library_version") != _library_version()
+            or data.get("device_kind") != device_kind):
+        return {}
+    plans = data.get("plans")
+    return plans if isinstance(plans, dict) else {}
+
+
+def memoize(plan: Plan) -> None:
+    """Insert into the in-process LRU only (static defaults and
+    disk-loaded plans both land here so repeat lookups are dict hits)."""
+    with _LOCK:
+        token = plan.key.token()
+        _MEM[token] = plan
+        _MEM.move_to_end(token)
+        while len(_MEM) > _MEM_MAX:
+            _MEM.popitem(last=False)
+
+
+def lookup(key: PlanKey) -> Optional[Plan]:
+    """Memory first, then disk.  Returns None on a full miss — the
+    caller decides between static defaults and tuning."""
+    token = key.token()
+    with _LOCK:
+        hit = _MEM.get(token)
+        if hit is not None:
+            _MEM.move_to_end(token)
+            return hit
+    rec = _load_store(key.device_kind).get(token)
+    if rec is None:
+        return None
+    try:
+        plan = Plan.from_record(key, rec, source="cache")
+    except (KeyError, TypeError, ValueError):
+        return None
+    memoize(plan)
+    return plan
+
+
+def store(plan: Plan, persist: bool = True) -> None:
+    """Memoize and (unless disabled) merge into the disk store.  Disk
+    failures are swallowed: a read-only HOME must never break the
+    transform that just tuned successfully."""
+    memoize(plan)
+    if not persist:
+        return
+    path = store_path(plan.key.device_kind)
+    if path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # serialize the read-merge-write across processes: two tuners
+        # finishing together must not drop each other's fresh winner
+        with open(f"{path}.lock", "w") as lk:
+            if fcntl is not None:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+            plans = _load_store(plan.key.device_kind)
+            plans[plan.key.token()] = plan.to_record()
+            data = {
+                "schema": SCHEMA_VERSION,
+                "library_version": _library_version(),
+                "device_kind": plan.key.device_kind,
+                "plans": plans,
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(data, fh, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def disk_entries(device_kind: str) -> dict:
+    """token -> plan record, for the CLI's `plan show`."""
+    return _load_store(device_kind)
+
+
+def clear(memory: bool = True, disk: bool = False) -> list:
+    """Drop cache levels; returns the list of removed disk files."""
+    removed = []
+    if memory:
+        with _LOCK:
+            _MEM.clear()
+    if disk:
+        d = cache_dir()
+        if d is not None and os.path.isdir(d):
+            for name in sorted(os.listdir(d)):
+                if not name.startswith("plans-"):
+                    continue
+                path = os.path.join(d, name)
+                if name.endswith(".json"):
+                    try:
+                        os.remove(path)
+                        removed.append(path)
+                    except OSError:
+                        pass
+                elif name.endswith(".json.lock"):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+    return removed

@@ -1,0 +1,218 @@
+"""PlanKey / Plan: what a tuned kernel choice IS, independent of how it
+was obtained (tuned, cached, or static default).
+
+A :class:`PlanKey` is everything the kernel choice may legally depend
+on: device kind, transform length, batch shape, plane dtype, output
+layout, and precision mode.  A :class:`Plan` binds a key to one concrete
+variant + parameter set from :mod:`.ladder` and exposes the executable.
+Keys serialize to a stable JSON token (the disk-cache dictionary key —
+round-tripped by tests), plans to a JSON record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Optional
+
+LAYOUTS = ("natural", "pi")
+PRECISIONS = ("split3", "highest", "default", "fp32")
+
+# bump when PlanKey/Plan serialization or ladder parameter semantics
+# change incompatibly — stale disk stores are then ignored wholesale
+SCHEMA_VERSION = 1
+
+
+def current_device_kind() -> str:
+    """Stable identifier of the device a plan is tuned for.  Accelerator
+    backends report the hardware kind (e.g. "TPU v5e"); everything else
+    is "<backend>-interpret" — the Pallas interpret path, where timings
+    are meaningless and tuning is refused."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend in ("tpu", "axon"):
+        try:
+            return str(jax.devices()[0].device_kind)
+        except Exception:
+            return backend
+    return f"{backend}-interpret"
+
+
+def device_is_tunable() -> bool:
+    """True when kernel timings on this backend mean anything (compiled
+    TPU paths, directly attached or over the axon relay)."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def offline_kind(device_kind: str) -> bool:
+    """True for device kinds whose plans must come from static defaults
+    (interpret-mode backends — see current_device_kind)."""
+    return device_kind.endswith("-interpret")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanKey:
+    """Everything a kernel-config choice may depend on.
+
+    layout: "natural" (frequency order; gathers ride inside the plan) or
+    "pi" (per-transform bit-reversed — the kernel-native order, gather
+    skipped exactly as the reference excludes it from timing).
+    precision: "split3" (default 3-pass bf16 error split, rel err
+    ~4e-6), "highest" (XLA 6-pass f32 emulation), "default" (1-pass
+    bf16), or "fp32" (the all-float32 jnp stage path — no MXU tail at
+    all: the full-precision escape hatch).
+    """
+
+    device_kind: str
+    n: int
+    batch: tuple = ()
+    layout: str = "natural"
+    dtype: str = "float32"
+    precision: str = "split3"
+
+    def __post_init__(self):
+        if self.layout not in LAYOUTS:
+            raise ValueError(f"layout={self.layout!r} not in {LAYOUTS}")
+        if self.precision not in PRECISIONS:
+            raise ValueError(
+                f"precision={self.precision!r} not in {PRECISIONS}")
+        if self.n < 1:
+            raise ValueError(f"n={self.n} must be positive")
+
+    def token(self) -> str:
+        """Canonical serialized form — the disk-store dictionary key."""
+        return json.dumps(
+            {
+                "v": SCHEMA_VERSION,
+                "device_kind": self.device_kind,
+                "n": self.n,
+                "batch": list(self.batch),
+                "layout": self.layout,
+                "dtype": self.dtype,
+                "precision": self.precision,
+            },
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+
+    @classmethod
+    def from_token(cls, token: str) -> "PlanKey":
+        d = json.loads(token)
+        if d.get("v") != SCHEMA_VERSION:
+            raise ValueError(f"plan-key schema {d.get('v')} != "
+                             f"{SCHEMA_VERSION}")
+        return cls(
+            device_kind=d["device_kind"],
+            n=int(d["n"]),
+            batch=tuple(int(b) for b in d["batch"]),
+            layout=d["layout"],
+            dtype=d["dtype"],
+            precision=d["precision"],
+        )
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    """One ladder entry's fate during a tuning race: "won" / "lost"
+    (timed, with ms) or "rejected" (did not compile/lower — the
+    scoped-VMEM cliff is an expected, non-fatal cause), always with a
+    recorded reason."""
+
+    variant: str
+    params: dict
+    status: str
+    ms: Optional[float] = None
+    reason: str = ""
+
+    def to_record(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_record(cls, d: dict) -> "CandidateResult":
+        return cls(variant=d["variant"], params=dict(d.get("params") or {}),
+                   status=d["status"], ms=d.get("ms"),
+                   reason=d.get("reason", ""))
+
+
+@dataclasses.dataclass
+class Plan:
+    """A resolved kernel choice for one PlanKey.
+
+    source: "tuned" (this process raced the ladder), "cache" (loaded
+    from the disk store), or "static" (measured-good default — the only
+    source offline mode ever produces).  `ms` is the tuned per-call time
+    when known; `tuning` the full race record.
+    """
+
+    key: PlanKey
+    variant: str
+    params: dict
+    source: str = "static"
+    ms: Optional[float] = None
+    tuning: list = dataclasses.field(default_factory=list)
+    _fn: Optional[Callable] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    @property
+    def fn(self) -> Callable:
+        """The traceable executor (xr, xi) -> (yr, yi): composable under
+        jit / shard_map / fori_loop.  Built lazily from the ladder and
+        cached on the plan."""
+        if self._fn is None:
+            from . import ladder
+
+            self._fn = ladder.build_executor(self.key, self.variant,
+                                             self.params)
+        return self._fn
+
+    def execute(self, xr, xi):
+        """Forward transform on float planes — THE dispatch point.
+        Traceable; for a standalone donated/jitted entry use
+        :meth:`executable`."""
+        return self.fn(xr, xi)
+
+    def execute_inverse(self, xr, xi):
+        """Inverse via the conj trick (natural layout only)."""
+        if self.key.layout != "natural":
+            raise ValueError("inverse requires a natural-layout plan")
+        n = self.key.n
+        yr, yi = self.fn(xr, -xi)
+        return yr / n, -yi / n
+
+    def executable(self, donate: bool = True) -> Callable:
+        """The jitted standalone callable, with input donation wired in
+        (the planes are consumed — the serving-path entry form)."""
+        import jax
+
+        return jax.jit(self.fn, donate_argnums=(0, 1) if donate else ())
+
+    def describe(self) -> dict:
+        d = {"variant": self.variant, "params": dict(self.params),
+             "source": self.source}
+        if self.ms is not None:
+            d["ms"] = round(self.ms, 4)
+        return d
+
+    def to_record(self) -> dict:
+        return {
+            "variant": self.variant,
+            "params": dict(self.params),
+            "ms": self.ms,
+            "tuning": [r.to_record() for r in self.tuning],
+        }
+
+    @classmethod
+    def from_record(cls, key: PlanKey, rec: dict,
+                    source: str = "cache") -> "Plan":
+        return cls(
+            key=key,
+            variant=rec["variant"],
+            params=dict(rec.get("params") or {}),
+            source=source,
+            ms=rec.get("ms"),
+            tuning=[CandidateResult.from_record(r)
+                    for r in rec.get("tuning") or []],
+        )

@@ -1,0 +1,216 @@
+"""The candidate-config ladder and static defaults — ONE source of truth
+for kernel configurations, shared by the autotuner, ``bench.py``, and
+the plan layer's offline fallbacks.
+
+Every entry is (variant, params).  Variants:
+
+* ``rows``       — ops.pallas_fft.fft_rows_pallas: each power-of-two row
+                   (128..2^16 points) finished entirely in VMEM; the
+                   batched / 2-D / Poisson hot path.
+* ``fused`` / ``fused-alias`` — the single-pallas_call whole-FFT (VMEM
+                   scratch carries the transform between phases; alias
+                   folds inputs onto outputs to clear the 16 MB
+                   scoped-VMEM cliff reliably).
+* ``rql``        — the retiling-free two-kernel composed path on the
+                   shared (R, Q, 128) layout.
+* ``two-kernel`` — the original long-range + tile grid pair.
+* ``mf``         — the matmul-funnel path (correct and supported, not in
+                   the flagship ladder — see bench history in ops).
+* ``jnp``        — the all-float32 XLA stage path (models.fft.
+                   fft_planes): the universal fallback and the "fp32"
+                   precision escape hatch.  Never raced (its unrolled
+                   stages take minutes of compile at large n).
+
+The flagship ladder reproduces bench.py's measured table at n=2^20
+(2026-07-31, v5e): fused t16 qb32 unaliased = 78.8-79.3 us (1323-1331
+GF) but sits AT the scoped-VMEM cliff and compiles nondeterministically;
+fused-alias = 94-98 us reliable; rql t16 = 91-98 us.  Cliff failures are
+exactly why the tuner treats compile errors as recorded rejections.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .core import PlanKey, offline_kind
+
+LANE = 128
+MAX_ROW_TILE = 1 << 16  # ops.pallas_fft.MAX_ROW_TILE (kept import-free)
+FUSED_MAX_N = 1 << 20   # n-point re+im VMEM scratch feasibility bound
+
+# the measured flagship variant ladder at large 1-D n (see module doc);
+# fastest-known first so a race's early entries are the likely winners
+FLAGSHIP_LADDER = (
+    ("fused", {"tile": 1 << 16, "qb": 32, "tail": 256}),
+    ("fused-alias", {"tile": 1 << 16, "qb": 32, "tail": 256}),
+    ("fused-alias", {"tile": 1 << 16, "qb": 64, "tail": 256}),
+    ("rql", {"tile": 1 << 16, "cb": 1 << 13, "tail": 256}),
+    ("rql", {"tile": 1 << 16, "cb": 1 << 12, "tail": 256}),
+    ("rql", {"tile": 1 << 15, "cb": 1 << 13, "tail": 256}),
+    ("rql", {"tile": 1 << 16, "cb": 1 << 13, "tail": 128}),
+    ("two-kernel", {"tile": 1 << 16, "cb": 1 << 14}),
+)
+
+
+def _pow2(n: int) -> bool:
+    return n >= 1 and not (n & (n - 1))
+
+
+def _nrows(key: PlanKey) -> int:
+    return math.prod(key.batch) or 1
+
+
+def _rows_eligible(key: PlanKey) -> bool:
+    from ..ops.pallas_fft import rows_plan_feasible
+
+    return _pow2(key.n) and rows_plan_feasible(_nrows(key), key.n)
+
+
+def candidates(key: PlanKey) -> list:
+    """The ordered (variant, params) race for `key`.  Empty when nothing
+    is tunable (the static default may still serve a jnp fallback)."""
+    if key.precision == "fp32":
+        return []  # fp32 forces the jnp path; nothing to race
+    cands = []
+    if _rows_eligible(key):
+        # tail=128 measured best for short rows (the S=2 tail's strided
+        # gathers outweigh the saved VPU level), 256 for long ones — race
+        # both, measured-best first
+        tails = [128, 256] if key.n <= 8192 else [256, 128]
+        cands = [("rows", {"tail": t}) for t in tails if t <= key.n]
+    elif key.batch == () and _pow2(key.n) and key.n > MAX_ROW_TILE:
+        if key.n <= FUSED_MAX_N:
+            cands = [(v, dict(p)) for v, p in FLAGSHIP_LADDER]
+        else:
+            cands = [(v, dict(p)) for v, p in FLAGSHIP_LADDER
+                     if not v.startswith("fused")]
+        # the VMEM-aware auto-cb rql shape: at large n the fixed-cb
+        # entries exceed the R*cb scoped-VMEM ceiling and reject — this
+        # one always lowers
+        cands.append(("rql", {"tile": 1 << 16, "cb": None, "tail": 256}))
+    return cands
+
+
+def static_default(key: PlanKey):
+    """Measured-good (variant, params) used when no tuned/cached plan
+    exists — the ONLY source offline mode serves.  Mirrors the dispatch
+    the library shipped before the plan layer, so un-tuned behavior is
+    never worse than it was."""
+    natural = key.layout == "natural"
+    if key.precision == "fp32":
+        if not natural:
+            raise ValueError(
+                "precision='fp32' runs the jnp stage path, which only "
+                "produces natural order — pi layout needs a kernel plan")
+        return "jnp", {}
+    if _rows_eligible(key):
+        return "rows", {"tail": LANE if key.n <= 8192 else 256}
+    if key.batch == () and _pow2(key.n) and key.n > MAX_ROW_TILE:
+        # large-n 1-D: the composed rql path with the VMEM-aware default
+        # cb (lowerable to n=2^24 — test_pallas.py's large-n case).
+        # Offline, natural order keeps the jnp path (interpret-mode rql
+        # at these sizes costs minutes for nothing), but pi layout has
+        # no jnp equivalent, so it gets the interpret rql plan.
+        if not (offline_kind(key.device_kind) and natural):
+            return "rql", {"tile": 1 << 16, "cb": None, "tail": 256}
+    if not natural:
+        raise ValueError(
+            f"pi-layout output requires a kernel-eligible shape "
+            f"(power-of-two trailing axis {LANE}..{MAX_ROW_TILE} with a "
+            f"Mosaic-legal row grouping), got batch={key.batch} "
+            f"n={key.n}")
+    return "jnp", {}
+
+
+def resolve_precision(precision: str):
+    """Map a PlanKey precision mode to the kernel-level precision
+    argument ("fp32" never reaches a kernel — it selects the jnp
+    variant)."""
+    from ..ops.pallas_fft import SPLIT3
+
+    if precision == "split3":
+        return SPLIT3
+    import jax
+
+    if precision == "highest":
+        return jax.lax.Precision.HIGHEST
+    if precision == "default":
+        return jax.lax.Precision.DEFAULT
+    raise ValueError(f"no kernel precision for mode {precision!r}")
+
+
+def build_executor(key: PlanKey, variant: str, params: dict):
+    """The traceable (xr, xi) -> (yr, yi) executor for one ladder entry.
+
+    Raises ValueError for statically infeasible parameter combinations
+    (the tuner records those as rejections); kernel-level lowering
+    failures surface when the returned callable is first traced."""
+    natural = key.layout == "natural"
+    n = key.n
+
+    if variant == "jnp":
+        if not natural:
+            raise ValueError("the jnp stage path only produces natural "
+                             "order")
+        from ..models.fft import fft_planes
+
+        return fft_planes
+
+    prec = resolve_precision(key.precision)
+
+    if variant == "rows":
+        from ..ops.pallas_fft import fft_rows_pallas
+
+        tail = params.get("tail")
+        block_tiles = params.get("block_tiles")
+
+        def rows_run(xr, xi):
+            return fft_rows_pallas(xr, xi, precision=prec, tail=tail,
+                                   natural=natural,
+                                   block_tiles=block_tiles)
+
+        return rows_run
+
+    # whole-transform 1-D variants: pi-layout core on flat (n,) planes
+    if key.batch != ():
+        raise ValueError(f"variant {variant!r} is a 1-D whole-transform "
+                         f"path; key has batch={key.batch}")
+    from ..ops import pallas_fft as pf
+
+    if variant in ("fused", "fused-alias"):
+        def core(xr, xi, _p=dict(params)):
+            return pf.fft_pi_layout_pallas_fused(
+                xr, xi, tile=_p.get("tile"), qb=_p.get("qb", 32),
+                tail=_p.get("tail", 256), precision=prec,
+                alias_io=variant.endswith("alias"))
+    elif variant == "rql":
+        def core(xr, xi, _p=dict(params)):
+            return pf.fft_pi_layout_pallas_rql(
+                xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
+                tail=_p.get("tail", 128), precision=prec)
+    elif variant == "two-kernel":
+        def core(xr, xi, _p=dict(params)):
+            return pf.fft_pi_layout_pallas2(
+                xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
+                tail=_p.get("tail", 128), precision=prec)
+    elif variant == "mf":
+        def core(xr, xi, _p=dict(params)):
+            return pf.fft_pi_layout_pallas_mf(
+                xr, xi, R=_p.get("R", LANE), cb=_p.get("cb"),
+                tail=_p.get("tail", 128), precision=prec)
+    else:
+        raise ValueError(f"unknown plan variant {variant!r}")
+
+    if not natural:
+        return core
+
+    from ..ops.bits import bit_reverse_indices
+
+    def natural_run(xr, xi):
+        import jax.numpy as jnp
+
+        yr, yi = core(xr, xi)
+        idx = jnp.asarray(bit_reverse_indices(n))
+        return jnp.take(yr, idx, axis=-1), jnp.take(yi, idx, axis=-1)
+
+    return natural_run

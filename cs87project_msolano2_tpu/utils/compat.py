@@ -1,0 +1,66 @@
+"""JAX version compatibility shims.
+
+The framework targets current JAX (top-level ``jax.shard_map`` with
+``check_vma``, ``jax.typeof`` exposing varying-manual-axes, and
+``jax.lax.pvary``) but must also run on the 0.4.x line, where shard_map
+still lives in ``jax.experimental.shard_map`` with a ``check_rep`` kwarg
+and the vma machinery does not exist at all.  Everything
+version-dependent is resolved here, once, so callers stay clean.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication/varying checker kwarg was renamed check_rep -> check_vma
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = True):
+    """shard_map with the checker flag spelled for the running JAX."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check},
+    )
+
+
+def vma_of(x):
+    """The varying-manual-axes set of `x`, or None when this JAX has no
+    vma tracking (0.4.x) or `x` carries none."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    try:
+        return getattr(typeof(x), "vma", None) or None
+    except Exception:
+        return None
+
+
+def shape_struct(shape, dtype, vma=None):
+    """ShapeDtypeStruct carrying `vma` when both the value and the JAX
+    version support it."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # 0.4.x: no vma kwarg
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def pvary_all(arrs, vma):
+    """jax.lax.pvary over a list of arrays; identity where unsupported."""
+    pvary = getattr(jax.lax, "pvary", None)
+    if not vma or pvary is None:
+        return list(arrs)
+    return [pvary(a, tuple(vma)) for a in arrs]

@@ -188,6 +188,26 @@ _GLOBAL_WINDOW: dict = {}
 _OVERHEAD_MIN: list = [None]
 
 
+def reset_program_warm_state() -> int:
+    """Forget that cached slope programs have already run.
+
+    The warm-skip (`has_run` per _PROGRAM_CACHE entry) assumes the relay
+    retains compiled programs for the life of this process.  After a
+    relay reconnect or worker restart — the exact events the harness's
+    run_with_retry absorbs — the server-side compilation is gone, and a
+    fetch issued with warm=False would time the remote recompile inside
+    the timed window (with the harness default reps=1 nothing masks it).
+    Callers that just survived a transient infrastructure error call
+    this so every cached program's next fetch re-warms unmeasured.
+    Returns how many entries were reset."""
+    n = 0
+    for ent in _PROGRAM_CACHE.values():
+        if ent[1]:
+            ent[1] = False
+            n += 1
+    return n
+
+
 def _slope_from_make(make, args, k1, k2, reps, min_delta_ms, max_k,
                      max_program_ms, kind, body=None, auto_window=False):
     """Shared slope machinery: `make(k)` builds the jitted K-application

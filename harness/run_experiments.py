@@ -28,6 +28,9 @@ import numpy as np  # noqa: E402
 
 from cs87project_msolano2_tpu.backends.registry import get_backend  # noqa: E402
 from cs87project_msolano2_tpu.cli import make_input  # noqa: E402
+from cs87project_msolano2_tpu.utils.timing import (  # noqa: E402
+    reset_program_warm_state,
+)
 from cs87project_msolano2_tpu.utils.verify import (  # noqa: E402
     pi_layout_to_natural,
     rel_err,
@@ -148,10 +151,16 @@ def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
         except Exception as e:
             if attempt == attempts - 1:
                 raise
+            # the relay that just dropped likely lost its compiled
+            # programs too: reset the slope cache's warm-skip flags so
+            # no post-reconnect recompile lands inside a timed window
+            nreset = reset_program_warm_state()
             pause = pause_s * (2 ** attempt)
             print(f"# transient backend error ({type(e).__name__}: "
                   f"{str(e)[:120]}); retry {attempt + 1}/{attempts - 1} "
-                  f"in {pause:.0f}s", file=sys.stderr)
+                  f"in {pause:.0f}s"
+                  + (f" (re-warming {nreset} cached timing programs)"
+                     if nreset else ""), file=sys.stderr)
             time.sleep(pause)
 
 

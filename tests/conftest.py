@@ -16,6 +16,11 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
+# plan subsystem: tier-1 runs offline — no test may write the real user
+# cache (or require a TPU to tune).  Tests that exercise the disk store
+# monkeypatch PIFFT_PLAN_CACHE to a tmp dir (it is re-read per call).
+os.environ["PIFFT_PLAN_CACHE"] = "off"
+os.environ.pop("PIFFT_PLAN_AUTOTUNE", None)
 
 import jax  # noqa: E402
 

@@ -1,0 +1,371 @@
+"""Plan subsystem tests — all offline (CPU, tier-1-safe): key round-trip,
+two-level cache hit/miss, disk-store versioning/invalidation, offline
+static-default fallback, the ladder race's tune-or-reject contract, and
+the CLI plan subcommand.  conftest.py sets PIFFT_PLAN_CACHE=off; tests
+that exercise the disk store monkeypatch it to a tmp dir."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import plans
+from cs87project_msolano2_tpu.plans import cache as plan_cache
+from cs87project_msolano2_tpu.plans import ladder
+from cs87project_msolano2_tpu.plans.core import SCHEMA_VERSION, Plan, PlanKey
+
+
+@pytest.fixture(autouse=True)
+def fresh_memory_cache():
+    """Each test starts with an empty in-process cache (the disk level
+    is governed per-test via PIFFT_PLAN_CACHE)."""
+    plan_cache.clear(memory=True, disk=False)
+    yield
+    plan_cache.clear(memory=True, disk=False)
+
+
+def tuned_key(**kw):
+    base = dict(device_kind="TPU test-kind", n=1 << 20, batch=(),
+                layout="pi", precision="split3")
+    base.update(kw)
+    return PlanKey(**base)
+
+
+# ---------------------------------------------------------------- keys
+
+
+def test_key_token_round_trip():
+    for key in (
+        tuned_key(),
+        tuned_key(batch=(64, 8), layout="natural", precision="highest"),
+        plans.make_key(4096, (16,)),
+    ):
+        assert PlanKey.from_token(key.token()) == key
+
+
+def test_key_validation():
+    with pytest.raises(ValueError):
+        tuned_key(layout="scrambled")
+    with pytest.raises(ValueError):
+        tuned_key(precision="bf8")
+
+
+def test_make_key_uses_current_device_kind():
+    key = plans.make_key(1024)
+    assert key.device_kind == plans.current_device_kind()
+    assert key.device_kind.endswith("-interpret")  # CPU test env
+
+
+# ------------------------------------------------- offline static plans
+
+
+def test_offline_never_tunes_and_serves_static():
+    key = plans.make_key(1 << 20)  # CPU device kind
+    with pytest.raises(plans.TuningUnavailable):
+        plans.tune(key)
+    plan = plans.get_plan(key)
+    assert plan.source == "static"
+    assert plan.variant == "jnp"  # offline natural large-n default
+
+
+def test_static_rows_plan_executes_correctly():
+    import jax.numpy as jnp
+
+    plan = plans.plan_for((4, 1024))
+    assert plan.variant == "rows" and plan.source == "static"
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 1024)) + 1j * rng.standard_normal((4, 1024))
+    yr, yi = plan.execute(jnp.asarray(x.real, jnp.float32),
+                          jnp.asarray(x.imag, jnp.float32))
+    y = np.asarray(yr) + 1j * np.asarray(yi)
+    ref = np.fft.fft(x)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-5
+    # inverse round trip through the same dispatch point
+    zr, zi = plan.execute_inverse(yr, yi)
+    z = np.asarray(zr) + 1j * np.asarray(zi)
+    assert np.max(np.abs(z - x)) / np.max(np.abs(x)) < 1e-5
+
+
+def test_pi_layout_requires_kernel_eligible_shape():
+    with pytest.raises(ValueError, match="kernel-eligible"):
+        plans.plan_for((7, 96), layout="pi")  # n < 128: no kernel path
+
+
+def test_fp32_escape_hatch():
+    plan = plans.plan_for((512,), precision="fp32")
+    assert plan.variant == "jnp"
+    with pytest.raises(ValueError):
+        plans.plan_for((512,), layout="pi", precision="fp32")
+
+
+# --------------------------------------------------------------- cache
+
+
+def test_memory_cache_hit_and_miss(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", "off")
+    key = tuned_key()
+    assert plan_cache.lookup(key) is None  # miss
+    plan = Plan(key=key, variant="rql",
+                params={"tile": 1 << 16, "cb": None, "tail": 256},
+                source="tuned", ms=0.09)
+    plan_cache.store(plan)
+    hit = plan_cache.lookup(key)
+    assert hit is plan  # same in-process object
+    assert plan_cache.lookup(tuned_key(n=1 << 21)) is None  # other key
+
+
+def test_disk_store_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = tuned_key()
+    plan = Plan(key=key, variant="fused",
+                params={"tile": 1 << 16, "qb": 32, "tail": 256},
+                source="tuned", ms=0.079)
+    plan_cache.store(plan)
+    path = plan_cache.store_path(key.device_kind)
+    assert os.path.exists(path)
+    # a "second process": drop the memory level, hit the disk level
+    plan_cache.clear(memory=True, disk=False)
+    hit = plan_cache.lookup(key)
+    assert hit is not None and hit.source == "cache"
+    assert hit.variant == "fused" and hit.params["qb"] == 32
+    assert hit.ms == pytest.approx(0.079)
+    # and get_plan serves it without touching static defaults
+    assert plans.get_plan(key).variant == "fused"
+
+
+def test_disk_store_version_invalidation(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = tuned_key()
+    plan_cache.store(Plan(key=key, variant="rql", params={}, source="tuned"))
+    path = plan_cache.store_path(key.device_kind)
+
+    def reload_with(**edits):
+        with open(path) as fh:
+            data = json.load(fh)
+        data.update(edits)
+        with open(path, "w") as fh:
+            json.dump(data, fh)
+        plan_cache.clear(memory=True, disk=False)
+        return plan_cache.lookup(key)
+
+    # stale library version: the whole store is ignored
+    assert reload_with(library_version="0.0.0-other") is None
+    # wrong schema: ignored
+    assert reload_with(library_version=_libver(),
+                       schema=SCHEMA_VERSION + 1) is None
+    # wrong device kind: ignored
+    assert reload_with(schema=SCHEMA_VERSION,
+                       device_kind="TPU someone-elses") is None
+    # corrupt JSON: treated as absent, never an error
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    plan_cache.clear(memory=True, disk=False)
+    assert plan_cache.lookup(key) is None
+
+
+def _libver():
+    from cs87project_msolano2_tpu import __version__
+
+    return __version__
+
+
+def test_cache_off_never_writes(tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", "off")
+    key = tuned_key()
+    plan_cache.store(Plan(key=key, variant="rql", params={}, source="tuned"))
+    assert plan_cache.cache_dir() is None
+    assert list(tmp_path.iterdir()) == []  # nothing written anywhere
+
+
+# ------------------------------------------------------------ autotune
+
+
+def fake_timer_factory(times):
+    """timer(fn, key) that returns canned times per call and raises for
+    entries whose canned value is an exception instance."""
+    seq = iter(times)
+
+    def timer(fn, key):
+        t = next(seq)
+        if isinstance(t, Exception):
+            raise t
+        return t
+
+    return timer
+
+
+def test_tune_races_ladder_and_records_every_candidate(monkeypatch):
+    key = tuned_key()
+    cands = ladder.candidates(key)
+    assert len(cands) >= 8  # the flagship ladder plus the auto-cb entry
+    # first candidate OOMs at the VMEM cliff, second wins, rest lose
+    times = [RuntimeError("RESOURCE_EXHAUSTED: scoped vmem"), 0.094]
+    times += [0.1 + 0.01 * i for i in range(len(cands) - 2)]
+    plan = plans.tune(key, timer=fake_timer_factory(times),
+                      allow_offline=True, persist=False, verbose=False)
+    assert plan.source == "tuned"
+    assert plan.variant == cands[1][0] and plan.params == cands[1][1]
+    assert plan.ms == pytest.approx(0.094)
+    # every ladder entry is tuned (won/lost with ms) or rejected with a
+    # recorded reason — none silently dropped
+    assert len(plan.tuning) == len(cands)
+    for rec in plan.tuning:
+        assert rec.status in ("won", "lost", "rejected")
+        if rec.status == "rejected":
+            assert rec.reason and rec.ms is None
+            assert "RESOURCE_EXHAUSTED" in rec.reason
+        else:
+            assert rec.ms is not None and rec.reason
+    assert [r.status for r in plan.tuning].count("won") == 1
+
+
+def test_tune_cache_hit_skips_race(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = tuned_key()
+    ncands = len(ladder.candidates(key))
+    plans.tune(key, timer=fake_timer_factory([0.1] * ncands),
+               allow_offline=True, verbose=False)
+    # second tune: must NOT invoke the timer at all (a raising timer
+    # proves the race never re-runs), and must log the cache hit
+    plan = plans.tune(key, timer=fake_timer_factory(
+        [AssertionError("ladder re-raced on a cache hit")] * ncands),
+        allow_offline=True)
+    assert plan.variant and capsys.readouterr().err.count("cache hit") == 1
+    # ...even from a fresh process (memory dropped, disk hit)
+    plan_cache.clear(memory=True, disk=False)
+    plan2 = plans.tune(key, timer=fake_timer_factory(
+        [AssertionError("ladder re-raced on a disk hit")] * ncands),
+        allow_offline=True)
+    assert plan2.source == "cache"
+    assert capsys.readouterr().err.count("cache hit") == 1
+
+
+def test_tune_ignores_memoized_static_plan():
+    # get_plan parks static defaults in the same LRU the tuner consults;
+    # those must not masquerade as tuning results or the race never runs
+    key = tuned_key()
+    static = plans.get_plan(key)
+    assert static.source == "static"
+    ncands = len(ladder.candidates(key))
+    plan = plans.tune(key, timer=fake_timer_factory([0.1] * ncands),
+                      allow_offline=True, persist=False, verbose=False)
+    assert plan.source == "tuned" and len(plan.tuning) == ncands
+
+
+def test_autotune_opt_in_not_vetoed_by_static_memo(monkeypatch):
+    # PIFFT_PLAN_AUTOTUNE=1: a static fallback parked in the LRU by an
+    # earlier failed race must not stop get_plan from tuning on retry
+    from cs87project_msolano2_tpu.plans import autotune
+
+    monkeypatch.setenv("PIFFT_PLAN_AUTOTUNE", "1")
+    monkeypatch.setattr(plans, "device_is_tunable", lambda: True)
+    monkeypatch.setattr(autotune, "device_is_tunable", lambda: True)
+    monkeypatch.setattr(autotune, "default_timer", lambda fn, key: 0.5)
+    key = tuned_key()
+    plan_cache.memoize(Plan(key=key, variant="rql", params={},
+                            source="static"))
+    plan = plans.get_plan(key)
+    assert plan.source == "tuned"
+    # and with the opt-in off, the memoized plan (now tuned) still serves
+    monkeypatch.delenv("PIFFT_PLAN_AUTOTUNE")
+    assert plans.get_plan(key) is plan
+
+
+def test_tune_all_rejected_raises_with_reasons():
+    key = tuned_key()
+    ncands = len(ladder.candidates(key))
+    boom = [RuntimeError(f"Mosaic oom {i}") for i in range(ncands)]
+    with pytest.raises(plans.TuningError) as ei:
+        plans.tune(key, timer=fake_timer_factory(boom),
+                   allow_offline=True, verbose=False)
+    assert len(ei.value.results) == ncands
+    assert all(r.status == "rejected" and r.reason
+               for r in ei.value.results)
+
+
+def test_rows_ladder_covers_batched_keys():
+    key = plans.make_key(4096, (64,))
+    cands = ladder.candidates(key)
+    assert cands and all(v == "rows" for v, _ in cands)
+    tails = [p["tail"] for _, p in cands]
+    assert set(tails) == {128, 256}
+
+
+# ------------------------------------------------------ consumer paths
+
+
+def test_fft_planes_fast_goes_through_plans(monkeypatch):
+    """models.fft.fft_planes_fast must dispatch through the plan layer
+    (the acceptance criterion's 'single dispatch point')."""
+    import importlib
+
+    import jax.numpy as jnp
+
+    mfft = importlib.import_module("cs87project_msolano2_tpu.models.fft")
+
+    seen = []
+    real = plans.plan_for
+
+    def spy(shape, layout="natural", precision=None):
+        seen.append((tuple(shape), layout))
+        return real(shape, layout=layout, precision=precision)
+
+    monkeypatch.setattr(plans, "plan_for", spy)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 256)) + 1j * rng.standard_normal((4, 256))
+    yr, yi = mfft.fft_planes_fast(jnp.asarray(x.real, jnp.float32),
+                                  jnp.asarray(x.imag, jnp.float32))
+    assert seen == [((4, 256), "natural")]
+    ref = np.fft.fft(x)
+    y = np.asarray(yr) + 1j * np.asarray(yi)
+    assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-5
+
+
+def test_fft_accepts_explicit_plan_and_precision():
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.fft import fft
+
+    rng = np.random.default_rng(2)
+    x = (rng.standard_normal(512)
+         + 1j * rng.standard_normal(512)).astype(np.complex64)
+    ref = np.fft.fft(x.astype(np.complex128))
+    explicit = plans.plan_for((512,))
+    for y in (fft(x, plan=explicit), fft(x, precision="highest"),
+              fft(x, precision="fp32")):
+        err = np.max(np.abs(np.asarray(y) - ref)) / np.max(np.abs(ref))
+        assert err < 1e-5
+    assert jnp.iscomplexobj(fft(x))
+
+
+# ----------------------------------------------------------------- cli
+
+
+def test_cli_plan_show_and_clear(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    from cs87project_msolano2_tpu.cli import main
+
+    assert main(["plan", "show"]) == 0
+    out = capsys.readouterr().out
+    assert "static defaults" in out  # empty store
+
+    key = plans.make_key(4096, (16,))
+    plan_cache.store(Plan(key=key, variant="rows", params={"tail": 256},
+                          source="tuned", ms=0.5))
+    assert main(["plan", "show"]) == 0
+    out = capsys.readouterr().out
+    assert "n=4096" in out and "rows" in out
+
+    assert main(["plan", "clear"]) == 0
+    assert "removed" in capsys.readouterr().out
+    plan_cache.clear(memory=True, disk=False)
+    assert plan_cache.lookup(key) is None
+
+
+def test_cli_plan_warm_refuses_offline(capsys):
+    from cs87project_msolano2_tpu.cli import main
+
+    assert main(["plan", "warm", "-n", "2^20"]) == 2
+    assert "offline" in capsys.readouterr().err
