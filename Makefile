@@ -8,7 +8,7 @@ SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench replicate \
         run-experiments run-experiments-and-analyze-results analyze \
-        analyze-datasets
+        analyze-datasets check lint
 
 all:
 	$(MAKE) -C cs87project_msolano2_tpu/native all
@@ -42,6 +42,27 @@ run-experiments-and-analyze-results: run-experiments analyze
 
 bench: all
 	python3 bench.py
+
+# project static analysis (check/ subsystem, docs/CHECKS.md): the
+# timing/retrace/Mosaic/plan-key invariants as AST rules, gated on the
+# committed baseline so only NEW violations fail
+check:
+	python3 -m cs87project_msolano2_tpu.cli check \
+	  --baseline check-baseline.json
+
+# lint = ruff (general Python hygiene; skipped with a notice where the
+# environment lacks it) + pifft check (project invariants).  Both always
+# run so one pass reports every finding; the exit status aggregates.
+lint:
+	@status=0; \
+	python3 -m cs87project_msolano2_tpu.cli check \
+	  --baseline check-baseline.json || status=1; \
+	if command -v ruff >/dev/null 2>&1; then \
+	  ruff check . || status=1; \
+	else \
+	  echo "# ruff not installed; skipping (pip install ruff)"; \
+	fi; \
+	exit $$status
 
 # the reference's one-command replication entry (make replicate)
 replicate: recompile run-experiments-and-analyze-results

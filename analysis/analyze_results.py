@@ -217,7 +217,7 @@ def ls_fit(y: np.ndarray, cols: list[np.ndarray]):
     """
     scales = np.array([max(float(np.sqrt(np.mean(c * c))), 1e-30)
                        for c in cols])
-    X = np.column_stack([c / s for c, s in zip(cols, scales)])
+    X = np.column_stack([c / s for c, s in zip(cols, scales, strict=True)])
     betas_n, *_ = np.linalg.lstsq(X, y, rcond=None)
     resid = y - X @ betas_n
     df = max(len(y) - X.shape[1], 1)
@@ -298,7 +298,7 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
         ("funnel", funnel, [funnel_law], ["funnel"]),
         ("tube", tube, [tube_law], ["tube"]),
     ):
-        kept = [(c, nm) for c, nm in zip(xcols, colnames) if np.any(c)]
+        kept = [(c, nm) for c, nm in zip(xcols, colnames, strict=True) if np.any(c)]
         if not kept:
             # Degenerate grid: the law is identically zero here (e.g. a
             # p=1-only sweep, where funnel_law = n(p-1)/p = 0 — this
@@ -362,7 +362,7 @@ def analyze(path: str, alpha_level: float = 0.01, plot_dir: str | None = None,
         ymean = max(float(np.mean(y)), 1e-30)
         while True:
             shares = {nm: float(np.mean(b * c)) / ymean
-                      for nm, b, c in zip(names, betas, cols)}
+                      for nm, b, c in zip(names, betas, cols, strict=True)}
             drop = [nm for nm in names if nm != "floor"
                     and betas[names.index(nm)] < 0 and shares[nm] > -0.01]
             if not drop:

@@ -67,6 +67,7 @@ def num_cores() -> int:
         return os.cpu_count() or 1
 
 
-# kept for API symmetry with timing-free callers
+# kept for API symmetry with timing-free callers; a raw clock read, not
+# a measurement, so the timing-layer rule is waived here
 def wall_ms() -> float:
-    return time.perf_counter() * 1e3
+    return time.perf_counter() * 1e3  # pifft: noqa[PIF102]

@@ -1,0 +1,36 @@
+"""`pifft check`: project-specific static analysis + runtime guards.
+
+The paper's claim rests on measurement discipline — the pi-DFT
+complexity law is verified against timed runs, so a single host sync
+inside a timed window, a silent retrace, or an under-specified plan key
+invalidates a result without failing any functional test.  This package
+enforces those invariants mechanically:
+
+* ``engine``  — AST rule engine: file walking, per-rule config,
+                ``# pifft: noqa[RULE]`` suppression, JSON + human
+                output, committed-baseline comparison.
+* ``rules``   — the bundled rule set (PIF1xx timing, PIF2xx retrace,
+                PIF3xx Mosaic, PIF4xx plan keys, PIF5xx hygiene); see
+                docs/CHECKS.md for each rule's rationale.
+* ``runtime`` — what static analysis cannot see, as pytest fixtures:
+                ``tracer_leak_guard`` (jax.checking_leaks) and
+                ``RecompileGuard`` (per-function retrace budgets).
+* ``cli``     — the ``pifft check`` subcommand; ``make check`` runs it
+                against the committed ``check-baseline.json``.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    check_paths,
+    check_source,
+    compare_baseline,
+    load_baseline,
+    register,
+)
+from .runtime import (  # noqa: F401
+    RecompileBudgetExceeded,
+    RecompileGuard,
+    tracer_leak_guard,
+)

@@ -1,0 +1,114 @@
+"""`pifft check` — the static-analysis entry point.
+
+    pifft check [paths...] [--rule ID ...] [--json]
+                [--baseline FILE] [--write-baseline FILE] [--list-rules]
+
+Default paths are the whole measurement surface: the package plus the
+scripts that produce the paper's timed numbers (bench.py,
+bench_configs.py, exp_perf.py, harness/).
+Exit codes: 0 clean (or matches baseline), 1 findings (or new findings
+vs baseline), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import engine
+
+DEFAULT_PATHS = ("cs87project_msolano2_tpu", "bench.py",
+                 "bench_configs.py", "exp_perf.py", "harness")
+
+
+def _default_paths() -> list:
+    """DEFAULT_PATHS resolved relative to the repo the package was
+    imported from, so `pifft check` works from any cwd.  Entries absent
+    on disk (an installed package without the repo scripts) are
+    dropped."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    return [p for p in (os.path.join(root, name)
+                        for name in DEFAULT_PATHS)
+            if os.path.exists(p)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pifft check",
+        description="project-specific static analysis: timing/retrace/"
+                    "Mosaic/plan-key invariants as AST rules",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the package "
+                         "and bench.py)")
+    ap.add_argument("--rule", action="append", metavar="ID", default=None,
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="compare against a committed baseline: only "
+                         "NEW findings fail")
+    ap.add_argument("--write-baseline", metavar="FILE", default=None,
+                    help="record the current findings as the baseline "
+                         "and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rule ids and summaries, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(engine.all_rules().items()):
+            print(f"{rid}  {rule.name}\n    {rule.summary}")
+        return 0
+
+    # check the raw paths (check_paths opens them as given); the
+    # repo-root-relative display form is only for output metadata, so
+    # the default run works from any cwd
+    raw_paths = args.paths or _default_paths()
+    paths = [engine._display_path(p) for p in raw_paths]
+    try:
+        findings = engine.check_paths(raw_paths, rules=args.rule)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            fh.write(engine.to_json(findings, paths) + "\n")
+        print(f"wrote baseline ({len(findings)} finding(s)) to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.baseline:
+        try:
+            baseline = engine.load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # KeyError/TypeError: a hand-edited or truncated baseline
+            # whose records are missing fields — a usage error (exit 2),
+            # not a findings failure
+            print(f"error: cannot read baseline {args.baseline}: {e!r}",
+                  file=sys.stderr)
+            return 2
+        new, fixed = engine.compare_baseline(findings, baseline)
+        if args.json:
+            print(engine.to_json(new, paths))
+        else:
+            if new:
+                print(engine.format_human(new))
+                print(f"{len(new)} NEW finding(s) vs baseline "
+                      f"{args.baseline}")
+            else:
+                print(f"pifft check: no new findings vs baseline "
+                      f"({len(findings)} known)")
+            if fixed:
+                print(f"note: {len(fixed)} baseline finding(s) no longer "
+                      f"present — consider re-recording with "
+                      f"--write-baseline")
+        return 1 if new else 0
+
+    if args.json:
+        print(engine.to_json(findings, paths))
+    else:
+        print(engine.format_human(findings))
+    return 1 if findings else 0

@@ -1,0 +1,349 @@
+"""The analysis engine: file walking, rule dispatch, suppression,
+baseline comparison.
+
+A rule is a :class:`Rule` subclass registered via :func:`register`; the
+engine parses each file once, hands every selected rule the shared
+:class:`FileContext` (AST, source lines, import aliases, noqa map), and
+collects :class:`Finding`s.  Suppression is per line:
+
+    something_flagged()  # pifft: noqa[PIF101]
+    something_flagged()  # pifft: noqa          (blanket: all rules)
+
+Findings serialize to JSON records; :func:`compare_baseline` splits a
+run against a committed baseline into (new, fixed) so CI fails on new
+violations without forcing an immediate fix of grandfathered ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterable, Iterator, Optional
+
+# files the walker never descends into (build trees, VCS, the C core)
+SKIP_DIRS = {".git", "__pycache__", "native", ".venv", "build", "dist",
+             ".eggs", "node_modules"}
+
+_NOQA_RE = re.compile(
+    r"#\s*pifft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s-]+)\])?", re.IGNORECASE)
+
+# messages may embed a source line ("window opened ... at line 42");
+# normalized out of the baseline key so surrounding edits don't
+# un-grandfather a finding
+_LINE_REF_RE = re.compile(r"\bline \d+\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_record(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_record(cls, d: dict) -> "Finding":
+        return cls(rule=d["rule"], path=d["path"], line=int(d["line"]),
+                   col=int(d.get("col", 0)), message=d["message"])
+
+    def key(self) -> tuple:
+        """Identity for baseline matching.  Line/column drift is
+        expected — any edit above a grandfathered finding moves it — so
+        the key is (rule, path, message) with embedded line references
+        normalized away; :func:`compare_baseline` disambiguates
+        same-key findings by count."""
+        return (self.rule, self.path,
+                _LINE_REF_RE.sub("line _", self.message))
+
+
+class ImportMap:
+    """name-in-scope -> canonical dotted origin, from a module's imports.
+
+    ``import numpy as np`` maps ``np -> numpy``; ``from time import
+    perf_counter as pc`` maps ``pc -> time.perf_counter``.  Rules resolve
+    call targets through this so aliasing cannot dodge them.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.aliases[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def resolve(self, dotted: str) -> str:
+        """Canonicalize a dotted expression's head through the aliases:
+        ``pc`` -> ``time.perf_counter``, ``np.asarray`` ->
+        ``numpy.asarray``.  Unknown heads pass through unchanged."""
+        head, _, rest = dotted.partition(".")
+        origin = self.aliases.get(head)
+        if origin is None:
+            return dotted
+        return f"{origin}.{rest}" if rest else origin
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FileContext:
+    """Everything rules need about one parsed file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.imports = ImportMap(tree)
+        # line -> set of suppressed rule ids, or {"*"} for blanket noqa
+        self.noqa: dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _NOQA_RE.search(line)
+            if not m:
+                continue
+            ids = m.group("ids")
+            if ids:
+                self.noqa[i] = {s.strip().upper()
+                                for s in ids.split(",") if s.strip()}
+            else:
+                self.noqa[i] = {"*"}
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        """Canonical dotted target of a call, through the import map."""
+        name = dotted_name(call.func)
+        return self.imports.resolve(name) if name else None
+
+    def suppressed(self, finding: Finding) -> bool:
+        ids = self.noqa.get(finding.line)
+        return bool(ids) and ("*" in ids or finding.rule.upper() in ids)
+
+
+class Rule:
+    """Base class for analysis rules.
+
+    Subclasses set ``id`` (stable, used in noqa tags and baselines),
+    ``name`` (kebab-case slug), ``summary`` (one line for --list-rules),
+    ``invariant`` (which measurement invariant the rule protects — shown
+    in docs), and optional ``default_config``.  ``check`` yields
+    Findings; it never needs to handle noqa or exemptions (the engine
+    does both).
+    """
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+    invariant: str = ""
+    default_config: dict = {}
+
+    def check(self, ctx: FileContext,
+              config: dict) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=self.id, path=ctx.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0), message=message)
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a rule to the global registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """id -> rule instance, importing the bundled rule set on first use."""
+    from . import rules as _  # noqa: F401  (registration side effect)
+
+    return dict(_REGISTRY)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            # non-directories pass through untouched: existing files are
+            # checked, a nonexistent path (the CI-script typo case)
+            # surfaces as a PIF000 "unreadable" finding instead of a
+            # silently-clean run
+            yield p
+
+
+def _exempt(path: str, patterns: Iterable[str]) -> bool:
+    # match against the absolute path: the display path is cwd-relative,
+    # so `cd utils && pifft check timing.py` would otherwise strip the
+    # directory the exemption glob keys on and the timing layer would
+    # flag itself
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    return any(fnmatch.fnmatch(norm, pat) for pat in patterns)
+
+
+def check_source(path: str, source: str, rules: Optional[Iterable[str]] = None,
+                 config: Optional[dict] = None) -> list:
+    """Run rules over one in-memory source (the unit-test entry point).
+    Returns findings sorted by location; a syntax error yields the
+    single pseudo-finding PIF000 rather than raising."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="PIF000", path=path, line=e.lineno or 1,
+                        col=e.offset or 0,
+                        message=f"file does not parse: {e.msg}")]
+    ctx = FileContext(path, source, tree)
+    selected = all_rules()
+    if rules is not None:
+        want = {r.upper() for r in rules}
+        unknown = want - set(selected)
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+        selected = {k: v for k, v in selected.items() if k in want}
+    overrides = config or {}
+    out = []
+    for rid, rule in sorted(selected.items()):
+        rcfg = dict(rule.default_config)
+        rcfg.update(overrides.get(rid, {}))
+        if _exempt(path, rcfg.get("exempt", ())):
+            continue
+        for f in rule.check(ctx, rcfg):
+            if not ctx.suppressed(f):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# the repo this package lives in: baseline keys for in-repo files are
+# recorded relative to it, not to whatever cwd the checker ran from
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _display_path(path: str) -> str:
+    """repo-root-relative for files under the repo (so baseline keys
+    and CI output are identical from any cwd), cwd-relative for other
+    files under cwd, the original path otherwise."""
+    ap = os.path.abspath(path)
+    for base in (_REPO_ROOT, os.getcwd()):
+        rel = os.path.relpath(ap, base)
+        if not rel.startswith(".."):
+            return rel.replace(os.sep, "/")
+    return path
+
+
+def check_paths(paths: Iterable[str], rules: Optional[Iterable[str]] = None,
+                config: Optional[dict] = None) -> list:
+    """Run rules over files/directories; the CLI and CI entry point."""
+    findings = []
+    for path in iter_python_files(paths):
+        shown = _display_path(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(Finding(
+                rule="PIF000", path=shown, line=1, col=0,
+                message=f"unreadable: {e}"))
+            continue
+        findings.extend(check_source(shown, source, rules, config))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# ---------------------------------------------------------------- output
+
+
+def to_json(findings: list, paths: Iterable[str] = ()) -> str:
+    return json.dumps(
+        {
+            "schema": 1,
+            "paths": list(paths),
+            "count": len(findings),
+            "findings": [f.to_record() for f in findings],
+        },
+        indent=1, sort_keys=True,
+    )
+
+
+def format_human(findings: list) -> str:
+    if not findings:
+        return "pifft check: clean"
+    lines = [f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}"
+             for f in findings]
+    lines.append(f"pifft check: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str) -> list:
+    """Findings recorded in a baseline file (the to_json schema).
+    Raises ValueError on a structurally wrong document so the CLI can
+    report a usage error instead of crashing."""
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or \
+            not isinstance(data.get("findings", []), list):
+        raise ValueError("baseline is not a pifft-check JSON document")
+    return [Finding.from_record(r) for r in data.get("findings", [])]
+
+
+def compare_baseline(findings: list, baseline: list) -> tuple:
+    """(new, fixed): findings not in the baseline, and baseline entries
+    no longer observed.  New findings fail CI; fixed ones only suggest
+    re-recording the baseline.  Matching is by count per key — k
+    identical findings against j grandfathered ones yields max(0, k-j)
+    new — so line drift never un-grandfathers a finding, but a genuine
+    second occurrence of the same violation still fails."""
+
+    def unmatched(items: list, against: list) -> list:
+        budget = Counter(f.key() for f in against)
+        out = []
+        for f in items:
+            if budget[f.key()] > 0:
+                budget[f.key()] -= 1
+            else:
+                out.append(f)
+        return out
+
+    return unmatched(findings, baseline), unmatched(baseline, findings)

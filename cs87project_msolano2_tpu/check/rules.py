@@ -1,0 +1,456 @@
+"""The bundled rule set: project invariants as AST checks.
+
+Every rule protects a measurement invariant of the pi-FFT reproduction
+(docs/CHECKS.md has the full rationale per rule).  Id groups:
+
+* PIF1xx — timing discipline (the paper's complexity law is verified
+  against timed runs; a host sync inside a timed window measures the
+  host, and on the axon relay ``block_until_ready`` is not a barrier)
+* PIF2xx — trace/recompile discipline (a silent retrace hides a compile
+  inside a timed window)
+* PIF3xx — Mosaic/Pallas lowering rules (violations surface as opaque
+  backend errors on hardware only)
+* PIF4xx — plan-cache key coverage (an under-specified PlanKey aliases
+  distinct compiled programs)
+* PIF5xx — hygiene (swallowed exceptions, banned legacy API)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .engine import FileContext, Rule, dotted_name, register
+
+# wall-clock entry points (canonical, post-import-map names)
+WALL_CLOCK = ("time.perf_counter", "time.time", "time.monotonic",
+              "time.process_time", "time.perf_counter_ns", "time.time_ns")
+
+# parameter names that, by project convention, carry static shape /
+# geometry information (transform length, processor count, tile sizes,
+# block widths) — compile-relevant, never traceable
+SHAPE_PARAM_NAMES = ("n", "p", "k", "shape", "tile", "cb", "qb", "tail",
+                     "block_tiles", "levels", "kblock", "reps", "grid")
+
+# the timing layer owns wall-clock and fetch barriers; rules about
+# timing discipline do not apply inside it
+TIMING_LAYER = ("*utils/timing.py",)
+
+
+def _is_wall_clock(ctx: FileContext, call: ast.Call,
+                   names=WALL_CLOCK) -> bool:
+    target = ctx.resolve_call(call)
+    return target in names if target else False
+
+
+def _iter_body_lists(tree: ast.AST) -> Iterator[list]:
+    """Every statement list in the module (module body, function bodies,
+    loop bodies, with bodies, ...)."""
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if isinstance(stmts, list) and stmts and \
+                    isinstance(stmts[0], ast.stmt):
+                yield stmts
+
+
+def _find_windows(ctx: FileContext, stmts: list) -> Iterator[tuple]:
+    """(open_idx, close_idx, var) for each timed window in one statement
+    list: ``var = time.perf_counter()`` ... first later statement whose
+    subtree computes ``<anything> - var`` with a perf_counter call on the
+    left.  Windows whose close lives in a deeper statement list are not
+    matched — progress/ETA trackers spanning whole loops are not timed
+    measurement windows."""
+    for i, stmt in enumerate(stmts):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and _is_wall_clock(ctx, stmt.value)):
+            continue
+        var = stmt.targets[0].id
+        for j in range(i + 1, len(stmts)):
+            closed = False
+            for node in ast.walk(stmts[j]):
+                if (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Sub)
+                        and isinstance(node.right, ast.Name)
+                        and node.right.id == var
+                        and isinstance(node.left, ast.Call)
+                        and _is_wall_clock(ctx, node.left)):
+                    closed = True
+                    break
+            if closed:
+                yield i, j, var
+                break
+
+
+@register
+class HostSyncInTimedWindow(Rule):
+    id = "PIF101"
+    name = "host-sync-in-timed-window"
+    summary = ("no host sync (time.*, np.asarray, .item(), float(...), "
+               "block_until_ready) between timing start/stop markers")
+    invariant = ("a host sync inside a timed window times the host round "
+                 "trip, not the device — one sync invalidates the row")
+    default_config = {
+        "exempt": TIMING_LAYER,
+        "sync_calls": ("numpy.asarray", "numpy.array", "jax.device_get",
+                       "jax.device_put", "jax.block_until_ready"),
+        "sync_methods": ("item", "tolist", "block_until_ready"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        for stmts in _iter_body_lists(ctx.tree):
+            for i, j, var in _find_windows(ctx, stmts):
+                # the closing statement j is scanned too: a sync riding
+                # the stop expression (`(pc() - t0) * scale.item()`)
+                # still executes inside the window (the close's own
+                # perf_counter call is wall-clock, never a sync label)
+                for stmt in stmts[i + 1:j + 1]:
+                    yield from self._scan(ctx, stmt, var,
+                                          stmts[i].lineno, config)
+
+    def _scan(self, ctx, stmt, var, open_line, config):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            label = self._sync_label(ctx, node, config)
+            if label:
+                yield self.finding(
+                    ctx, node,
+                    f"host sync `{label}` inside the timed window opened "
+                    f"by `{var} = time.perf_counter()` at line {open_line}"
+                    f" — it times the host, not the device")
+
+    def _sync_label(self, ctx, call, config) -> Optional[str]:
+        target = ctx.resolve_call(call)
+        if target:
+            if target in config["sync_calls"]:
+                return target
+            if target.startswith("time.") and not _is_wall_clock(ctx, call):
+                return target  # time.sleep and friends
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in config["sync_methods"] and not call.args:
+            return f".{call.func.attr}()"
+        if isinstance(call.func, ast.Name) and call.func.id == "float" \
+                and call.args and not isinstance(call.args[0], ast.Constant):
+            return "float(...)"
+        return None
+
+
+@register
+class WallClockOutsideTimingLayer(Rule):
+    id = "PIF102"
+    name = "wall-clock-outside-timing-layer"
+    summary = ("direct time.perf_counter/time.time calls belong to "
+               "utils/timing.py (time_ms / loop_slope_ms)")
+    invariant = ("only the timing layer knows when block_until_ready is "
+                 "a lie (the axon relay) and when the loop-slope method "
+                 "is required — ad-hoc wall-clock bypasses that choice")
+    default_config = {"exempt": TIMING_LAYER}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_wall_clock(ctx, node):
+                target = ctx.resolve_call(node)
+                yield self.finding(
+                    ctx, node,
+                    f"`{target}()` outside the timing layer — route "
+                    f"measurement through utils.timing (time_ms / "
+                    f"loop_slope_ms) so the relay discipline applies")
+
+
+@register
+class BlockUntilReadyAsBarrier(Rule):
+    id = "PIF103"
+    name = "block-until-ready-as-barrier"
+    summary = ("jax.block_until_ready outside the timing layer — on the "
+               "relay it is not a barrier")
+    invariant = ("on the axon TPU relay block_until_ready returns before "
+                 "the device finishes; only a scalar fetch synchronizes. "
+                 "utils.timing.block documents the caveat; raw call "
+                 "sites look like barriers and are not")
+    default_config = {"exempt": TIMING_LAYER}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve_call(node)
+            if target == "jax.block_until_ready" or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                yield self.finding(
+                    ctx, node,
+                    "block_until_ready used as a barrier — not one on "
+                    "the relay; use utils.timing.block (documented "
+                    "caveat) or a scalar fetch")
+
+
+def _collect_defs(tree: ast.AST) -> dict:
+    """name -> def node for plain functions AND name = lambda aliases."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Lambda):
+            defs[node.targets[0].id] = node.value
+    return defs
+
+
+def _param_names(fn: ast.AST) -> list:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    return [n for n in names if n != "self"]
+
+
+_JIT_NAMES = ("jax.jit", "jax.api.jit")
+_PALLAS_CALL_NAMES = ("jax.experimental.pallas.pallas_call",
+                      "pallas.pallas_call", "pl.pallas_call")
+
+
+def _resolve_jit_like(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """'jit' / 'pallas_call' when the call is one, else None."""
+    target = ctx.resolve_call(call)
+    if target in _JIT_NAMES:
+        return "jit"
+    if target and (target in _PALLAS_CALL_NAMES
+                   or target.endswith(".pallas_call")
+                   or target == "pallas_call"):
+        return "pallas_call"
+    return None
+
+
+@register
+class JitNonStaticShapeArg(Rule):
+    id = "PIF201"
+    name = "jit-nonstatic-shape-arg"
+    summary = ("jax.jit / pallas_call over a function taking shape args "
+               "(n, p, tile, ...) without static_argnums/partial binding")
+    invariant = ("shape args traced as values either fail at trace time "
+                 "or silently retrace per call — a retrace inside a "
+                 "timed window times XLA, not the transform")
+    default_config = {"shape_params": SHAPE_PARAM_NAMES}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        defs = _collect_defs(ctx.tree)
+        shape_names = set(config["shape_params"])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            kind = _resolve_jit_like(ctx, node)
+            if kind is None:
+                continue
+            fn = node.args[0]
+            if isinstance(fn, ast.Lambda):
+                params, label = _param_names(fn), "<lambda>"
+            elif isinstance(fn, ast.Name) and fn.id in defs:
+                params, label = _param_names(defs[fn.id]), fn.id
+            else:
+                continue  # partial(...)/attribute: shape args are bound
+            hit = sorted(set(params) & shape_names)
+            if not hit:
+                continue
+            if kind == "jit" and any(
+                    kw.arg in ("static_argnums", "static_argnames")
+                    for kw in node.keywords):
+                continue
+            fix = ("declare them in static_argnums/static_argnames or "
+                   "bind via functools.partial/closure" if kind == "jit"
+                   else "bind them via functools.partial/closure — "
+                        "pallas_call passes refs only")
+            yield self.finding(
+                ctx, node,
+                f"{kind}({label}) leaves shape arg(s) {hit} dynamic; "
+                f"{fix}")
+
+
+@register
+class JitInLoop(Rule):
+    id = "PIF202"
+    name = "jit-constructed-in-loop"
+    summary = ("jax.jit / pallas_call constructed inside a loop body — a "
+               "fresh callable per iteration defeats the trace cache")
+    invariant = ("each jax.jit() call owns a fresh cache: constructing "
+                 "one per iteration recompiles the same program every "
+                 "time (the retrace class of bug the recompile-guard "
+                 "fixture catches at runtime)")
+    default_config = {}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        yield from self._walk(ctx, ctx.tree, in_loop=False)
+
+    def _walk(self, ctx, node, in_loop) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # a def inside a loop only traces when called; the call
+                # site is what matters, so the loop flag resets here
+                yield from self._walk(ctx, child, in_loop=False)
+                continue
+            if isinstance(child, (ast.For, ast.While)):
+                # only body/orelse re-run per iteration; a jit in the
+                # `for x in ...` iterable or `while ...` test is
+                # evaluated once (For) and suspicious enough anyway
+                # that treating it as in-loop stays correct for While
+                yield from self._walk(ctx, child, in_loop=True)
+                continue
+            if in_loop and isinstance(child, ast.Call):
+                kind = _resolve_jit_like(ctx, child)
+                if kind is not None:
+                    yield self.finding(
+                        ctx, child,
+                        f"{kind}(...) constructed inside a loop body — "
+                        f"hoist it out (or cache it) so the compiled "
+                        f"program is reused across iterations")
+            yield from self._walk(ctx, child, in_loop)
+
+
+@register
+class BlockSpecSublane(Rule):
+    id = "PIF301"
+    name = "blockspec-sublane-rule"
+    summary = ("BlockSpec literal sublane dim (second-to-last) must be 1 "
+               "or a multiple of 8 for float32 tiles")
+    invariant = ("Mosaic tiles float32 as (8, 128): a block whose "
+                 "sublane dim is neither 1 nor a multiple of 8 (nor the "
+                 "whole array) fails lowering with an opaque backend "
+                 "error — on hardware only, long after review")
+    default_config = {"sublane": 8}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name or name.split(".")[-1] != "BlockSpec":
+                continue
+            shape = None
+            if node.args:
+                shape = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "block_shape":
+                    shape = kw.value
+            if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+                continue
+            sub = shape.elts[-2]
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                v = sub.value
+                if v != 1 and v % config["sublane"]:
+                    yield self.finding(
+                        ctx, sub,
+                        f"BlockSpec sublane dim {v} is neither 1 nor a "
+                        f"multiple of {config['sublane']} — Mosaic's "
+                        f"float32 tile rule; rounds up or fails "
+                        f"lowering (a block spanning the WHOLE array "
+                        f"is legal — suppress with "
+                        f"# pifft: noqa[PIF301] there)")
+
+
+@register
+class PlanKeyFieldCoverage(Rule):
+    id = "PIF401"
+    name = "plankey-field-coverage"
+    summary = ("direct PlanKey(...) construction must pass every "
+               "compile-relevant field (or go through plans.make_key)")
+    invariant = ("PlanKey is the plan cache's identity: every input the "
+                 "kernel choice may depend on must be in the key, or two "
+                 "different compiled programs alias one cache entry")
+    default_config = {
+        "exempt": ("*plans/core.py",),
+        "fields": ("device_kind", "n", "batch", "layout", "dtype",
+                   "precision"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        fields = list(config["fields"])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name or name.split(".")[-1] != "PlanKey":
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs: not statically analyzable
+            given = set(fields[:len(node.args)])
+            given |= {kw.arg for kw in node.keywords}
+            missing = [f for f in fields if f not in given]
+            if missing:
+                yield self.finding(
+                    ctx, node,
+                    f"PlanKey(...) leaves compile-relevant field(s) "
+                    f"{missing} defaulted — pass them explicitly (or use "
+                    f"plans.make_key) so the cache key covers every "
+                    f"input the kernel choice depends on")
+
+
+@register
+class BroadExceptSwallow(Rule):
+    id = "PIF501"
+    name = "broad-except-swallow"
+    summary = ("bare/broad except that neither re-raises nor uses the "
+               "caught exception (log, record, print)")
+    invariant = ("a swallowed exception hides the compile failure or "
+                 "infra error that invalidated a measurement; every "
+                 "broad handler must re-raise or record why")
+    default_config = {"broad": ("Exception", "BaseException")}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        broad = set(config["broad"])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type, broad):
+                continue
+            if self._handler_ok(node):
+                continue
+            label = "bare except" if node.type is None else \
+                f"except {dotted_name(node.type) or '...'}"
+            yield self.finding(
+                ctx, node,
+                f"{label} swallows the error — narrow the exception "
+                f"type, or bind it and log/record it, or re-raise")
+
+    def _is_broad(self, type_node, broad) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(e, broad) for e in type_node.elts)
+        name = dotted_name(type_node)
+        return name is not None and name.split(".")[-1] in broad
+
+    def _handler_ok(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if handler.name and isinstance(node, ast.Name) \
+                    and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+        return False
+
+
+@register
+class LegacyTablesKwarg(Rule):
+    id = "PIF502"
+    name = "legacy-tables-kwarg"
+    summary = "the legacy tables= kwarg is banned at call sites"
+    invariant = ("tables= predates the plan subsystem: it bypasses the "
+                 "PlanKey cache entirely, so the call runs an untuned "
+                 "kernel the autotuner can neither see nor race — use "
+                 "plan=/precision= instead")
+    default_config = {}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "tables":
+                    yield self.finding(
+                        ctx, kw.value,
+                        "legacy tables= kwarg — pass plan=/precision= "
+                        "(the plans subsystem) so the kernel choice "
+                        "stays under the plan cache")

@@ -1,0 +1,113 @@
+"""Runtime-assisted guards: what static analysis cannot see.
+
+Two hazards the AST rules (PIF2xx) can only approximate are checkable
+exactly at runtime:
+
+* **tracer leaks** — a traced value escaping its trace (stored on an
+  object, appended to a list) poisons later code with stale tracers.
+  :func:`tracer_leak_guard` wraps a block in ``jax.checking_leaks()``.
+* **silent retraces** — a jitted function re-tracing past its declared
+  budget (unstable shapes/dtypes, non-hashable statics, a fresh closure
+  per call) hides a compile inside what looks like a warm call — on the
+  relay that is ~seconds of XLA inside a "timed" window.
+  :class:`RecompileGuard` counts actual traces per wrapped function and
+  fails loudly when a budget is exceeded.
+
+Both are exposed as pytest fixtures in tests/conftest.py
+(``no_tracer_leaks``, ``recompile_guard``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+
+class RecompileBudgetExceeded(AssertionError):
+    """A guarded jitted function traced more often than its budget."""
+
+
+class RecompileGuard:
+    """Counts traces of jitted functions against declared budgets.
+
+    Usage::
+
+        guard = RecompileGuard()
+        f = guard.jit(my_fn, budget=1)   # drop-in for jax.jit(my_fn)
+        f(x); f(x)                       # same shape: one trace
+        guard.verify()                   # raises if any budget exceeded
+
+    Counting piggybacks on jit semantics: the wrapped Python callable
+    runs exactly once per cache miss (= per trace/compile), so the call
+    count IS the trace count — version-stable, no private jax API.
+    """
+
+    def __init__(self):
+        self._records: list[dict] = []
+
+    def jit(self, fn, *, budget: int = 1, name: str | None = None,
+            **jit_kwargs):
+        """``jax.jit(fn, **jit_kwargs)`` with trace counting attached.
+        ``budget`` is the number of traces this function is ALLOWED
+        (1 for a shape-stable hot path; N for a path serving N known
+        shapes)."""
+        import jax
+
+        rec = {
+            "name": name or getattr(fn, "__name__", repr(fn)),
+            "budget": int(budget),
+            "traces": 0,
+        }
+        self._records.append(rec)
+
+        @functools.wraps(fn)
+        def counted(*args, **kwargs):
+            # under jax.disable_jit() the wrapped fn runs on EVERY call
+            # (call count is no longer trace count) — don't count, so
+            # no-jit debug runs don't fail budgets spuriously
+            if not jax.config.jax_disable_jit:
+                rec["traces"] += 1
+            return fn(*args, **kwargs)
+
+        return jax.jit(counted, **jit_kwargs)
+
+    def report(self) -> list[dict]:
+        """Per-function {name, budget, traces} records (copies)."""
+        return [dict(r) for r in self._records]
+
+    def over_budget(self) -> list[dict]:
+        return [dict(r) for r in self._records
+                if r["traces"] > r["budget"]]
+
+    def verify(self) -> None:
+        """Raise :class:`RecompileBudgetExceeded` if any guarded
+        function traced past its budget (the fixture calls this at
+        teardown, so a retrace regression fails the test that caused
+        it)."""
+        over = self.over_budget()
+        if over:
+            detail = "; ".join(
+                f"{r['name']}: {r['traces']} traces > budget "
+                f"{r['budget']}" for r in over)
+            raise RecompileBudgetExceeded(
+                f"retrace budget exceeded — {detail}. A retrace means "
+                f"the call signature is unstable (shapes, dtypes, fresh "
+                f"closures, unhashable statics); on the relay each one "
+                f"hides seconds of XLA compile inside a timed window.")
+
+
+@contextlib.contextmanager
+def tracer_leak_guard():
+    """``jax.checking_leaks()`` as a reusable guard: any tracer that
+    escapes a trace inside the block raises instead of surfacing later
+    as a baffling UnexpectedTracerError three calls downstream.  On JAX
+    versions without ``checking_leaks`` the guard degrades to a no-op
+    (the runtime check is best-effort by design)."""
+    import jax
+
+    checking = getattr(jax, "checking_leaks", None)
+    if checking is None:  # very old jax: nothing to arm
+        yield
+        return
+    with checking():
+        yield

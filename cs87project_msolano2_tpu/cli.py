@@ -4,6 +4,8 @@
     python -m cs87project_msolano2_tpu { -n <n> -p <p> [-o] [-b <backend>]
                                          [--reps R] | -t [-b <backend>] }
     python -m cs87project_msolano2_tpu plan {show | warm | clear} [...]
+    python -m cs87project_msolano2_tpu check [path ...] [--rule ID]
+                                         [--json] [--baseline FILE]
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
@@ -14,6 +16,10 @@ The `plan` subcommand manages the FFT plan cache (the plans/ subsystem):
 `show` lists the persistent store for this device kind, `warm` tunes a
 key now so serving sessions start on a cache hit, `clear` wipes the
 on-disk store.
+
+The `check` subcommand runs the project's static-analysis pass (the
+check/ subsystem): AST rules for the timing/retrace/Mosaic/plan-key
+invariants, with baseline comparison for CI.  See docs/CHECKS.md.
 """
 
 from __future__ import annotations
@@ -138,6 +144,10 @@ def main(argv=None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "plan":
         return plan_main(argv[1:])
+    if argv and argv[0] == "check":
+        from .check.cli import main as check_main
+
+        return check_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="cs87project_msolano2_tpu",
         description="communication-free pi-FFT over the backend-dispatch boundary",

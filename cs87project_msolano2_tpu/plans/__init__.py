@@ -42,19 +42,24 @@ from .core import (  # noqa: F401
     PlanKey,
     current_device_kind,
     device_is_tunable,
+    warn,
 )
 
 
 def make_key(n: int, batch: tuple = (), layout: str = "natural",
              precision: str | None = None,
-             device_kind: str | None = None) -> PlanKey:
+             device_kind: str | None = None,
+             dtype: str = "float32") -> PlanKey:
     """PlanKey for an n-point transform over `batch` leading dims on the
-    current (or given) device kind."""
+    current (or given) device kind.  Every compile-relevant field is
+    passed explicitly (PIF401): a defaulted field here would silently
+    alias keys if the PlanKey default ever diverged."""
     return PlanKey(
         device_kind=device_kind or current_device_kind(),
         n=int(n),
         batch=tuple(int(b) for b in batch),
         layout=layout,
+        dtype=dtype,
         precision=precision or "split3",
     )
 
@@ -75,8 +80,12 @@ def get_plan(key: PlanKey) -> Plan:
     if opt_in:
         try:
             return tune(key)
-        except Exception:
-            pass  # fall through to the static default
+        except Exception as e:
+            # fall through to the static default — but SAY so: a tuning
+            # race that dies silently looks identical to one that never
+            # ran, and the session serves static plans with no clue why
+            warn(f"opted-in autotune failed ({type(e).__name__}: "
+                 f"{str(e)[:200]}); serving static default")
     from . import ladder
 
     variant, params = ladder.static_default(key)

@@ -32,7 +32,7 @@ except ImportError:  # non-POSIX: merges fall back to last-writer-wins
 from collections import OrderedDict
 from typing import Optional
 
-from .core import SCHEMA_VERSION, Plan, PlanKey
+from .core import SCHEMA_VERSION, Plan, PlanKey, warn
 
 _MEM: OrderedDict = OrderedDict()
 _MEM_MAX = 128
@@ -153,8 +153,13 @@ def store(plan: Plan, persist: bool = True) -> None:
             with open(tmp, "w") as fh:
                 json.dump(data, fh, indent=1, sort_keys=True)
             os.replace(tmp, path)
-    except OSError:
-        pass
+    except OSError as e:
+        # deliberate swallow (a read-only HOME must never break the
+        # transform that just tuned) — but logged: a session silently
+        # re-tuning every run because its store never persists is
+        # otherwise undiagnosable
+        warn(f"plan store write failed ({path}): {e}; tuning result "
+             f"kept in memory only")
 
 
 def disk_entries(device_kind: str) -> dict:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import sys
 from typing import Callable, Optional
 
 LAYOUTS = ("natural", "pi")
@@ -21,6 +22,14 @@ PRECISIONS = ("split3", "highest", "default", "fp32")
 # bump when PlanKey/Plan serialization or ladder parameter semantics
 # change incompatibly — stale disk stores are then ignored wholesale
 SCHEMA_VERSION = 1
+
+
+def warn(msg: str) -> None:
+    """One-line diagnostic to stderr, `# `-prefixed like the tuner's
+    log lines.  Deliberate-swallow sites (PIF501) route through this so
+    a degraded session — store never persisting, autotune dying — says
+    so in a greppable, consistent format."""
+    print(f"# {msg}", file=sys.stderr)
 
 
 def current_device_kind() -> str:
@@ -34,7 +43,10 @@ def current_device_kind() -> str:
     if backend in ("tpu", "axon"):
         try:
             return str(jax.devices()[0].device_kind)
-        except Exception:
+        except (RuntimeError, IndexError, AttributeError):
+            # backend init failure / no devices / relay device object
+            # without device_kind: the backend name is still a stable
+            # (if coarser) plan-cache identity
             return backend
     return f"{backend}-interpret"
 

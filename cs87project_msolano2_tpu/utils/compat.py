@@ -43,7 +43,9 @@ def vma_of(x):
         return None
     try:
         return getattr(typeof(x), "vma", None) or None
-    except Exception:
+    except (TypeError, ValueError, AttributeError):
+        # typeof rejects non-jax values (plain numpy, python scalars);
+        # for vma purposes those simply carry none
         return None
 
 

@@ -21,10 +21,9 @@ def block(x: Any) -> Any:
     """block_until_ready on any pytree of jax arrays; no-op otherwise."""
     try:
         import jax
-
-        return jax.block_until_ready(x)
-    except Exception:
+    except ImportError:
         return x
+    return jax.block_until_ready(x)
 
 
 def time_ms(fn: Callable, *args, reps: int = 1, warmup: int = 1, **kw):

@@ -34,3 +34,30 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs[:8]
+
+
+# --- runtime-assisted guards from the check/ subsystem (docs/CHECKS.md) ---
+
+
+@pytest.fixture
+def recompile_guard():
+    """Retrace-budget guard: ``guard.jit(fn, budget=N)`` is a drop-in
+    jax.jit whose trace count is verified at teardown — a test that
+    makes a guarded function retrace past its budget FAILS, which is
+    the point (a silent retrace hides a compile inside a timed window).
+    """
+    from cs87project_msolano2_tpu.check.runtime import RecompileGuard
+
+    guard = RecompileGuard()
+    yield guard
+    guard.verify()
+
+
+@pytest.fixture
+def no_tracer_leaks():
+    """Arms jax.checking_leaks() for the test: a tracer escaping its
+    trace raises here, at the leak, instead of three calls later."""
+    from cs87project_msolano2_tpu.check.runtime import tracer_leak_guard
+
+    with tracer_leak_guard():
+        yield

@@ -1,7 +1,6 @@
 """Auxiliary subsystems (SURVEY.md §5): probes, tracing, debug checks,
 multi-host wrappers."""
 
-import numpy as np
 import pytest
 
 from cs87project_msolano2_tpu.probes import how_many_tpu_devices, main as probes_main
@@ -165,7 +164,11 @@ def test_multihost_two_process_smoke(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.communicate()
-    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+    for pid, (p, (out, err)) in enumerate(zip(procs, outs, strict=True)):
+        if p.returncode != 0 and \
+                "Multiprocess computations aren't implemented" in err:
+            pytest.skip("jax.distributed multiprocess jobs unsupported "
+                        "on this host's CPU backend")
         assert p.returncode == 0, f"process {pid} failed:\n{out}\n{err}"
         assert f"OK process {pid}" in out
 

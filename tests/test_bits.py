@@ -40,3 +40,48 @@ def test_bit_reverse_indices_matches_scalar():
         assert np.array_equal(idx, expect)
         # a bit-reversal is an involution: applying twice is identity
         assert np.array_equal(idx[idx], np.arange(n))
+
+
+def test_bit_reverse_indices_n_equals_1():
+    """Degenerate transform: n=1 has zero bits, the identity gather."""
+    idx = bit_reverse_indices(1)
+    assert idx.dtype == np.int64
+    assert idx.tolist() == [0]
+    assert ilog2(1) == 0
+    assert bit_reverse(0, 0) == 0
+
+
+def test_bit_reverse_indices_large_n():
+    """The largest n the bench sweeps reach (2^24, the reference's
+    pthreads analysis ceiling): spot-check the construction without
+    materializing the scalar-loop cross-check."""
+    n = 1 << 24
+    idx = bit_reverse_indices(n)
+    assert idx.shape == (n,)
+    assert idx[0] == 0
+    assert idx[1] == n >> 1            # lowest bit -> highest
+    assert idx[n - 1] == n - 1          # all-ones is a palindrome
+    bits = ilog2(n)
+    for k in (2, 3, 12345, n // 2, n - 2):
+        assert idx[k] == bit_reverse(k, bits)
+    # involution on a sample, not the full 128 MB gather
+    sample = np.array([0, 1, 7, 100, n - 1])
+    assert np.array_equal(idx[idx[sample]], sample)
+
+
+def test_bit_reverse_max_int64_bits():
+    """bit_reverse is pure Python int math: the int64 index ceiling
+    (bits=62, the last width np.int64 gathers can address) holds."""
+    bits = 62
+    v = (1 << 61) | 1
+    r = bit_reverse(v, bits)
+    assert r == (1 << 61) | 1  # palindrome value survives
+    assert bit_reverse(1, bits) == 1 << 61
+    for v in (0, 1, 2, 3, (1 << 62) - 1):
+        assert bit_reverse(bit_reverse(v, bits), bits) == v
+
+
+def test_ilog2_rejects_non_powers():
+    for bad in (0, -2, 3, 5, (1 << 20) - 1):
+        with pytest.raises(ValueError):
+            ilog2(bad)

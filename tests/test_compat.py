@@ -1,0 +1,129 @@
+"""utils/compat.py: the JAX version shims (shard_map kwarg spelling,
+vma tracking, ShapeDtypeStruct vma) — both version branches of each,
+exercised via monkeypatching so the suite covers the branch the
+installed JAX does NOT take."""
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.utils import compat
+
+
+# ------------------------------------------------------ shard_map shim
+
+
+def test_check_kw_matches_real_signature():
+    """The kwarg the shim chose at import time must actually exist on
+    the shard_map this JAX ships."""
+    params = inspect.signature(compat._shard_map).parameters
+    assert compat._CHECK_KW in params
+    assert compat._CHECK_KW in ("check_vma", "check_rep")
+
+
+@pytest.mark.parametrize("kw", ["check_vma", "check_rep"])
+def test_shard_map_spells_checker_kwarg_for_each_branch(monkeypatch, kw):
+    """Both JAX lines: current (check_vma) and 0.4.x (check_rep).  The
+    shim must forward mesh/specs untouched and spell the checker flag
+    the way the running JAX expects."""
+    seen = {}
+
+    def fake_shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+        seen.update(kwargs, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, f=f)
+        return "wrapped"
+
+    monkeypatch.setattr(compat, "_shard_map", fake_shard_map)
+    monkeypatch.setattr(compat, "_CHECK_KW", kw)
+    fn = object()
+    out = compat.shard_map(fn, mesh="m", in_specs="i", out_specs="o",
+                           check=False)
+    assert out == "wrapped"
+    assert seen["f"] is fn
+    assert (seen["mesh"], seen["in_specs"], seen["out_specs"]) == \
+        ("m", "i", "o")
+    assert seen[kw] is False
+    assert set(seen) == {"f", "mesh", "in_specs", "out_specs", kw}
+
+
+def test_shard_map_shim_runs_on_real_mesh(devices8):
+    """End-to-end through the REAL shard_map on the virtual CPU mesh:
+    the chosen kwarg spelling is one the installed JAX accepts."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices8[:2]), ("x",))
+    f = compat.shard_map(lambda a: a * 2, mesh=mesh, in_specs=P("x"),
+                         out_specs=P("x"), check=True)
+    x = jnp.arange(8, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) * 2)
+
+
+# ------------------------------------------------------------ vma shims
+
+
+def test_vma_of_plain_values_is_none():
+    assert compat.vma_of(np.ones(4)) is None
+    assert compat.vma_of(jnp.ones(4)) is None  # no manual axes here
+    assert compat.vma_of(3.5) is None
+
+
+def test_vma_of_without_typeof_branch(monkeypatch):
+    """The 0.4.x branch: no jax.typeof at all -> always None."""
+    monkeypatch.delattr(jax, "typeof", raising=False)
+    assert compat.vma_of(jnp.ones(4)) is None
+
+
+def test_vma_of_typeof_raises_branch(monkeypatch):
+    """typeof rejecting a value (plain host object) degrades to None."""
+    def angry_typeof(x):
+        raise TypeError("not a jax value")
+
+    monkeypatch.setattr(jax, "typeof", angry_typeof, raising=False)
+    assert compat.vma_of(object()) is None
+
+
+def test_shape_struct_plain_and_vma():
+    s = compat.shape_struct((4, 8), jnp.float32)
+    assert s.shape == (4, 8) and s.dtype == jnp.float32
+    # empty vma never touches the vma kwarg (0.4.x safe)
+    s2 = compat.shape_struct((2,), jnp.float32, vma=None)
+    assert s2.shape == (2,)
+
+
+def test_shape_struct_vma_fallback_branch(monkeypatch):
+    """A ShapeDtypeStruct without the vma kwarg (0.4.x) must not break
+    the shim — it falls back to the plain struct."""
+    class OldStruct:
+        def __init__(self, shape, dtype):  # no vma kwarg
+            self.shape, self.dtype = shape, dtype
+
+    monkeypatch.setattr(jax, "ShapeDtypeStruct", OldStruct)
+    s = compat.shape_struct((4,), jnp.float32, vma={"x"})
+    assert isinstance(s, OldStruct) and s.shape == (4,)
+
+
+def test_pvary_all_identity_branches(monkeypatch):
+    arrs = [jnp.ones(4), jnp.zeros(4)]
+    # falsy vma: identity regardless of jax version
+    assert compat.pvary_all(arrs, None) == arrs
+    assert compat.pvary_all(arrs, set()) == arrs
+    # no jax.lax.pvary (0.4.x): identity even with a vma set
+    monkeypatch.delattr(jax.lax, "pvary", raising=False)
+    assert compat.pvary_all(arrs, {"x"}) == arrs
+
+
+def test_pvary_all_applies_pvary(monkeypatch):
+    calls = []
+
+    def fake_pvary(a, axes):
+        calls.append(axes)
+        return a
+
+    monkeypatch.setattr(jax.lax, "pvary", fake_pvary, raising=False)
+    arrs = [jnp.ones(2), jnp.ones(3)]
+    out = compat.pvary_all(arrs, {"x"})
+    assert len(out) == 2
+    assert calls == [("x",), ("x",)]

@@ -4,7 +4,6 @@ analysis (the reference's statistical integration test, SURVEY.md §4.2)."""
 import importlib.util
 import os
 import subprocess
-import sys
 
 import numpy as np
 import pytest

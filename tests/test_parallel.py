@@ -6,7 +6,6 @@ paper's no-communication thesis."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from cs87project_msolano2_tpu.parallel import (
     fft2_sharded,
