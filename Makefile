@@ -6,7 +6,7 @@
 # analyze-datasets uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all clean recompile test bench replicate \
+.PHONY: all clean recompile test bench bench-smoke replicate \
         run-experiments run-experiments-and-analyze-results analyze \
         analyze-datasets check lint
 
@@ -42,6 +42,10 @@ run-experiments-and-analyze-results: run-experiments analyze
 
 bench: all
 	python3 bench.py
+
+# the CI rot check: whole reporting pipeline at toy sizes, offline
+bench-smoke:
+	PIFFT_PLAN_CACHE=off python3 bench.py --smoke
 
 # project static analysis (check/ subsystem, docs/CHECKS.md): the
 # timing/retrace/Mosaic/plan-key invariants as AST rules, gated on the

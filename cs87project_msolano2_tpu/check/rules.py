@@ -3,9 +3,11 @@
 Every rule protects a measurement invariant of the pi-FFT reproduction
 (docs/CHECKS.md has the full rationale per rule).  Id groups:
 
-* PIF1xx — timing discipline (the paper's complexity law is verified
-  against timed runs; a host sync inside a timed window measures the
-  host, and on the axon relay ``block_until_ready`` is not a barrier)
+* PIF1xx — timing/hot-path discipline (the paper's complexity law is
+  verified against timed runs; a host sync inside a timed window
+  measures the host, on the axon relay ``block_until_ready`` is not a
+  barrier, and a kernel entry point chaining extra pallas_call round
+  trips is the large-n falloff the bench tracks)
 * PIF2xx — trace/recompile discipline (a silent retrace hides a compile
   inside a timed window)
 * PIF3xx — Mosaic/Pallas lowering rules (violations surface as opaque
@@ -185,6 +187,132 @@ class BlockUntilReadyAsBarrier(Rule):
                     "block_until_ready used as a barrier — not one on "
                     "the relay; use utils.timing.block (documented "
                     "caveat) or a scalar fetch")
+
+
+@register
+class MultiPallasRoundTrip(Rule):
+    id = "PIF104"
+    name = "multi-pallas-round-trip"
+    summary = ("functions named *_pallas* must stream their data through "
+               "ONE pallas_call HBM round trip (noqa with justification "
+               "for known multi-trip fallbacks)")
+    invariant = ("every pallas_call is a full HBM round trip of its "
+                 "operands; a kernel entry point chaining two is the "
+                 "large-n throughput falloff bench.py's roofline rows "
+                 "track — single-pass designs (the fused VMEM carry, "
+                 "the fourstep DMA pipeline) exist precisely to avoid "
+                 "it, so a second trip must be a justified exception")
+    default_config = {"patterns": ("*_pallas*",)}
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+
+        defs = [node for node in ast.walk(ctx.tree)
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+
+        def walk_shallow(fn):
+            # this function's OWN statements only: nested defs are
+            # separate entries in `defs`, and their trips reach the
+            # enclosing function through the call-site weighting —
+            # descending into them here would double-count
+            stack = list(ast.iter_child_nodes(fn))
+            while stack:
+                node = stack.pop()
+                yield node
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                    stack.extend(ast.iter_child_nodes(node))
+
+        fn_defs = (ast.FunctionDef, ast.AsyncFunctionDef)
+        direct = {}      # id(def) -> [pallas_call sites in OWN body]
+        calls = {}       # id(def) -> [(name, call node) in OWN body]
+        children = {}    # id(def) -> {name: immediate nested def}
+        for f in defs:
+            direct[id(f)] = []
+            calls[id(f)] = []
+            children[id(f)] = {}
+            for node in walk_shallow(f):
+                if isinstance(node, fn_defs):
+                    children[id(f)][node.name] = node
+                elif isinstance(node, ast.Call):
+                    if _resolve_jit_like(ctx, node) == "pallas_call":
+                        direct[id(f)].append(node)
+                    elif isinstance(node.func, ast.Name):
+                        calls[id(f)].append((node.func.id, node))
+
+        # scope-aware resolution: a bare-name call in f's own body
+        # resolves through the lexical chain — f's immediate nested
+        # defs, then each enclosing function's (siblings included),
+        # then module level — never a same-named closure of some
+        # UNRELATED function (keying by name alone would collide those)
+        top = {d.name: d for d in ctx.tree.body if isinstance(d, fn_defs)}
+        parent = {}
+        for f in defs:
+            for child in children[id(f)].values():
+                parent[id(child)] = f
+
+        def resolve(f, name):
+            scope = f
+            while scope is not None:
+                target = children[id(scope)].get(name)
+                if target is not None:
+                    return None if target is f else target
+                scope = parent.get(id(scope))
+            target = top.get(name)
+            return None if target is f else target
+
+        # module-local fixpoint on TRIP COUNTS, keyed by def node: a
+        # call to a local wrapper contributes the wrapper's own
+        # round-trip count (so a single call to a two-trip helper is
+        # still two trips), capped at 3 to keep cyclic call graphs
+        # terminating — anything >= 2 flags, exact totals beyond that
+        # don't matter.  Cross-module composition is the plan layer's
+        # job; this rule guards the module where round trips are
+        # authored.
+        trips = {id(f): min(len(direct[id(f)]), 3) for f in defs}
+
+        def weight(f, name):
+            target = resolve(f, name)
+            return trips[id(target)] if target is not None else 0
+
+        for _ in range(len(defs) + 1):
+            changed = False
+            for f in defs:
+                total = min(
+                    len(direct[id(f)])
+                    + sum(weight(f, name) for name, _ in calls[id(f)]),
+                    3)
+                if total != trips[id(f)]:
+                    trips[id(f)] = total
+                    changed = True
+            if not changed:
+                break
+
+        for f in defs:
+            if not any(fnmatch.fnmatch(f.name, pat)
+                       for pat in config["patterns"]):
+                continue
+            sites = [(node, 1) for node in direct[id(f)]]
+            sites += [(node, weight(f, name))
+                      for name, node in calls[id(f)]
+                      if weight(f, name) > 0]
+            sites.sort(key=lambda s: (s[0].lineno, s[0].col_offset))
+            cum = 0
+            for node, w in sites:
+                cum += w
+                if cum <= 1:
+                    continue
+                label = (dotted_name(node.func) or "pallas_call")
+                via = (f" (`{label}` alone makes {w} trips)"
+                       if w > 1 else f" (extra trip via `{label}`)")
+                yield self.finding(
+                    ctx, node,
+                    f"`{f.name}` makes more than one pallas_call HBM "
+                    f"round trip{via} — stream the transform through "
+                    f"one kernel (fused/fourstep designs), or justify "
+                    f"with # pifft: noqa[PIF104]")
 
 
 def _collect_defs(tree: ast.AST) -> dict:

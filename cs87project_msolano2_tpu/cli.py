@@ -3,7 +3,7 @@
 
     python -m cs87project_msolano2_tpu { -n <n> -p <p> [-o] [-b <backend>]
                                          [--reps R] | -t [-b <backend>] }
-    python -m cs87project_msolano2_tpu plan {show | warm | clear} [...]
+    python -m cs87project_msolano2_tpu plan {show | warm | clear | sweep} [...]
     python -m cs87project_msolano2_tpu check [path ...] [--rule ID]
                                          [--json] [--baseline FILE]
 
@@ -15,7 +15,8 @@ golden test through the chosen backend and prints pass/fail.
 The `plan` subcommand manages the FFT plan cache (the plans/ subsystem):
 `show` lists the persistent store for this device kind, `warm` tunes a
 key now so serving sessions start on a cache hit, `clear` wipes the
-on-disk store.
+on-disk store, `sweep` tunes a large-n trajectory and reports the
+measured fourstep crossover (docs/KERNELS.md).
 
 The `check` subcommand runs the project's static-analysis pass (the
 check/ subsystem): AST rules for the timing/retrace/Mosaic/plan-key
@@ -70,15 +71,21 @@ def _parse_n(s: str) -> int:
 
 
 def plan_main(argv) -> int:
-    """`plan {show|warm|clear}` — manage the persistent FFT plan cache."""
+    """`plan {show|warm|clear|sweep}` — manage the persistent FFT plan
+    cache (`sweep` tunes a whole large-n trajectory and reports the
+    measured fourstep crossover — docs/KERNELS.md)."""
     ap = argparse.ArgumentParser(
         prog="cs87project_msolano2_tpu plan",
-        description="show / warm / clear the FFT plan cache "
+        description="show / warm / clear / sweep the FFT plan cache "
                     "(tune once, serve forever)",
     )
-    ap.add_argument("action", choices=("show", "warm", "clear"))
+    ap.add_argument("action", choices=("show", "warm", "clear", "sweep"))
     ap.add_argument("-n", type=_parse_n, default=1 << 20,
                     help="transform length for warm (int or 2^k)")
+    ap.add_argument("--ns", type=_parse_n, nargs="*",
+                    default=[1 << 20, 1 << 22, 1 << 24],
+                    help="sweep: transform lengths to tune "
+                         "(default: the bench trajectory)")
     ap.add_argument("--batch", type=int, nargs="*", default=[],
                     help="leading batch dims for warm (default: none)")
     ap.add_argument("--layout", choices=("natural", "pi"), default="pi",
@@ -119,6 +126,21 @@ def plan_main(argv) -> int:
             print(f"  n={key.n} batch={key.batch} {key.layout} "
                   f"{key.precision}: {rec['variant']} {rec['params']}"
                   + (f" ({ms:.4f} ms)" if ms is not None else ""))
+        return 0
+
+    if args.action == "sweep":
+        try:
+            tuned, cross = plans.tune_sweep(
+                args.ns, layout=args.layout, precision=args.precision,
+                force=args.force)
+        except (plans.TuningUnavailable, plans.TuningError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        for p in tuned:
+            ms = f" ({p.ms:.4f} ms)" if p.ms is not None else ""
+            print(f"  n={p.key.n}: {p.variant} {p.params}{ms}")
+        print(f"measured fourstep crossover: "
+              f"{cross if cross is not None else 'none (never won)'}")
         return 0
 
     # warm

@@ -75,22 +75,25 @@ def ifft(x, p: int = 1, tables=None, plan=None,
     return jnp.conj(fft(jnp.conj(x), p, tables, plan, precision)) / n
 
 
-def fft2(x, p: int = 1):
-    """2-D DFT over the trailing two axes via row then column 1-D passes."""
-    y = fft(x, p)
+def fft2(x, p: int = 1, precision: str | None = None):
+    """2-D DFT over the trailing two axes via row then column 1-D passes.
+    Each pass resolves its own per-shape plan (the two axes may differ),
+    so large axes pick up the large-n kernel family automatically."""
+    y = fft(x, p, precision=precision)
     y = jnp.swapaxes(y, -1, -2)
-    y = fft(y, p)
+    y = fft(y, p, precision=precision)
     return jnp.swapaxes(y, -1, -2)
 
 
-def fftn(x, axes=None, p: int = 1):
+def fftn(x, axes=None, p: int = 1, precision: str | None = None):
     """N-D DFT over `axes` (default: all) via successive 1-D passes."""
     x = jnp.asarray(x)
     if axes is None:
         axes = range(x.ndim)
     y = x
     for ax in axes:
-        y = jnp.moveaxis(fft(jnp.moveaxis(y, ax, -1), p), -1, ax)
+        y = jnp.moveaxis(
+            fft(jnp.moveaxis(y, ax, -1), p, precision=precision), -1, ax)
     return y
 
 

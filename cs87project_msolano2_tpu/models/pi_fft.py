@@ -90,6 +90,47 @@ def tube(sr, si, n, p, tables=None):
     return sr, si
 
 
+def resolve_tube_plan(shape, plan=None, precision=None,
+                      min_segment=None):
+    """THE tube-plan resolution, shared by :func:`tube_planned` and the
+    sharded paths (parallel/pi_shard.py) so the fallback policy exists
+    once: an explicit Plan passes through, ``False`` pins the jnp tube,
+    None resolves per `shape` — returning None (jnp tube) when the
+    segment is at or below `min_segment`, when the plan layer has no
+    kernel for the shape (non-eligible batch/row geometry raises
+    ValueError), or when it would serve the jnp variant (no pi-layout
+    jnp path exists)."""
+    if plan is False:
+        return None
+    if plan is not None:
+        return plan
+    if min_segment is not None and shape[-1] <= min_segment:
+        return None
+    from .. import plans
+
+    try:
+        resolved = plans.plan_for(shape, layout="pi", precision=precision)
+    except ValueError:
+        return None
+    return None if resolved.variant == "jnp" else resolved
+
+
+def tube_planned(sr, si, n, p, plan=None, precision=None):
+    """Tube phase through the plan subsystem.
+
+    A segment's tube IS a standalone s-point pi-layout transform: the
+    n-plan levels k.. coincide exactly with a fresh s-plan's levels 0..
+    (W_{n>>(k+l)} = W_{s>>l} — see ``tube``), so the per-shard-shape
+    plan applies, including the large-n fourstep kernel family at
+    s > 2^20 where the unrolled jnp tube costs minutes of compile.
+    Falls back to the jnp ``tube`` whenever :func:`resolve_tube_plan`
+    serves no kernel plan."""
+    plan = resolve_tube_plan(sr.shape, plan, precision)
+    if plan is None:
+        return tube(sr, si, n, p)
+    return plan.execute(sr, si)
+
+
 def pi_fft_pi_layout(xr, xi, p, tables=None):
     """Full pi-FFT, output in pi layout.  xr/xi: (..., n) -> (..., n)."""
     n = xr.shape[-1]

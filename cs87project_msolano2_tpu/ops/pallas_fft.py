@@ -572,6 +572,22 @@ def _long_range_factors(R: int, C: int):
     )
 
 
+def long_range_vmem_bytes(R: int, cb: int, separable: bool = False) -> int:
+    """Scoped-VMEM footprint estimate of one long-range-kernel program.
+
+    The double-buffered in/out column blocks are 8 planes of R*cb
+    float32; Mosaic's stack reuse keeps the butterfly temps to ~2 more
+    (anchored to the measured 16.75 MB at R=64, cb=2^13 — ~8.4 planes
+    with temps, rounded up to 10 here so the estimate errs toward
+    rejecting).  Dense twiddle tables add their own double-buffered
+    re/im blocks, which across levels sum to ~R*cb entries per plane;
+    the separable A/B factors are negligible (R + levels*cb floats)."""
+    block = R * cb * 4
+    tw = (4 * block if not separable
+          else 2 * (R * 4 + ilog2(max(R, 2)) * cb * 4))
+    return 10 * block + tw
+
+
 def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
                     separable: bool = False):
     """First log2(R) DIF stages of an (R, C)-viewed transform as one
@@ -588,8 +604,21 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
     levels = ilog2(R)
     if cb is None:
         cb = min(C, 4096)
+        while cb > LANE and not interpret and \
+                long_range_vmem_bytes(R, cb, separable) > VMEM_LIMIT_BYTES:
+            cb //= 2
     if C % cb or cb % LANE:
         raise ValueError(f"cb={cb} must divide C={C} and be a multiple of {LANE}")
+    if not interpret and \
+            long_range_vmem_bytes(R, cb, separable) > VMEM_LIMIT_BYTES:
+        # a cb that passes the divisibility check can still blow the
+        # 16 MB scoped-VMEM ceiling once R is large — fail here naming
+        # the limiting (R, cb) pair instead of a remote-compile failure
+        raise ValueError(
+            f"long-range column blocks R={R} x cb={cb} need ~"
+            f"{long_range_vmem_bytes(R, cb, separable) >> 20} MB scoped "
+            f"VMEM (limit {VMEM_LIMIT_BYTES >> 20} MB) — reduce cb (or "
+            f"use a larger tile so R shrinks)")
 
     in_specs = [pl.BlockSpec((R, cb), lambda i: (0, i))] * 2
     if separable:
@@ -648,7 +677,7 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
             separable,
         )
         xr, xi = xr2.reshape(n), xi2.reshape(n)
-    yr, yi = tile_fft_grid(
+    yr, yi = tile_fft_grid(  # pifft: noqa[PIF104] (the documented two-trip fallback path: kept as the tuner's always-lowerable baseline — fourstep/fused are the single-pass designs)
         xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret,
         precision, tail,
     )
@@ -761,7 +790,8 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
 
     if precision is None:
         precision = SPLIT3
-    yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
+    yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104] (two-trip by design: the retiling-free ladder fallback where fused/fourstep reject; its intermediate round trip is what the fourstep pipeline removes)
+        x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
 
 
@@ -890,7 +920,7 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
     def out_row(i):
         return (jnp.maximum(i - QB, 0), 0, 0)
 
-    out = pl.pallas_call(
+    out = pl.pallas_call(  # pifft: noqa[PIF104] (single-pass: the R<2 branch above is a dispatch — exactly one of the two trips ever runs)
         partial(_fused_fft_kernel, levels, R, QB, qb, steps, precision),
         grid=(QB + R,),
         in_specs=in_specs,
@@ -911,8 +941,330 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
         # tries the fast unaliased config first and this one as the
         # reliable fallback.
         input_output_aliases={0: 0, 1: 1} if alias_io else {},
+        # phase B reads what phase A left in the VMEM scratch: the grid
+        # is carry-ordered, and a megacore splitting it across cores
+        # would hand phase B an empty scratch — declare it sequential
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x3r, x3i, a3r, a3i, b3r, b3i, *tables, btr, bti)
+    return out[0].reshape(n), out[1].reshape(n)
+
+
+def _fourstep_kernel(levels, R, QB, qb, steps, precision, separable, *refs):
+    """Single-pass four-step whole-FFT kernel body (Bailey's four-step
+    out-of-core formulation, restated for VMEM): ONE pallas_call whose
+    sequential grid streams the (R, C)-viewed transform through VMEM
+    exactly once per phase, with an HBM-resident carry and manual
+    double-buffered DMA so the memory system never idles —
+
+      steps 0..QB-1   (phase A): long-range DIF stages + twiddles on one
+                      (R, qb, LANE) column block (read via the normal
+                      block pipeline, i.e. hardware-prefetched), result
+                      staged in VMEM and DMA'd to the HBM carry at its
+                      column offset while the NEXT block computes;
+      steps QB..QB+R-1 (phase B): one tile-point DIF per step — row j+1's
+                      carry DMA is issued before row j is consumed, so
+                      the HBM read of the next tile overlaps the current
+                      tile's VPU stages and MXU tail.
+
+    Versus the rql two-kernel path this removes the kernel-launch gap,
+    the inter-kernel retiling, and the un-overlapped intermediate
+    round trip; the fused VMEM-carry path is still faster where the
+    whole transform fits VMEM (n <= 2^20) — see docs/KERNELS.md for the
+    crossover.  DMA discipline: every start is waited exactly once
+    (write slot s re-waited before reuse at block i-2; the boundary
+    drains the last two outstanding writes before the first carry read,
+    because every column write touches every carry row).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ntab = sum(6 if k in ("r8", "r4") else 2 for k, _ in steps)
+    xr_ref, xi_ref = refs[0], refs[1]
+    pos = 2
+    if separable:
+        ar_ref, ai_ref, br_ref, bi_ref = refs[pos:pos + 4]
+        pos += 4
+        lr_tw = ()
+    else:
+        lr_tw = refs[pos:pos + 2 * levels]
+        pos += 2 * levels
+    tw = refs[pos:pos + ntab]
+    btr_ref, bti_ref = refs[pos + ntab], refs[pos + ntab + 1]
+    or_ref, oi_ref = refs[pos + ntab + 2], refs[pos + ntab + 3]
+    (hr_ref, hi_ref, str_ref, sti_ref, rr_ref, ri_ref,
+     wsem, rsem) = refs[pos + ntab + 4:]
+
+    i = pl.program_id(0)
+
+    def write_dma(slot, blk, plane):
+        """Carry write: staging slot -> HBM column slice of block `blk`
+        (strided: R separate (qb, LANE) chunks).  Reconstructed
+        identically at start and wait sites."""
+        stage = (str_ref, sti_ref)[plane]
+        hbm = (hr_ref, hi_ref)[plane]
+        return pltpu.make_async_copy(
+            stage.at[slot],
+            hbm.at[:, pl.dslice(blk * qb, qb), :],
+            wsem.at[slot, plane],
+        )
+
+    def read_dma(slot, row, plane):
+        """Carry read: HBM row `row` (one contiguous tile) -> VMEM row
+        slot."""
+        buf = (rr_ref, ri_ref)[plane]
+        hbm = (hr_ref, hi_ref)[plane]
+        return pltpu.make_async_copy(
+            hbm.at[row], buf.at[slot], rsem.at[slot, plane])
+
+    @pl.when(i < QB)
+    def _phase_a():
+        xr = xr_ref[...]
+        xi = xi_ref[...]
+        rest = xr.shape[1:]  # (qb, LANE)
+        for l in range(levels):
+            half = R >> (l + 1)
+            if separable:
+                o = R - (R >> l)
+                a_r = ar_ref[...][o:o + half].reshape(half, 1, 1)
+                a_i = ai_ref[...][o:o + half].reshape(half, 1, 1)
+                b_r = br_ref[...][l:l + 1]
+                b_i = bi_ref[...][l:l + 1]
+                wr = a_r * b_r - a_i * b_i
+                wi = a_r * b_i + a_i * b_r
+            else:
+                wr = lr_tw[2 * l][...]
+                wi = lr_tw[2 * l + 1][...]
+            xr4 = xr.reshape(-1, 2, half, *rest)
+            xi4 = xi.reshape(-1, 2, half, *rest)
+            ar, br = xr4[:, 0], xr4[:, 1]
+            ai, bi = xi4[:, 0], xi4[:, 1]
+            tr, ti = ar + br, ai + bi
+            dr, di = ar - br, ai - bi
+            ur = dr * wr - di * wi
+            ui = dr * wi + di * wr
+            xr = jnp.stack((tr, ur), axis=1).reshape(R, *rest)
+            xi = jnp.stack((ti, ui), axis=1).reshape(R, *rest)
+
+        s = i % 2
+
+        @pl.when(i >= 2)
+        def _retire_write():
+            # block i-2 DMA'd out of this slot; it must land before the
+            # slot is overwritten (also keeps every start waited once)
+            for plane in (0, 1):
+                write_dma(s, i - 2, plane).wait()
+
+        str_ref[s] = xr
+        sti_ref[s] = xi
+        for plane in (0, 1):
+            write_dma(s, i, plane).start()
+
+        @pl.when(i == QB - 1)
+        def _boundary():
+            # every carry ROW spans all column blocks: drain the (at
+            # most two) outstanding writes, then prefetch row 0 so
+            # phase B starts with its first tile already in flight
+            for blk in ([QB - 2, QB - 1] if QB >= 2 else [QB - 1]):
+                for plane in (0, 1):
+                    write_dma(blk % 2, blk, plane).wait()
+            for plane in (0, 1):
+                read_dma(0, 0, plane).start()
+
+    @pl.when(i >= QB)
+    def _phase_b():
+        j = i - QB
+
+        @pl.when(j + 1 < R)
+        def _prefetch():
+            # slot (j+1)%2 held row j-1, consumed one (sequential) grid
+            # step ago — safe to refill while row j computes
+            for plane in (0, 1):
+                read_dma((j + 1) % 2, j + 1, plane).start()
+
+        s = j % 2
+        for plane in (0, 1):
+            read_dma(s, j, plane).wait()
+        yr, yi = _tile_fft_compute(
+            rr_ref[s], ri_ref[s], steps, tw,
+            btr_ref[:, :], bti_ref[:, :], precision,
+        )
+        or_ref[...] = yr.reshape(or_ref.shape)
+        oi_ref[...] = yi.reshape(oi_ref.shape)
+
+
+def fourstep_vmem_bytes(R: int, cb: int, tile: int, tail: int = 256,
+                        separable: bool = True) -> int:
+    """Scoped-VMEM footprint estimate of one fourstep-kernel program.
+
+    Column side (phase A): the double-buffered input blocks (4 planes of
+    R*cb float32), the two staging slots (4 planes), and ~2 planes of
+    Mosaic stack temps (the long-range anchor: 16.75 MB measured at 8
+    io planes + temps for R*cb = 2^19 — temps are nearly free under
+    stack reuse); dense twiddle mode adds its own double-buffered re/im
+    table blocks (~4 planes — the per-level tables sum to ~R*cb).  Row
+    side (phase B): two read slots + double-buffered output blocks + ~4
+    planes of tile-FFT temps, all tile-sized, plus the tail matrices
+    and the tile twiddle tables (~2.2 tile entries across the
+    mixed-radix steps)."""
+    block = R * cb * 4
+    col = (4 + 4 + 2) * block
+    if not separable:
+        col += 4 * block
+    row = (4 + 4 + 4) * tile * 4
+    tables = 2 * tail * tail * 4 + int(2.5 * tile) * 4
+    return col + row + tables
+
+
+def fourstep_auto_cb(n: int, tile: int, tail: int = 256,
+                     separable: bool = True,
+                     interpret: bool = False) -> int:
+    """The widest Mosaic-legal column block the VMEM budget admits for an
+    n = R*tile fourstep transform: qb a multiple of 8 (sublane rule on
+    the (R, qb, LANE) blocks) dividing Q, preferring >= 25% headroom
+    under the scoped-VMEM ceiling, taking the largest merely-fitting
+    block otherwise.  Raises when even qb=8 cannot fit — that (R, tile)
+    pair needs a larger tile."""
+    R = n // tile
+    Q = tile // LANE
+    legal = [q for q in (1 << k for k in range(3, Q.bit_length()))
+             if q < Q and Q % q == 0] + [Q]
+    fits = [q for q in legal
+            if fourstep_vmem_bytes(R, q * LANE, tile, tail, separable)
+            <= VMEM_LIMIT_BYTES]
+    if not fits:
+        if interpret:  # no scoped-VMEM ceiling in interpret mode
+            return legal[0] * LANE
+        need = fourstep_vmem_bytes(R, legal[0] * LANE, tile, tail,
+                                   separable) >> 20
+        raise ValueError(
+            f"fourstep R={R} is infeasible at n={n} (tile={tile}): its "
+            f"smallest lowerable column block needs ~{need} MB scoped "
+            f"VMEM (limit {VMEM_LIMIT_BYTES >> 20} MB) — use a larger "
+            f"tile")
+    roomy = [q for q in fits
+             if fourstep_vmem_bytes(R, q * LANE, tile, tail, separable)
+             <= VMEM_LIMIT_BYTES * 3 // 4]
+    return (roomy[-1] if roomy else fits[-1]) * LANE
+
+
+def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
+                                  cb: int | None = None, tail: int = 256,
+                                  precision=None, separable: bool = True,
+                                  interpret=None):
+    """Whole-FFT in ONE pallas_call at any n: the four-step pipeline with
+    an HBM carry and manual double-buffered DMA (see _fourstep_kernel).
+
+    The large-n path: where the fused VMEM-carry kernel tops out at
+    n = 2^20 (the carry itself must fit VMEM), this streams column
+    blocks and carry rows through VMEM with reads of block/row i+1
+    overlapping compute of i, and the grid declared
+    ``dimension_semantics=("arbitrary",)`` so a megacore never splits
+    the carry-ordered steps.  `separable` picks the phase-A twiddle
+    mode (factored A/B reconstruction vs dense tables — the dense
+    blocks cost ~R*cb extra VMEM and one more HBM table stream)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _use_interpret()
+    if precision is None:
+        precision = SPLIT3
+    n = xr.shape[-1]
+    if tile is None:
+        tile = min(n, MAX_ROW_TILE)
+    _check_tail(tail, tile)
+    R = n // tile
+    if R < 2:
+        # no long-range phase: the plain tile grid IS single-pass
+        yr, yi = tile_fft_grid(
+            xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret,
+            precision, tail)
+        return yr.reshape(n), yi.reshape(n)
+    Q = tile // LANE
+    levels = ilog2(R)
+    if cb is None:
+        cb = fourstep_auto_cb(n, tile, tail, separable, interpret)
+    if cb % LANE or tile % cb:
+        raise ValueError(f"cb={cb} must divide tile={tile} and be a "
+                         f"multiple of {LANE}")
+    qb = cb // LANE
+    if qb % 8 and qb != Q:
+        raise ValueError(
+            f"cb={cb} gives {qb}-row column blocks; Mosaic's sublane "
+            f"rule needs block rows divisible by 8 or covering the "
+            f"whole tile — use cb >= {8 * LANE}")
+    if not interpret and \
+            fourstep_vmem_bytes(R, cb, tile, tail, separable) > \
+            VMEM_LIMIT_BYTES:
+        raise ValueError(
+            f"fourstep blocks R={R} x cb={cb} (tile={tile}) need ~"
+            f"{fourstep_vmem_bytes(R, cb, tile, tail, separable) >> 20} "
+            f"MB scoped VMEM (limit {VMEM_LIMIT_BYTES >> 20} MB) — "
+            f"reduce cb or pass cb=None")
+    QB = Q // qb
+
+    steps, np_tables = _tile_plan(tile, tail)
+    tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr)
+    btr, bti = _pvary_like(
+        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], xr)
+    x3r = xr.reshape(R, Q, LANE)
+    x3i = xi.reshape(R, Q, LANE)
+
+    def in_col(i):
+        return (0, jnp.minimum(i, QB - 1), 0)
+
+    in_specs = [pl.BlockSpec((R, qb, LANE), in_col)] * 2
+    if separable:
+        ar, ai, br, bi = _pvary_like(
+            [jnp.asarray(t) for t in _long_range_factors(R, tile)], xr)
+        operands = [ar.reshape(R - 1, 1, 1), ai.reshape(R - 1, 1, 1),
+                    br.reshape(levels, Q, LANE),
+                    bi.reshape(levels, Q, LANE)]
+        in_specs += [pl.BlockSpec((R - 1, 1, 1), lambda i: (0, 0, 0))] * 2
+        in_specs += [pl.BlockSpec((levels, qb, LANE), in_col)] * 2
+    else:
+        lr = []
+        for l, (wr, wi) in enumerate(twiddle_tables(n)[:levels]):
+            half = R >> (l + 1)
+            lr.append(jnp.asarray(wr.reshape(half, Q, LANE)))
+            lr.append(jnp.asarray(wi.reshape(half, Q, LANE)))
+        operands = list(_pvary_like(lr, xr))
+        in_specs += [pl.BlockSpec((t.shape[0], qb, LANE), in_col)
+                     for t in operands]
+    in_specs += [pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables]
+    in_specs += [pl.BlockSpec((tail, tail), lambda i: (0, 0))] * 2
+
+    def out_row(i):
+        return (jnp.maximum(i - QB, 0), 0, 0)
+
+    out = pl.pallas_call(  # pifft: noqa[PIF104] (single-pass: the R<2 branch above is a dispatch — exactly one of the two trips ever runs)
+        partial(_fourstep_kernel, levels, R, QB, qb, steps, precision,
+                separable),
+        grid=(QB + R,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, Q, LANE), out_row)] * 2,
+        out_shape=[
+            _out_struct((R, Q, LANE), xr),
+            _out_struct((R, Q, LANE), xi),
+        ],
+        scratch_shapes=[
+            pltpu.ANY((R, Q, LANE), jnp.float32),   # HBM carry (re, im)
+            pltpu.ANY((R, Q, LANE), jnp.float32),
+            pltpu.VMEM((2, R, qb, LANE), jnp.float32),  # write staging
+            pltpu.VMEM((2, R, qb, LANE), jnp.float32),
+            pltpu.VMEM((2, Q, LANE), jnp.float32),      # row read slots
+            pltpu.VMEM((2, Q, LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),            # [slot, plane]
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        # the grid is a carry-ordered pipeline, NOT parallelizable: a
+        # megacore splitting it across cores would race the HBM carry
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x3r, x3i, *operands, *tables, btr, bti)
     return out[0].reshape(n), out[1].reshape(n)
 
 
@@ -1124,7 +1476,8 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
         interpret=interpret,
     )(x3r, x3i, br, bi, atr, ati, b2r, b2i)
 
-    yr, yi = _tile_fft_rows(x3r, x3i, tile, tail, precision, interpret)
+    yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104] (two-trip by design: the matmul-funnel research path, not in the flagship ladder)
+        x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
 
 

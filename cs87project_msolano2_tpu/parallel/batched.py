@@ -23,16 +23,20 @@ from ..utils.compat import shard_map
 
 
 def fft_batched_planes(xr, xi, mesh, axis: str = "data",
-                       inverse: bool = False, natural: bool = True):
+                       inverse: bool = False, natural: bool = True,
+                       precision: str | None = None):
     """1-D FFT along the trailing axis of (B, n) re/im planes,
     batch-sharded over `axis`.  Natural order by default, same
     sharding; `natural=False` returns pi layout (per-row bit-reversed,
     forward only — the kernel-native order with the gather left off,
-    mirroring the flagship bench contract)."""
+    mirroring the flagship bench contract).  `precision` picks the
+    kernel precision mode for the per-shard plan (split3 default /
+    highest / fp32 — see models.fft)."""
     nshards = mesh.shape[axis]
     local = (xr.shape[0] // nshards,) + tuple(xr.shape[1:])
     plan = plans.plan_for(
-        local, layout="natural" if (natural or inverse) else "pi")
+        local, layout="natural" if (natural or inverse) else "pi",
+        precision=precision)
 
     def device_fn(br, bi):
         if inverse:

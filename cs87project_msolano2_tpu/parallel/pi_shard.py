@@ -8,6 +8,15 @@ its local tube; the output is sharded along the segment axis.  The
 computation body contains NO collectives — tests assert the compiled
 HLO is collective-free (test_parallel.py), which is the machine-checked
 form of the paper's thesis.
+
+Kernel dispatch: each device's tube is a standalone (n/p)-point
+pi-layout transform, resolved through the ONE shared policy
+``models.pi_fft.resolve_tube_plan`` — the plan subsystem serves it a
+per-SHARD-shape kernel: at segment lengths past 2^20 the single-pass
+fourstep pipeline, at row-eligible lengths the rows kernel.  The plan
+path auto-engages only above ``PLAN_SEGMENT_MIN`` (where the unrolled
+jnp tube hits its compile-time cliff); pass ``plan=`` to force it, or
+``plan=False`` to pin the jnp tube.
 """
 
 from __future__ import annotations
@@ -19,22 +28,37 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils.compat import shard_map
 
-from ..models.pi_fft import funnel_single, tube
+from ..models.pi_fft import funnel_single, resolve_tube_plan, tube
 from ..ops.twiddle import twiddle_tables
 
+# segment length above which the plan path engages by default: the
+# unrolled jnp tube's compile time explodes past one VMEM tile
+# (ops.pallas_fft.MAX_ROW_TILE), which is also where the kernel family
+# starts to matter
+PLAN_SEGMENT_MIN = 1 << 16
 
-def pi_fft_sharded(xr, xi, mesh, axis: str = "p"):
+
+def pi_fft_sharded(xr, xi, mesh, axis: str = "p", plan=None):
     """pi-FFT over a 1-D mesh axis.  xr/xi: (n,) replicated; returns
     (n,) planes in pi layout, sharded along the mesh axis.
+
+    `plan` routes each device's tube through the plan subsystem (see
+    module docstring); the funnel stays the replicated scalar-select
+    chain either way, so the body remains collective-free.
     """
     p = mesh.shape[axis]
     n = xr.shape[-1]
     tables = twiddle_tables(n)
+    seg_plan = resolve_tube_plan((n // p,), plan,
+                                 min_segment=PLAN_SEGMENT_MIN)
 
     def device_fn(xr_loc, xi_loc):
         pi = jax.lax.axis_index(axis)
         fr, fi = funnel_single(xr_loc, xi_loc, pi, p, tables)
-        tr, ti = tube(fr, fi, n, p, tables)
+        if seg_plan is not None:
+            tr, ti = seg_plan.execute(fr, fi)
+        else:
+            tr, ti = tube(fr, fi, n, p, tables)
         return tr, ti  # (n/p,) per device -> (n,) sharded
 
     fn = shard_map(
@@ -42,25 +66,38 @@ def pi_fft_sharded(xr, xi, mesh, axis: str = "p"):
         mesh=mesh,
         in_specs=(P(), P()),  # replicated
         out_specs=(P(axis), P(axis)),  # segment-sharded
+        # vma checking stays on for the pure-jnp body; the kernel path
+        # disables it like parallel/batched.py (the Pallas HLO
+        # interpreter cannot carry varying-manual-axes through its grid
+        # while-loop — the error text itself prescribes this)
+        check=seg_plan is None,
     )
     return fn(xr, xi)
 
 
 def pi_fft_sharded_batched(xr, xi, mesh, data_axis: str = "data",
-                           seq_axis: str = "p"):
+                           seq_axis: str = "p", plan=None):
     """Batched pi-FFT over a 2-D (data x p) mesh: batches sharded over
     `data_axis` (plain DP), each signal decomposed over `seq_axis` (the
     pi analogue of sequence/context parallelism).  xr/xi: (B, n).
-    Still zero collectives.
+    Still zero collectives; the tube goes through the per-shard-shape
+    plan exactly as in :func:`pi_fft_sharded` (keyed on the
+    (B/dp, n/p) segment block each device actually transforms).
     """
     p = mesh.shape[seq_axis]
     n = xr.shape[-1]
     tables = twiddle_tables(n)
+    bloc = xr.shape[0] // mesh.shape[data_axis]
+    seg_plan = resolve_tube_plan((bloc, n // p), plan,
+                                 min_segment=PLAN_SEGMENT_MIN)
 
     def device_fn(xr_loc, xi_loc):  # (B/dp, n) replicated along seq axis
         pi = jax.lax.axis_index(seq_axis)
         fr, fi = funnel_single(xr_loc, xi_loc, pi, p, tables)
-        tr, ti = tube(fr, fi, n, p, tables)
+        if seg_plan is not None:
+            tr, ti = seg_plan.execute(fr, fi)
+        else:
+            tr, ti = tube(fr, fi, n, p, tables)
         b = tr.shape[0]
         return tr.reshape(b, n // p), ti.reshape(b, n // p)
 
@@ -69,6 +106,7 @@ def pi_fft_sharded_batched(xr, xi, mesh, data_axis: str = "data",
         mesh=mesh,
         in_specs=(P(data_axis, None), P(data_axis, None)),
         out_specs=(P(data_axis, seq_axis), P(data_axis, seq_axis)),
+        check=seg_plan is None,  # see pi_fft_sharded
     )
     return fn(xr, xi)
 

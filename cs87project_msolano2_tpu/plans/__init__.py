@@ -35,7 +35,13 @@ from __future__ import annotations
 import os
 
 from . import cache  # noqa: F401
-from .autotune import TuningError, TuningUnavailable, tune  # noqa: F401
+from .autotune import (  # noqa: F401
+    TuningError,
+    TuningUnavailable,
+    fourstep_crossover,
+    tune,
+    tune_sweep,
+)
 from .core import (  # noqa: F401
     CandidateResult,
     Plan,

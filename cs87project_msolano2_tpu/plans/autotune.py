@@ -134,3 +134,44 @@ def tune(key: PlanKey, *, force: bool = False,
     _log(verbose, f"# plan tuned: {key.token()} -> {best.variant} "
                   f"{best.params} ({best.ms:.4f} ms)")
     return plan
+
+
+def fourstep_crossover(plans: list) -> Optional[int]:
+    """The measured crossover n from a list of tuned plans: the smallest
+    n whose winner is a fourstep variant, None when fourstep never won.
+    The ladder's static expectation is ``ladder.FOURSTEP_MIN_N``; this
+    reports what THIS device actually measured, so a drifted crossover
+    is visible (and can be fed back into the ladder)."""
+    wins = sorted(p.key.n for p in plans if p.variant == "fourstep")
+    return wins[0] if wins else None
+
+
+def tune_sweep(ns, *, layout: str = "pi", precision: Optional[str] = None,
+               force: bool = False, timer: Optional[Callable] = None,
+               verbose: bool = True, allow_offline: bool = False,
+               persist: bool = True):
+    """Per-n crossover selection: race the ladder at each n (the bench's
+    large-n trajectory in one call — each n gets the candidates and
+    ordering :func:`ladder.candidates` enumerates for ITS key) and
+    report the measured fourstep crossover.  Returns
+    ``(plans, crossover_n)``; cached winners short-circuit exactly as in
+    :func:`tune`, so re-sweeping a warmed machine is free.  A single n
+    whose race fails outright (every candidate rejected) is skipped
+    with a logged reason — the other ns' tuned-and-persisted winners
+    survive; only :class:`TuningUnavailable` (offline — no n can tune)
+    propagates."""
+    from . import make_key
+
+    out = []
+    for n in sorted(int(x) for x in ns):
+        key = make_key(n, layout=layout, precision=precision)
+        try:
+            out.append(tune(key, force=force, timer=timer, verbose=verbose,
+                            allow_offline=allow_offline, persist=persist))
+        except TuningError as e:
+            _log(verbose, f"# plan sweep: n={n} race failed ({e}); "
+                          f"skipping this n")
+    cross = fourstep_crossover(out)
+    _log(verbose, f"# plan sweep: measured fourstep crossover = "
+                  f"{cross if cross is not None else 'none (never won)'}")
+    return out, cross

@@ -13,6 +13,11 @@ Every entry is (variant, params).  Variants:
                    scoped-VMEM cliff reliably).
 * ``rql``        — the retiling-free two-kernel composed path on the
                    shared (R, Q, 128) layout.
+* ``fourstep``   — the single-pallas_call large-n pipeline: HBM carry +
+                   manual double-buffered DMA, column blocks streamed
+                   through VMEM once per phase (docs/KERNELS.md).  The
+                   static large-n choice above FOURSTEP_MIN_N, where the
+                   fused VMEM carry no longer fits.
 * ``two-kernel`` — the original long-range + tile grid pair.
 * ``mf``         — the matmul-funnel path (correct and supported, not in
                    the flagship ladder — see bench history in ops).
@@ -37,6 +42,17 @@ from .core import PlanKey, offline_kind
 LANE = 128
 MAX_ROW_TILE = 1 << 16  # ops.pallas_fft.MAX_ROW_TILE (kept import-free)
 FUSED_MAX_N = 1 << 20   # n-point re+im VMEM scratch feasibility bound
+
+# The documented fourstep crossover: below this the fused VMEM-carry
+# kernel holds the whole transform resident and wins (bench r5 flagship
+# at 2^20); at and above it the carry no longer fits VMEM and the
+# single-pass fourstep DMA pipeline is the static choice
+# (docs/KERNELS.md has the budget math behind both bounds).
+FOURSTEP_MIN_N = FUSED_MAX_N << 1
+
+# dense-twiddle fourstep entries are only raced while the per-level
+# dense tables stay affordable to build and stream (~2n table floats)
+FOURSTEP_DENSE_MAX_N = 1 << 22
 
 # the measured flagship variant ladder at large 1-D n (see module doc);
 # fastest-known first so a race's early entries are the likely winners
@@ -66,9 +82,56 @@ def _rows_eligible(key: PlanKey) -> bool:
     return _pow2(key.n) and rows_plan_feasible(_nrows(key), key.n)
 
 
+def _fourstep_feasible(n: int) -> bool:
+    """Can the fourstep kernel lower an n-point transform at the
+    flagship tile?  False once even the smallest Mosaic-legal column
+    block overflows scoped VMEM (R >= 512 at tile=2^16) — the static
+    default must never serve a plan that raises on first execute."""
+    from ..ops.pallas_fft import fourstep_auto_cb
+
+    try:
+        fourstep_auto_cb(n, MAX_ROW_TILE, 256, True)
+    except ValueError:
+        return False
+    return True
+
+
+def fourstep_candidates(n: int) -> list:
+    """The fourstep race entries for an n-point 1-D key, spanning the
+    tunable axes: tile (2^16 flagship + 2^15 doubling R), cb (the
+    VMEM-auto block plus one explicit halving, so the race can catch a
+    smaller-block win the estimate misses), tail, and the
+    separable-twiddle mode (dense raced only while its tables stay
+    affordable — FOURSTEP_DENSE_MAX_N)."""
+    ents = [("fourstep", {"tile": MAX_ROW_TILE, "cb": None, "tail": 256,
+                          "separable": True})]
+    from ..ops.pallas_fft import fourstep_auto_cb
+
+    try:
+        auto = fourstep_auto_cb(n, MAX_ROW_TILE, 256, True)
+    except ValueError:
+        auto = None
+    if auto is not None and auto // 2 >= 8 * LANE:
+        ents.append(("fourstep", {"tile": MAX_ROW_TILE, "cb": auto // 2,
+                                  "tail": 256, "separable": True}))
+    if n <= FOURSTEP_DENSE_MAX_N:
+        ents.append(("fourstep", {"tile": MAX_ROW_TILE, "cb": None,
+                                  "tail": 256, "separable": False}))
+    ents.append(("fourstep", {"tile": MAX_ROW_TILE, "cb": None,
+                              "tail": 128, "separable": True}))
+    ents.append(("fourstep", {"tile": 1 << 15, "cb": None, "tail": 256,
+                              "separable": True}))
+    return ents
+
+
 def candidates(key: PlanKey) -> list:
     """The ordered (variant, params) race for `key`.  Empty when nothing
-    is tunable (the static default may still serve a jnp fallback)."""
+    is tunable (the static default may still serve a jnp fallback).
+    Large-n ordering encodes the per-n crossover expectation: below
+    FOURSTEP_MIN_N the fused VMEM-carry entries lead and fourstep rides
+    at the end (so a surprise win is still caught); at and above it the
+    fourstep entries lead and the fused ones (infeasible there) drop
+    out."""
     if key.precision == "fp32":
         return []  # fp32 forces the jnp path; nothing to race
     cands = []
@@ -79,15 +142,20 @@ def candidates(key: PlanKey) -> list:
         tails = [128, 256] if key.n <= 8192 else [256, 128]
         cands = [("rows", {"tail": t}) for t in tails if t <= key.n]
     elif key.batch == () and _pow2(key.n) and key.n > MAX_ROW_TILE:
-        if key.n <= FUSED_MAX_N:
+        if key.n < FOURSTEP_MIN_N:
             cands = [(v, dict(p)) for v, p in FLAGSHIP_LADDER]
         else:
-            cands = [(v, dict(p)) for v, p in FLAGSHIP_LADDER
-                     if not v.startswith("fused")]
+            cands = fourstep_candidates(key.n)
+            cands += [(v, dict(p)) for v, p in FLAGSHIP_LADDER
+                      if not v.startswith("fused")]
         # the VMEM-aware auto-cb rql shape: at large n the fixed-cb
         # entries exceed the R*cb scoped-VMEM ceiling and reject — this
         # one always lowers
         cands.append(("rql", {"tile": 1 << 16, "cb": None, "tail": 256}))
+        if key.n < FOURSTEP_MIN_N:
+            # below the crossover fourstep is the expected loser — raced
+            # last so the record still shows the margin per n
+            cands += fourstep_candidates(key.n)
     return cands
 
 
@@ -106,12 +174,21 @@ def static_default(key: PlanKey):
     if _rows_eligible(key):
         return "rows", {"tail": LANE if key.n <= 8192 else 256}
     if key.batch == () and _pow2(key.n) and key.n > MAX_ROW_TILE:
-        # large-n 1-D: the composed rql path with the VMEM-aware default
-        # cb (lowerable to n=2^24 — test_pallas.py's large-n case).
-        # Offline, natural order keeps the jnp path (interpret-mode rql
-        # at these sizes costs minutes for nothing), but pi layout has
-        # no jnp equivalent, so it gets the interpret rql plan.
+        # large-n 1-D: above the documented crossover the single-pass
+        # fourstep pipeline is the static choice (the fused VMEM carry
+        # no longer fits, and the two-kernel paths pay the un-overlapped
+        # intermediate round trip bench's large-n rows track); below it
+        # the composed rql path with the VMEM-aware default cb.
+        # Offline, natural order keeps the jnp path (interpret-mode
+        # kernels at these sizes cost minutes for nothing), but pi
+        # layout has no jnp equivalent, so it gets the interpret plan.
         if not (offline_kind(key.device_kind) and natural):
+            if key.n >= FOURSTEP_MIN_N and _fourstep_feasible(key.n):
+                return "fourstep", {"tile": MAX_ROW_TILE, "cb": None,
+                                    "tail": 256, "separable": True}
+            # below the crossover — or where fourstep's smallest legal
+            # column block cannot fit VMEM (R >= 512 at tile=2^16,
+            # i.e. n >= 2^25) — the always-lowerable auto-cb rql plan
             return "rql", {"tile": 1 << 16, "cb": None, "tail": 256}
     if not natural:
         raise ValueError(
@@ -183,6 +260,12 @@ def build_executor(key: PlanKey, variant: str, params: dict):
                 xr, xi, tile=_p.get("tile"), qb=_p.get("qb", 32),
                 tail=_p.get("tail", 256), precision=prec,
                 alias_io=variant.endswith("alias"))
+    elif variant == "fourstep":
+        def core(xr, xi, _p=dict(params)):
+            return pf.fft_pi_layout_pallas_fourstep(
+                xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
+                tail=_p.get("tail", 256), precision=prec,
+                separable=_p.get("separable", True))
     elif variant == "rql":
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas_rql(

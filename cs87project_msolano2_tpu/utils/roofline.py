@@ -1,0 +1,74 @@
+"""HBM-roofline accounting for the FFT paths.
+
+A pi-layout FFT is memory-bound on TPU once it leaves one VMEM tile:
+the arithmetic (5 n log2 n flops at hundreds of GFLOP/s) rides far
+under the MXU roof, so the honest efficiency figure is achieved HBM
+bandwidth against the device's peak.  The convention here charges the
+MINIMUM traffic any implementation must move — read the re+im float32
+planes once, write them once (16 bytes/element) — so the utilization
+number directly exposes both round trips and serialization.  Read it
+against two ceilings: a carry-free path (the fused VMEM kernel,
+n <= 2^20) tops out at 1.0, while ANY large-n design with a
+materialized intermediate — the fourstep HBM carry included — moves
+2x the minimum and is bandwidth-capped at ~0.5 on this scale.  What
+separates fourstep from the two-kernel paths is not bytes but
+OVERLAP: how closely a path approaches its own 0.5 cap measures the
+launch-gap / retiling / un-overlapped-round-trip overhead the
+single-pass pipeline removes.  bench.py reports this per large-n row
+so the large-n falloff — and any fix — is tracked release over
+release (docs/KERNELS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Peak HBM bandwidth per chip, GB/s (vendor-published figures; device
+# kinds as jax reports them in ``device_kind``).  Substrings are
+# matched case-insensitively so minor naming variants ("TPU v5 lite"
+# vs "TPU v5e") still resolve.
+HBM_PEAK_GBPS = {
+    "v2": 700.0,
+    "v3": 900.0,
+    "v4i": 614.0,
+    "v4": 1228.0,
+    "v5p": 2765.0,
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v6e": 1640.0,
+    "v6 lite": 1640.0,
+}
+
+
+def hbm_peak_bytes_per_s(device_kind: str) -> Optional[float]:
+    """Peak HBM bytes/s for a jax ``device_kind`` string, or None when
+    the kind is unknown (interpret backends, unlisted hardware) — the
+    caller omits the utilization figure rather than inventing one.
+    Longest-substring match so "v5 lite" is not shadowed by "v5"."""
+    kind = (device_kind or "").lower()
+    best = None
+    for sub, gbps in HBM_PEAK_GBPS.items():
+        if sub in kind and (best is None or len(sub) > best[0]):
+            best = (len(sub), gbps)
+    return best[1] * 1e9 if best else None
+
+
+def fft_min_hbm_bytes(n: int) -> int:
+    """The floor any n-point float32-plane FFT must move through HBM:
+    one read and one write of the re+im planes (4 B x 2 planes x 2
+    directions = 16 B/element).  Twiddle/table traffic is excluded —
+    it is implementation choice, which is exactly what the utilization
+    figure should penalize."""
+    return 16 * n
+
+
+def roofline_utilization(n: int, ms: float,
+                         device_kind: str) -> Optional[float]:
+    """Achieved fraction of the HBM roofline for an n-point transform
+    measured at `ms` per call, charging the minimum traffic (see
+    fft_min_hbm_bytes).  None when the device peak is unknown or the
+    measurement is degenerate."""
+    peak = hbm_peak_bytes_per_s(device_kind)
+    if peak is None or ms is None or ms <= 0.0:
+        return None
+    return fft_min_hbm_bytes(n) / (ms * 1e-3) / peak

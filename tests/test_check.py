@@ -173,6 +173,144 @@ def test_pif103_timing_block_helper_is_fine():
     assert run(code, "PIF103") == []
 
 
+# ------------------------------------------ PIF104 multi pallas trips
+
+
+def test_pif104_flags_two_direct_pallas_calls():
+    code = """
+        from jax.experimental import pallas as pl
+
+        def fft_pallas_chain(x):
+            y = pl.pallas_call(k1, grid=(4,))(x)
+            return pl.pallas_call(k2, grid=(4,))(y)
+    """
+    found = run(code, "PIF104")
+    assert rule_ids(found) == ["PIF104"]
+    assert "fft_pallas_chain" in found[0].message
+
+
+def test_pif104_resolves_local_wrappers_by_fixpoint():
+    # neither helper is named *_pallas*; the entry point reaches two
+    # round trips only THROUGH them — the fixpoint must still see it
+    code = """
+        from jax.experimental import pallas as pl
+
+        def stage_a(x):
+            return pl.pallas_call(k1, grid=(1,))(x)
+
+        def stage_b(x):
+            return stage_a(x)
+
+        def whole_pallas_path(x):
+            y = stage_a(x)
+            return stage_b(y)
+    """
+    found = run(code, "PIF104")
+    assert rule_ids(found) == ["PIF104"]
+    assert "whole_pallas_path" in found[0].message
+
+
+def test_pif104_counts_trips_through_a_single_wrapper_call():
+    # ONE call site reaching TWO round trips through a local helper
+    # must still flag: the fixpoint carries trip counts, not just
+    # reachability
+    code = """
+        from jax.experimental import pallas as pl
+
+        def helper(x):
+            y = pl.pallas_call(k1, grid=(1,))(x)
+            return pl.pallas_call(k2, grid=(1,))(y)
+
+        def whole_pallas(x):
+            return helper(x)
+    """
+    found = run(code, "PIF104")
+    assert [f.rule for f in found].count("PIF104") >= 1
+    assert any("whole_pallas" in f.message and "2 trips" in f.message
+               for f in found)
+
+
+def test_pif104_nested_launcher_counts_once():
+    # one round trip through a nested closure: the pallas_call belongs
+    # to `launch`, and fft_rows_pallas reaches it once — descending
+    # into the nested def AND weighting its call site would
+    # double-count and falsely flag
+    code = """
+        from jax.experimental import pallas as pl
+
+        def fft_rows_pallas(x):
+            def launch(y):
+                return pl.pallas_call(k1, grid=(4,))(y)
+            return launch(x)
+    """
+    assert run(code, "PIF104") == []
+
+
+def test_pif104_same_named_defs_do_not_collide():
+    # another function's nested two-trip closure named `helper` must
+    # not poison resolution of the module-level single-trip `helper`:
+    # bare-name calls resolve to own nested defs, then module scope
+    code = """
+        from jax.experimental import pallas as pl
+
+        def other(x):
+            def helper(y):
+                a = pl.pallas_call(k1, grid=(1,))(y)
+                return pl.pallas_call(k2, grid=(1,))(a)
+            return helper(x)
+
+        def helper(y):
+            return pl.pallas_call(k1, grid=(1,))(y)
+
+        def fft_rows_pallas(x):
+            return helper(x)
+    """
+    assert run(code, "PIF104") == []
+
+
+def test_pif104_sibling_nested_helpers_resolve():
+    # trips routed nested-helper -> sibling nested helper must still
+    # count: resolution walks the lexical chain, not just own children
+    code = """
+        from jax.experimental import pallas as pl
+
+        def whole_pallas(x):
+            def a(y):
+                return pl.pallas_call(k1, grid=(1,))(y)
+            def b(y):
+                return a(a(y))
+            return b(x)
+    """
+    found = run(code, "PIF104")
+    assert [f.rule for f in found].count("PIF104") >= 1
+    assert any("whole_pallas" in f.message for f in found)
+
+
+def test_pif104_single_trip_and_unmatched_names_pass():
+    code = """
+        from jax.experimental import pallas as pl
+
+        def fft_rows_pallas(x):
+            return pl.pallas_call(k1, grid=(4,))(x)
+
+        def two_kernel_driver(x):  # not *_pallas*: out of scope
+            y = fft_rows_pallas(x)
+            return fft_rows_pallas(y)
+    """
+    assert run(code, "PIF104") == []
+
+
+def test_pif104_noqa_with_justification():
+    code = """
+        from jax.experimental import pallas as pl
+
+        def fft_pallas_fallback(x):
+            y = pl.pallas_call(k1, grid=(4,))(x)
+            return pl.pallas_call(k2, grid=(4,))(y)  # pifft: noqa[PIF104] (deliberate two-trip fallback)
+    """
+    assert run(code, "PIF104") == []
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 

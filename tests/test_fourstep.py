@@ -1,0 +1,377 @@
+"""Four-step single-pass large-n path: kernel parity, VMEM budget
+validation, plan-ladder crossover selection, sharded-path pickup, and
+the bench's roofline accounting (interpret mode on the CPU backend; the
+same code compiles for TPU — bench.py exercises that on hardware)."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+from cs87project_msolano2_tpu.ops.pallas_fft import (
+    VMEM_LIMIT_BYTES,
+    fft_pi_layout_pallas2,
+    fft_pi_layout_pallas_fourstep,
+    fourstep_auto_cb,
+    fourstep_vmem_bytes,
+    long_range_grid,
+    long_range_vmem_bytes,
+)
+
+
+def rand_planes(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal(n).astype(np.float32),
+        rng.standard_normal(n).astype(np.float32),
+    )
+
+
+def to_complex(yr, yi):
+    return np.asarray(yr).astype(np.complex128) + 1j * np.asarray(yi)
+
+
+def np_pi_layout(x, n):
+    return np.fft.fft(x.astype(np.complex128))[bit_reverse_indices(n)]
+
+
+# ------------------------------------------------------- kernel parity
+
+
+@pytest.mark.parametrize("n,tile,cb,tail,separable", [
+    (1 << 12, 1 << 11, None, 128, True),     # R=2 (minimal long range)
+    (1 << 13, 1 << 10, None, 128, True),     # qb == Q: QB=1 boundary
+    (1 << 14, 1 << 11, 1 << 10, 128, True),  # QB=2: boundary drains both
+    (1 << 15, 1 << 12, 1 << 10, 256, True),  # QB=4: in-phase slot waits
+    (1 << 15, 1 << 12, 1 << 10, 256, False),  # dense-twiddle phase A
+    (1 << 16, 1 << 13, None, 256, True),     # deeper R=8 pipeline
+])
+def test_fourstep_vs_numpy(n, tile, cb, tail, separable):
+    xr, xi = rand_planes(n, seed=21)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_fourstep(
+        xr, xi, tile=tile, cb=cb, tail=tail, separable=separable)
+    err = np.max(np.abs(to_complex(yr, yi) - np_pi_layout(x, n))) / \
+        np.max(np.abs(np_pi_layout(x, n)))
+    assert err < 1e-5, (n, tile, cb, tail, separable, err)
+
+
+def test_fourstep_matches_two_kernel_path():
+    """Three-way parity: the single-pass fourstep pipeline, the
+    two-kernel pallas2 path, and numpy must agree on the same input —
+    the DMA-carry dataflow may not change a single value."""
+    n, tile = 1 << 14, 1 << 12
+    xr, xi = rand_planes(n, seed=22)
+    x = xr.astype(np.complex128) + 1j * xi
+    fr, fi = fft_pi_layout_pallas_fourstep(xr, xi, tile=tile, tail=128)
+    tr, ti = fft_pi_layout_pallas2(xr, xi, tile=tile, tail=128)
+    ref = np_pi_layout(x, n)
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(to_complex(fr, fi) - ref)) / scale < 1e-5
+    assert np.max(np.abs(to_complex(tr, ti) - ref)) / scale < 1e-5
+    # fourstep vs pallas2 directly: identical stage math, tighter bound
+    assert np.max(np.abs(to_complex(fr, fi) - to_complex(tr, ti))) / \
+        scale < 1e-5
+
+
+def test_fourstep_flagship_size():
+    """The flagship n=2^20 shape end-to-end through the default
+    (auto-cb, separable) configuration."""
+    n = 1 << 20
+    xr, xi = rand_planes(n, seed=23)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_fourstep(xr, xi)
+    ref = np_pi_layout(x, n)
+    err = np.max(np.abs(to_complex(yr, yi) - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5
+
+
+@pytest.mark.slow
+def test_fourstep_large_n_2_22():
+    """Large-n reach: the acceptance shape (R=64 at tile=2^16) through
+    the exact static-default parameters the plan layer serves."""
+    n = 1 << 22
+    xr, xi = rand_planes(n, seed=24)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_fourstep(xr, xi, tile=1 << 16, tail=256)
+    ref = np_pi_layout(x, n)
+    err = np.max(np.abs(to_complex(yr, yi) - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5
+
+
+def test_fourstep_r1_fallback():
+    """tile == n: no long-range phase; the tile grid serves directly."""
+    n = 1 << 13
+    xr, xi = rand_planes(n, seed=25)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_fourstep(xr, xi, tile=n, tail=128)
+    ref = np_pi_layout(x, n)
+    assert np.max(np.abs(to_complex(yr, yi) - ref)) / \
+        np.max(np.abs(ref)) < 1e-5
+
+
+# --------------------------------------------------- budget validation
+
+
+def test_fourstep_cb_validation():
+    xr, xi = rand_planes(1 << 13, seed=26)
+    with pytest.raises(ValueError):  # cb does not divide tile
+        fft_pi_layout_pallas_fourstep(xr, xi, tile=1 << 11, cb=768)
+    with pytest.raises(ValueError, match="sublane"):
+        # qb=4: neither a multiple of 8 nor the whole tile
+        fft_pi_layout_pallas_fourstep(xr, xi, tile=1 << 11, cb=512)
+
+
+def test_fourstep_vmem_budget_error_names_shape():
+    """An explicit (R, cb) pair past the scoped-VMEM ceiling must fail
+    with the pair named, before any lowering is attempted."""
+    n, tile = 1 << 22, 1 << 14  # R = 256
+    xr, xi = rand_planes(n, seed=27)
+    assert fourstep_vmem_bytes(256, 1 << 13, tile) > VMEM_LIMIT_BYTES
+    with pytest.raises(ValueError, match=r"R=256 x cb=8192"):
+        fft_pi_layout_pallas_fourstep(xr, xi, tile=tile, cb=1 << 13,
+                                      interpret=False)
+
+
+def test_fourstep_auto_cb_budget():
+    """The auto chooser must produce lowerable blocks through the
+    acceptance range (2^21..2^24 at tile=2^16) and raise clearly when
+    no legal block can fit."""
+    for logn in (21, 22, 23, 24):
+        cb = fourstep_auto_cb(1 << logn, 1 << 16)
+        R = (1 << logn) >> 16
+        assert cb % 128 == 0 and (cb // 128) % 8 == 0
+        assert fourstep_vmem_bytes(R, cb, 1 << 16) <= VMEM_LIMIT_BYTES
+    with pytest.raises(ValueError, match="infeasible"):
+        fourstep_auto_cb(1 << 26, 1 << 14)  # R = 4096: nothing fits
+
+
+def test_long_range_vmem_budget_error_names_pair():
+    """Satellite: long_range_grid must reject a (R, cb) pair that passes
+    the divisibility check but exceeds VMEM, naming the pair instead of
+    deferring to a remote-compile failure."""
+    import jax.numpy as jnp
+
+    R, C = 512, 1 << 14
+    xr = jnp.zeros((R, C), jnp.float32)
+    assert long_range_vmem_bytes(R, 1 << 13) > VMEM_LIMIT_BYTES
+    with pytest.raises(ValueError, match=r"R=512 x cb=8192"):
+        long_range_grid(xr, xr, cb=1 << 13, interpret=False)
+    # the auto chooser shrinks cb under the same budget instead
+    assert long_range_vmem_bytes(
+        R, min(C, 4096), separable=False) > VMEM_LIMIT_BYTES  # would blow
+    # divisibility violations still raise their own error first
+    with pytest.raises(ValueError, match="divide"):
+        long_range_grid(xr, xr, cb=100)
+
+
+def test_long_range_separable_matches_dense():
+    """Satellite: the factored A/B twiddle reconstruction must agree
+    with the dense-table path bit-for-bit at the output tolerance."""
+    import jax.numpy as jnp
+
+    R, C = 16, 1 << 10
+    xr, xi = rand_planes(R * C, seed=28)
+    x2r = jnp.asarray(xr.reshape(R, C))
+    x2i = jnp.asarray(xi.reshape(R, C))
+    dr, di = long_range_grid(x2r, x2i, cb=256, separable=False)
+    sr, si = long_range_grid(x2r, x2i, cb=256, separable=True)
+    scale = np.max(np.abs(to_complex(dr, di)))
+    assert np.max(np.abs(to_complex(dr, di) - to_complex(sr, si))) / \
+        scale < 1e-6
+
+
+# ----------------------------------------------- ladder and crossover
+
+
+def test_static_default_selects_fourstep_only_above_crossover():
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans import ladder
+
+    def variant(n, kind="TPU v5e", layout="pi"):
+        return ladder.static_default(
+            plans.make_key(n, layout=layout, device_kind=kind))[0]
+
+    assert variant(1 << 14) == "rows"
+    assert variant(1 << 18) == "rql"
+    assert variant(1 << 20) == "rql"  # below the crossover
+    for logn in (21, 22, 24):
+        assert variant(1 << logn) == "fourstep"
+    # offline natural keeps the jnp path (interpret kernels cost minutes
+    # for nothing); offline pi layout has no jnp equivalent
+    assert variant(1 << 22, kind="cpu-interpret",
+                   layout="natural") == "jnp"
+    assert variant(1 << 22, kind="cpu-interpret") == "fourstep"
+    assert ladder.FOURSTEP_MIN_N == 1 << 21
+    # past fourstep's own feasibility bound (R >= 512 at tile=2^16 —
+    # no legal column block fits VMEM) the static default must serve
+    # the always-lowerable rql plan, never one that raises on execute
+    assert variant(1 << 25) == "rql"
+    assert variant(1 << 26) == "rql"
+
+
+def test_ladder_orders_fourstep_by_crossover():
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans import ladder
+
+    below = ladder.candidates(
+        plans.make_key(1 << 20, layout="pi", device_kind="TPU v5e"))
+    above = ladder.candidates(
+        plans.make_key(1 << 22, layout="pi", device_kind="TPU v5e"))
+    assert below[0][0] == "fused"          # flagship leads below
+    assert above[0][0] == "fourstep"       # fourstep leads above
+    # fourstep is still raced below the crossover (a surprise win must
+    # be observable), and fused never appears above it
+    assert any(v == "fourstep" for v, _ in below)
+    assert not any(v.startswith("fused") for v, _ in above)
+    # every fourstep entry builds an executor (params are coherent)
+    for v, p in above:
+        if v == "fourstep":
+            assert p["tile"] in (1 << 15, 1 << 16) and "separable" in p
+
+
+def test_tune_sweep_reports_measured_crossover():
+    """Per-n crossover selection: with an injected timer that makes the
+    first candidate win at every n, the sweep's measured crossover is
+    the first n whose ladder leads with fourstep."""
+    import itertools
+
+    from cs87project_msolano2_tpu import plans
+
+    cnt = itertools.count()
+    out, cross = plans.tune_sweep(
+        [1 << 20, 1 << 22],
+        timer=lambda fn, key: 1.0 + next(cnt) * 1e-3,
+        allow_offline=True, persist=False, verbose=False)
+    assert [p.key.n for p in out] == [1 << 20, 1 << 22]
+    assert out[0].variant == "fused" and out[1].variant == "fourstep"
+    assert cross == 1 << 22
+    assert plans.fourstep_crossover(out) == cross
+    assert plans.fourstep_crossover(out[:1]) is None
+    # one n whose race fails outright is skipped, not fatal: the other
+    # ns' winners (already tuned/persisted) survive the sweep
+    from cs87project_msolano2_tpu.plans import ladder
+
+    n_bad = 1 << 24
+    bad_count = len(ladder.candidates(
+        plans.make_key(n_bad, layout="pi")))
+
+    def flaky_timer(fn, key, _c=itertools.count()):
+        if key.n == n_bad:
+            raise RuntimeError("RESOURCE_EXHAUSTED: scoped vmem")
+        return 1.0 + next(_c) * 1e-3
+
+    out2, cross2 = plans.tune_sweep(
+        [1 << 22, n_bad], timer=flaky_timer,
+        allow_offline=True, persist=False, verbose=False)
+    assert [p.key.n for p in out2] == [1 << 22]
+    assert cross2 == 1 << 22
+    assert bad_count > 0  # the failed n had a real race to lose
+
+
+def test_fourstep_plan_executes():
+    """A fourstep Plan built by the ladder executor must run end-to-end
+    (natural layout bakes the gather in)."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans.core import Plan
+
+    n = 1 << 13
+    key = plans.make_key(n, layout="natural")
+    plan = Plan(key=key, variant="fourstep",
+                params={"tile": 1 << 10, "tail": 128}, source="static")
+    xr, xi = rand_planes(n, seed=29)
+    yr, yi = plan.execute(xr, xi)
+    ref = np.fft.fft(xr.astype(np.complex128) + 1j * xi)
+    err = np.max(np.abs(to_complex(yr, yi) - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5
+
+
+# ------------------------------------------------- sharded-path pickup
+
+
+def test_tube_planned_matches_tube():
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu.models.pi_fft import (
+        funnel,
+        tube,
+        tube_planned,
+    )
+
+    n, p = 1 << 12, 4
+    xr, xi = rand_planes(n, seed=30)
+    fr, fi = funnel(jnp.asarray(xr), jnp.asarray(xi), p)
+    ar, ai = tube_planned(fr, fi, n, p)
+    br, bi = tube(fr, fi, n, p)
+    scale = np.max(np.abs(to_complex(br, bi)))
+    assert np.max(np.abs(to_complex(ar, ai) - to_complex(br, bi))) / \
+        scale < 1e-5
+
+
+def test_pi_fft_sharded_with_plan(devices8):
+    """The sharded path with an explicit per-shard-shape plan must match
+    the tables path (same pi-layout output, same sharding) — the wiring
+    that lets each device's tube run the kernel family."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.parallel.pi_shard import pi_fft_sharded
+
+    n, p = 1 << 13, 8
+    mesh = Mesh(np.array(devices8[:p]), ("p",))
+    xr, xi = rand_planes(n, seed=31)
+    xj, yj = jnp.asarray(xr), jnp.asarray(xi)
+    ref_r, ref_i = pi_fft_sharded(xj, yj, mesh)  # jnp tube (auto: small s)
+    plan = plans.get_plan(plans.make_key(n // p, layout="pi"))
+    assert plan.variant == "rows"
+    kr, ki = pi_fft_sharded(xj, yj, mesh, plan=plan)
+    scale = np.max(np.abs(to_complex(ref_r, ref_i)))
+    assert np.max(np.abs(to_complex(kr, ki) - to_complex(ref_r, ref_i))) / \
+        scale < 1e-5
+    # plan=False pins the jnp tube explicitly
+    pr, pi_ = pi_fft_sharded(xj, yj, mesh, plan=False)
+    assert np.max(np.abs(to_complex(pr, pi_) -
+                         to_complex(ref_r, ref_i))) / scale < 1e-6
+
+
+# ---------------------------------------------------- bench / roofline
+
+
+def test_roofline_utilization():
+    from cs87project_msolano2_tpu.utils.roofline import (
+        fft_min_hbm_bytes,
+        hbm_peak_bytes_per_s,
+        roofline_utilization,
+    )
+
+    assert fft_min_hbm_bytes(1 << 20) == 16 << 20
+    assert hbm_peak_bytes_per_s("TPU v5e") == pytest.approx(819e9)
+    assert hbm_peak_bytes_per_s("TPU v5 lite") == pytest.approx(819e9)
+    assert hbm_peak_bytes_per_s("TPU v5p") == pytest.approx(2765e9)
+    assert hbm_peak_bytes_per_s("cpu-interpret") is None
+    # n=2^24 at 1 ms on v5e: 268 MB / 1 ms = 268 GB/s of 819 GB/s
+    util = roofline_utilization(1 << 24, 1.0, "TPU v5e")
+    assert util == pytest.approx((16 * (1 << 24)) / 1e-3 / 819e9)
+    assert roofline_utilization(1 << 24, 0.0, "TPU v5e") is None
+    assert roofline_utilization(1 << 24, 1.0, "unknown") is None
+
+
+def test_bench_smoke_pipeline(capsys):
+    """The CI rot check in-process: bench --smoke must emit one JSON
+    record with the flagship fields, the per-row large-n fields, and
+    the plan descriptions, entirely offline."""
+    import json
+
+    import bench
+
+    assert bench.main(["--smoke"]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["smoke"] is True
+    assert rec["metric"].startswith("fft1d_n2^12")
+    assert rec["plan"]["variant"] == "rows"
+    # the C baseline is full-N only: a toy-n ratio would be meaningless
+    assert "vs_baseline" not in rec
+    tag = f"n2^{bench.SMOKE_LARGE_LOGNS[0]}"
+    assert f"{tag}_ms" in rec and f"{tag}_gflops" in rec
+    assert f"{tag}_vs_xla" in rec  # per-row xla comparison (satellite)
