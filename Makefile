@@ -6,7 +6,7 @@
 # analyze-datasets uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all clean recompile test bench bench-smoke replicate \
+.PHONY: all clean recompile test bench bench-smoke bench-chaos replicate \
         run-experiments run-experiments-and-analyze-results analyze \
         analyze-datasets check lint
 
@@ -46,6 +46,19 @@ bench: all
 # the CI rot check: whole reporting pipeline at toy sizes, offline
 bench-smoke:
 	PIFFT_PLAN_CACHE=off python3 bench.py --smoke
+
+# the CI chaos check (docs/RESILIENCE.md): with every kernel entry
+# dying of an injected CAPACITY fault, the degradation chain must carry
+# the bench to rc=0 with the record tagged degraded and at least one
+# demotion on the plan — the end-to-end resilience guarantee
+bench-chaos:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off PIFFT_FAULT=tube:capacity:1.0 \
+	  python3 bench.py --smoke | tee /tmp/pifft-bench-chaos.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-bench-chaos.json')); \
+	  assert r.get('degraded') is True, r; \
+	  assert r['plan'].get('demotions'), r['plan']; \
+	  print('# chaos smoke ok: rc=0, degraded tagged, demotion recorded')"
 
 # project static analysis (check/ subsystem, docs/CHECKS.md): the
 # timing/retrace/Mosaic/plan-key invariants as AST rules, gated on the

@@ -44,10 +44,23 @@ the per-FFT time is the slope and the ~100 ms relay overhead cancels.
 On hardware where block_until_ready is honest the same method simply
 measures with less noise.
 
+Resilience (docs/RESILIENCE.md): every measurement runs under the
+resilience subsystem's discipline — faults are CLASSIFIED
+(resilience.classify), TRANSIENT ones retried with backoff, and
+CAPACITY/PERMANENT kernel faults ride the plan degradation chain, so a
+dead kernel demotes (fourstep -> rql -> jnp.fft -> numpy) instead of
+killing the bench; a degraded row is tagged ``degraded: true`` and its
+plan record carries the demotion trail.  ``--journal``/``--resume`` add
+atomic per-cell JSONL checkpointing: a preempted bench re-run with
+``--resume`` recomputes only the cells the kill took, byte-identical
+semantics for the rest.
+
 ``--smoke`` (CI): run the whole reporting pipeline at toy sizes with
 single-shot timing so the entry point cannot silently rot offline.  The
 numbers are meaningless (interpret mode); the JSON shape, the plan
-resolution, and every measurement seam are real.
+resolution, and every measurement seam are real.  ``make bench-chaos``
+runs it with ``PIFFT_FAULT=tube:capacity:1.0`` and asserts the
+degradation chain carried the run to rc=0 with a recorded demotion.
 """
 
 import argparse
@@ -64,6 +77,21 @@ LARGE_LOGNS = (22, 24)
 
 SMOKE_N = 1 << 12
 SMOKE_LARGE_LOGNS = (13,)
+
+
+def _retry(fn, *args, smoke: bool = False, label: str = ""):
+    """Shared TRANSIENT-retry wrapper (resilience.with_retry policy):
+    real runs get the 30/60/120 s relay-recovery ladder, smoke runs a
+    fast one so CI never sleeps on an injected blip.  CAPACITY and
+    PERMANENT faults pass straight through — repetition cannot fix
+    them; classification at the call site decides what can."""
+    from cs87project_msolano2_tpu.resilience import (
+        FAST_POLICY,
+        call_with_retry,
+    )
+
+    policy = FAST_POLICY if smoke else None
+    return call_with_retry(fn, *args, policy=policy, label=label)
 
 
 def _smoke_ms(fn, *args) -> float:
@@ -83,8 +111,11 @@ def measure_tpu_ms(n: int = N, smoke: bool = False) -> tuple:
     """(ms, plan) for an n-point pi-layout key, via the plans
     subsystem's shared measurement policy (tuned-race ms reused, cached
     plans re-timed with the tuner's own timer, a non-compiling cached
-    winner re-raced)."""
+    winner re-raced).  TRANSIENT faults retry here; kernel CAPACITY/
+    PERMANENT faults degrade inside the plan executor and surface as
+    ``plan.degraded``."""
     from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import maybe_fault
 
     key = plans.make_key(n, layout="pi")
     if smoke:
@@ -95,8 +126,19 @@ def measure_tpu_ms(n: int = N, smoke: bool = False) -> tuple:
         k0 = jax.random.PRNGKey(0)
         xr = jax.random.normal(k0, (n,), jnp.float32)
         xi = jax.random.normal(jax.random.fold_in(k0, 1), (n,), jnp.float32)
-        return _smoke_ms(plan.fn, xr, xi), plan
-    return plans.measured_ms(key)
+
+        def run_smoke():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(plan.fn, xr, xi)
+
+        return _retry(run_smoke, smoke=True,
+                      label=f"flagship smoke n={n}"), plan
+
+    def run():
+        maybe_fault("bench")  # resilience injection site
+        return plans.measured_ms(key)
+
+    return _retry(run, label=f"measured_ms n={n}")
 
 
 def measure_xla_fft_ms(n: int = N, smoke: bool = False):
@@ -106,11 +148,15 @@ def measure_xla_fft_ms(n: int = N, smoke: bool = False):
     itself plus one scaling is timed — the same epilogue the Pallas body
     pays.  Falls back to the unrolled slope if the FFT custom-call
     cannot lower inside a fori_loop; returns None (metric omitted) if it
-    cannot be measured at all rather than losing the other results."""
+    cannot be measured at all rather than losing the other results.
+    Failures are classified (resilience taxonomy) so the diagnostic
+    says WHICH recovery applies, and transient ones were already
+    retried before any fallback fires."""
     import jax
     import jax.numpy as jnp
 
     from cs87project_msolano2_tpu.plans import warn
+    from cs87project_msolano2_tpu.resilience import classify, maybe_fault
     from cs87project_msolano2_tpu.utils.timing import (
         loop_slope_ms,
         unrolled_slope_ms,
@@ -139,31 +185,40 @@ def measure_xla_fft_ms(n: int = N, smoke: bool = False):
         return jnp.real(y) * inv, jnp.imag(y) * inv
 
     if smoke:
-        return _smoke_ms(body_fft, (xr, xi))
+        def run_smoke():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(body_fft, (xr, xi))
+
+        return _retry(run_smoke, smoke=True, label=f"xla smoke n={n}")
+
+    def run_loop_slope():
+        maybe_fault("bench")  # resilience injection site
+        return loop_slope_ms(body_fft, (xr, xi), k1=64, k2=1024, reps=5,
+                             min_delta_ms=100.0, cache=False)
 
     try:
-        raw = loop_slope_ms(body_fft, (xr, xi), k1=64, k2=1024, reps=5,
-                            min_delta_ms=100.0, cache=False)
+        raw = _retry(run_loop_slope, label=f"xla fft n={n}")
     except Exception as e:
         # some backends cannot lower the FFT custom-call inside a While
         # body — statically unroll instead (modest k2: program size and
         # remote-compile time grow linearly with the unroll)
-        warn(f"xla fft n={n} under fori_loop failed ({type(e).__name__}); "
-             f"trying unrolled slope")
+        warn(f"xla fft n={n} under fori_loop failed ({classify(e).value} "
+             f"{type(e).__name__}); trying unrolled slope")
         try:
             raw = unrolled_slope_ms(body_fft, (xr, xi), k1=8, k2=64,
                                     reps=7, min_delta_ms=20.0, max_k=256,
                                     cache=False)
         except Exception as e2:
             warn(f"xla fft n={n} not measurable on this backend "
-                 f"({type(e2).__name__}); omitting vs_xla_fft")
+                 f"({classify(e2).value} {type(e2).__name__}); omitting "
+                 f"vs_xla_fft")
             return None
     try:
         epilogue = loop_slope_ms(body_epilogue, (xr, xi), k1=64, k2=1024,
                                  reps=5, min_delta_ms=40.0, cache=False)
     except Exception as e:
-        warn(f"xla epilogue n={n} not resolvable ({type(e).__name__}); "
-             f"vs_xla_fft conservatively uncorrected")
+        warn(f"xla epilogue n={n} not resolvable ({classify(e).value} "
+             f"{type(e).__name__}); vs_xla_fft conservatively uncorrected")
         epilogue = 0.0
     # the epilogue is a small fraction of the FFT; if its measurement
     # came back implausibly large (relay noise), don't let it eat the
@@ -171,43 +226,57 @@ def measure_xla_fft_ms(n: int = N, smoke: bool = False):
     return max(raw - epilogue, raw * 0.5)
 
 
-def measure_large_n_ms(logns=LARGE_LOGNS, smoke: bool = False) -> dict:
-    """Large-n reach rows (the reference's pthreads analysis goes to
-    n=2^24): per-key plans at each 2^logn — each n gets the plan tuned
+def measure_large_n_row(logn: int, smoke: bool = False) -> dict:
+    """One large-n reach row (the reference's pthreads analysis goes to
+    n=2^24): the per-key plan at 2^logn — each n gets the plan tuned
     (or statically chosen) for ITS key, not the flagship's shape — with
     the same-chip XLA comparison and the HBM-roofline utilization
-    recorded PER ROW, so the large-n falloff is tracked release over
-    release.  Best-effort — a failed row drops its fields, not the
-    bench, and says so through plans.warn (greppable `# ` diagnostics,
-    the PIF501 discipline)."""
+    recorded, so the large-n falloff is tracked release over release.
+    Best-effort — a failed row drops its fields, not the bench, and
+    says so through plans.warn with the fault's classification
+    (greppable `# ` diagnostics, the PIF501 discipline).  A row whose
+    plan demoted mid-measurement is tagged ``<tag>_degraded``."""
     from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import classify
     from cs87project_msolano2_tpu.utils.roofline import roofline_utilization
 
     out = {}
+    nn = 1 << logn
+    tag = f"n2^{logn}"
+    try:
+        ms, plan = measure_tpu_ms(nn, smoke=smoke)
+    except Exception as e:
+        plans.warn(f"large-n 2^{logn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return out
+    out[f"{tag}_ms"] = round(ms, 4)
+    out[f"{tag}_gflops"] = round(
+        5.0 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
+    out[f"{tag}_plan"] = plan.describe()
+    if plan.degraded:
+        out[f"{tag}_degraded"] = True
+    util = roofline_utilization(nn, ms, plan.key.device_kind)
+    if util is not None:
+        out[f"{tag}_roofline_util"] = round(util, 3)
+    try:
+        xla_ms = measure_xla_fft_ms(nn, smoke=smoke)
+    except Exception as e:
+        plans.warn(f"large-n 2^{logn} xla comparison failed "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        xla_ms = None
+    if xla_ms is not None:
+        out[f"{tag}_vs_xla"] = round(xla_ms / ms, 2)
+    return out
+
+
+def measure_large_n_ms(logns=LARGE_LOGNS, smoke: bool = False) -> dict:
+    """All large-n rows (kept as the non-journaled entry point; the
+    journaled path in main() checkpoints per row)."""
+    out = {}
     for logn in logns:
-        nn = 1 << logn
-        tag = f"n2^{logn}"
-        try:
-            ms, plan = measure_tpu_ms(nn, smoke=smoke)
-        except Exception as e:
-            plans.warn(f"large-n 2^{logn} not measured "
-                       f"({type(e).__name__}: {str(e)[:200]})")
-            continue
-        out[f"{tag}_ms"] = round(ms, 4)
-        out[f"{tag}_gflops"] = round(
-            5.0 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
-        out[f"{tag}_plan"] = plan.describe()
-        util = roofline_utilization(nn, ms, plan.key.device_kind)
-        if util is not None:
-            out[f"{tag}_roofline_util"] = round(util, 3)
-        try:
-            xla_ms = measure_xla_fft_ms(nn, smoke=smoke)
-        except Exception as e:
-            plans.warn(f"large-n 2^{logn} xla comparison failed "
-                       f"({type(e).__name__}: {str(e)[:200]})")
-            xla_ms = None
-        if xla_ms is not None:
-            out[f"{tag}_vs_xla"] = round(xla_ms / ms, 2)
+        out.update(measure_large_n_row(logn, smoke=smoke))
     return out
 
 
@@ -232,14 +301,87 @@ def main(argv=None) -> int:
                     help="toy sizes + single-shot timing: exercise the "
                          "whole reporting pipeline offline (CI rot "
                          "check; numbers are meaningless)")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="checkpoint each measurement cell to an atomic "
+                         "JSONL journal (docs/RESILIENCE.md)")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip cells already completed in the journal "
+                         "(default journal: bench-journal.jsonl); a "
+                         "killed bench re-run this way recomputes only "
+                         "what the kill took")
     args = ap.parse_args(argv)
 
     n = SMOKE_N if args.smoke else N
     logns = SMOKE_LARGE_LOGNS if args.smoke else LARGE_LOGNS
 
-    tpu_ms, plan = measure_tpu_ms(n, smoke=args.smoke)
-    xla_ms = measure_xla_fft_ms(n, smoke=args.smoke)
-    large = measure_large_n_ms(logns, smoke=args.smoke)
+    journal = None
+    if args.journal or args.resume:
+        from cs87project_msolano2_tpu.resilience import Journal
+
+        journal = Journal(args.journal or "bench-journal.jsonl")
+        if args.resume:
+            journal.load()
+        else:
+            # a fresh (non-resumed) run must not inherit stale cells
+            journal.reset()
+        # cells are keyed by name ("flagship", ...), so the journal
+        # carries its run configuration and --resume refuses a
+        # mismatch: resuming a full-N bench from a smoke journal would
+        # splice toy numbers into the headline record
+        config = {"n": n, "logns": list(logns), "smoke": bool(args.smoke)}
+        prior = journal.get("config")
+        if prior is not None:
+            prior = {k: prior.get(k) for k in config}
+            if prior != config:
+                print(f"error: journal {journal.path} was written by a "
+                      f"different bench configuration ({prior} != "
+                      f"{config}); use a fresh --journal or delete it",
+                      file=sys.stderr)
+                return 2
+        else:
+            journal.record("config", config)
+
+    def cell(name, compute):
+        """compute() -> JSON-safe payload dict, checkpointed per cell.
+        An EMPTY payload (a row whose measurement failed outright) is
+        never journaled: --resume must re-measure it, not canonize the
+        failure as a completed cell."""
+        if journal is not None and journal.has(name):
+            rec = dict(journal.get(name))
+            rec.pop("cell", None)
+            plans.warn(f"bench --resume: cell {name} loaded from journal "
+                       f"(not re-measured)")
+            return rec
+        out = compute()
+        if journal is not None and out:
+            journal.record(name, out)
+        return out
+
+    def flagship_cell():
+        tpu_ms, plan = measure_tpu_ms(n, smoke=args.smoke)
+        out = {"tpu_ms": tpu_ms, "plan": plan.describe(),
+               "device_kind": plan.key.device_kind}
+        if plan.degraded:
+            out["degraded"] = True
+        return out
+
+    def xla_cell():
+        # a None measurement is a FAILED cell, not a completed one with
+        # value None: return {} so cell() leaves it out of the journal
+        # and --resume re-measures it once the blip passes
+        ms = measure_xla_fft_ms(n, smoke=args.smoke)
+        return {} if ms is None else {"xla_ms": ms}
+
+    flagship = cell("flagship", flagship_cell)
+    xla = cell("xla", xla_cell)
+    large = {}
+    degraded_rows = False
+    for logn in logns:
+        row = cell(f"n2^{logn}",
+                   lambda logn=logn: measure_large_n_row(
+                       logn, smoke=args.smoke))
+        degraded_rows |= bool(row.get(f"n2^{logn}_degraded"))
+        large.update(row)
     if args.smoke:
         # the C baseline runs at the FULL flagship N (the native
         # harness is not parameterized here): in smoke mode that is
@@ -247,19 +389,27 @@ def main(argv=None) -> int:
         # toy-n TPU time — omit vs_baseline rather than publish it
         c_ms = None
     else:
-        c_ms = measure_c_baseline_ms()
+        c_ms = cell("c_baseline",
+                    lambda: {"c_ms": measure_c_baseline_ms()})["c_ms"]
+
+    tpu_ms = flagship["tpu_ms"]
+    xla_ms = xla.get("xla_ms")
     gflops = 5.0 * n * np.log2(n) / (tpu_ms * 1e-3) / 1e9
     record = {
         "metric": f"fft1d_n2^{n.bit_length() - 1}_complex64_gflops",
         "value": round(gflops, 1),
         "unit": "GFLOP/s",
-        "plan": plan.describe(),
+        "plan": flagship["plan"],
     }
     if args.smoke:
         record["smoke"] = True
+    if flagship.get("degraded") or degraded_rows:
+        # a demoted plan anywhere taints the whole line: never let a
+        # degraded run read as a healthy number (docs/RESILIENCE.md)
+        record["degraded"] = True
     if c_ms is not None:
         record["vs_baseline"] = round(c_ms / tpu_ms, 1)
-    util = roofline_utilization(n, tpu_ms, plan.key.device_kind)
+    util = roofline_utilization(n, tpu_ms, flagship["device_kind"])
     if util is not None:
         record["roofline_util"] = round(util, 3)
     if xla_ms is not None:

@@ -315,6 +315,95 @@ class MultiPallasRoundTrip(Rule):
                     f"with # pifft: noqa[PIF104]")
 
 
+@register
+class BroadExceptAroundKernel(Rule):
+    id = "PIF105"
+    name = "broad-except-around-kernel"
+    summary = ("bare/broad except wrapping pallas_call or a timed "
+               "measurement must classify the fault "
+               "(resilience.classify / with_retry) — outside "
+               "resilience/ itself")
+    invariant = ("an unclassified broad handler around a kernel or a "
+                 "timed window collapses the fault taxonomy: a "
+                 "transient relay drop, an OOM, and a Mosaic rejection "
+                 "all demand DIFFERENT recoveries (retry / demote / "
+                 "abort), and a handler that cannot tell them apart "
+                 "retries the unretryable or silently keeps a "
+                 "corrupted measurement — the resilience subsystem "
+                 "(docs/RESILIENCE.md) exists so no other layer "
+                 "guesses")
+    default_config = {
+        # the retry/degrade machinery and the timing layer implement
+        # the discipline; they cannot also be subject to it
+        "exempt": ("*resilience/*", *TIMING_LAYER),
+        # measurement entry points whose failure is a classified event
+        "timed_calls": ("loop_slope_ms", "unrolled_slope_ms", "time_ms",
+                        "default_timer", "measured_ms"),
+        # a handler naming any of these has routed the fault through
+        # the taxonomy
+        "classify_calls": ("classify", "wrap", "call_with_retry",
+                           "with_retry"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        timed = set(config["timed_calls"])
+        classified = set(config["classify_calls"])
+        broad = ("Exception", "BaseException")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            label = self._kernel_label(ctx, node.body, timed)
+            if label is None:
+                continue
+            for handler in node.handlers:
+                if not _is_broad_handler(handler.type, broad):
+                    continue
+                if self._classifies(ctx, handler, classified):
+                    continue
+                htype = "bare except" if handler.type is None else \
+                    f"except {dotted_name(handler.type) or '...'}"
+                yield self.finding(
+                    ctx, handler,
+                    f"{htype} around `{label}` without classifying the "
+                    f"fault — route it through resilience.classify / "
+                    f"with_retry so transient, capacity, and permanent "
+                    f"failures get their own recovery (or justify with "
+                    f"# pifft: noqa[PIF105])")
+
+    def _kernel_label(self, ctx, stmts, timed):
+        """The first pallas_call / timed-measurement call in the try
+        body, or None."""
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _resolve_jit_like(ctx, node) == "pallas_call":
+                    return dotted_name(node.func) or "pallas_call"
+                target = ctx.resolve_call(node)
+                if target and target.split(".")[-1] in timed:
+                    return dotted_name(node.func) or target
+        return None
+
+    def _classifies(self, ctx, handler, classified) -> bool:
+        for node in ast.walk(handler):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve_call(node)
+            if target and target.split(".")[-1] in classified:
+                return True
+        return False
+
+
+def _is_broad_handler(type_node, broad) -> bool:
+    """Shared broad-handler predicate (PIF105 and PIF501)."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad_handler(e, broad) for e in type_node.elts)
+    name = dotted_name(type_node)
+    return name is not None and name.split(".")[-1] in broad
+
+
 def _collect_defs(tree: ast.AST) -> dict:
     """name -> def node for plain functions AND name = lambda aliases."""
     defs: dict[str, ast.AST] = {}
@@ -542,12 +631,7 @@ class BroadExceptSwallow(Rule):
                 f"type, or bind it and log/record it, or re-raise")
 
     def _is_broad(self, type_node, broad) -> bool:
-        if type_node is None:
-            return True
-        if isinstance(type_node, ast.Tuple):
-            return any(self._is_broad(e, broad) for e in type_node.elts)
-        name = dotted_name(type_node)
-        return name is not None and name.split(".")[-1] in broad
+        return _is_broad_handler(type_node, broad)
 
     def _handler_ok(self, handler: ast.ExceptHandler) -> bool:
         for node in ast.walk(handler):

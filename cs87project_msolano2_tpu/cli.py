@@ -6,6 +6,7 @@
     python -m cs87project_msolano2_tpu plan {show | warm | clear | sweep} [...]
     python -m cs87project_msolano2_tpu check [path ...] [--rule ID]
                                          [--json] [--baseline FILE]
+    python -m cs87project_msolano2_tpu faults {list | inject <spec>}
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
@@ -21,6 +22,14 @@ measured fourstep crossover (docs/KERNELS.md).
 The `check` subcommand runs the project's static-analysis pass (the
 check/ subsystem): AST rules for the timing/retrace/Mosaic/plan-key
 invariants, with baseline comparison for CI.  See docs/CHECKS.md.
+
+The `faults` subcommand fronts the resilience subsystem
+(docs/RESILIENCE.md): `list` shows the injection sites, fault kinds and
+the PIFFT_FAULT syntax; `inject <site>:<kind>[:<prob>[:<count>]]` arms
+the spec in-process and drives a small pi-layout transform through the
+plan layer, reporting what fired, how it classified, and whether the
+retry/degradation policies carried the run — the one-command demo that
+the recovery ladder works on THIS machine.
 """
 
 from __future__ import annotations
@@ -161,11 +170,104 @@ def plan_main(argv) -> int:
     return 0
 
 
+def faults_main(argv) -> int:
+    """`faults {list|inject}` — inspect and exercise the resilience
+    subsystem's fault-injection layer (docs/RESILIENCE.md)."""
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu faults",
+        description="list injection sites / inject a fault and watch "
+                    "the retry + degradation policies handle it",
+    )
+    ap.add_argument("action", choices=("list", "inject"))
+    ap.add_argument("spec", nargs="?", default=None,
+                    help="inject: <site>:<kind>[:<prob>[:<count>]] "
+                         "(the PIFFT_FAULT syntax)")
+    ap.add_argument("-n", type=_parse_n, default=1 << 10,
+                    help="inject: transform length for the demo run "
+                         "(int or 2^k; default 2^10)")
+    args = ap.parse_args(argv)
+
+    from . import resilience
+
+    if args.action == "list":
+        print("fault kinds (PIFFT_FAULT=<site>:<kind>[:<prob>[:<count>]],"
+              " comma-separated; site is an fnmatch pattern):")
+        for kind in resilience.KINDS:
+            print(f"  {kind}")
+        print("injection sites:")
+        for site, where in sorted(resilience.KNOWN_SITES.items()):
+            print(f"  {site:<11} {where}")
+        print("recovery: transient -> with_retry backoff; capacity/"
+              "permanent -> plan degradation chain "
+              f"({' -> '.join(resilience.DEGRADE_CHAIN)}); "
+              "see docs/RESILIENCE.md")
+        return 0
+
+    if not args.spec:
+        print("error: inject needs a <site>:<kind>[:<prob>[:<count>]] "
+              "spec", file=sys.stderr)
+        return 2
+    try:
+        spec = resilience.FaultSpec.parse(args.spec)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    from . import plans
+
+    plans.cache.clear(memory=True)  # the demo must trace fresh
+    key = plans.make_key(args.n, layout="pi")
+    rng = np.random.default_rng(0)
+    xr = rng.standard_normal(args.n).astype(np.float32)
+    xi = rng.standard_normal(args.n).astype(np.float32)
+
+    with resilience.inject(spec.site, spec.kind, spec.prob, spec.count) \
+            as live:
+        plan = plans.get_plan(key)
+
+        def run():
+            return plan.execute(xr, xi)
+
+        try:
+            yr, yi = resilience.call_with_retry(
+                run, policy=resilience.FAST_POLICY, label="faults demo")
+        except Exception as e:
+            kind = resilience.classify(e)
+            print(f"run FAILED after policy exhaustion: {kind.value} "
+                  f"{type(e).__name__}: {str(e)[:200]}")
+            print(f"(fault fired {live.fired} time(s); an uncapped "
+                  f"always-on transient spec exhausts the retry budget "
+                  f"by design — cap it with :<count>)")
+            return 1
+
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128))
+    got = verify.pi_layout_to_natural(np.asarray(yr) + 1j * np.asarray(yi))
+    err = verify.rel_err(got, ref)
+    print(f"fault spec {args.spec!r}: fired {live.fired} time(s)")
+    d = plan.describe()
+    if plan.degraded:
+        trail = " -> ".join([plan.variant]
+                            + [rec["to"] for rec in plan.demotions])
+        print(f"plan DEGRADED: {trail} (run completed on the weakest "
+              f"rung that worked)")
+    else:
+        print(f"plan healthy: {d['variant']} {d['params']} "
+              f"(retry absorbed the fault)" if live.fired
+              else f"plan healthy: {d['variant']} {d['params']} "
+                   f"(fault never fired)")
+    print(f"result vs numpy fft: rel err {err:.3e} "
+          f"({'OK' if err < 1e-5 else 'WRONG'})")
+    return 0 if err < 1e-5 else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "plan":
         return plan_main(argv[1:])
+    if argv and argv[0] == "faults":
+        return faults_main(argv[1:])
     if argv and argv[0] == "check":
         from .check.cli import main as check_main
 

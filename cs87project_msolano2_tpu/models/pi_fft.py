@@ -99,7 +99,15 @@ def resolve_tube_plan(shape, plan=None, precision=None,
     segment is at or below `min_segment`, when the plan layer has no
     kernel for the shape (non-eligible batch/row geometry raises
     ValueError), or when it would serve the jnp variant (no pi-layout
-    jnp path exists)."""
+    jnp path exists).
+
+    Resolution itself sits under the resilience discipline: a CAPACITY
+    or PERMANENT fault while resolving (injection site ``resolve``, or
+    a plan layer dying on a real backend) DEGRADES to the jnp tube with
+    a ``plans.warn`` diagnostic instead of killing the sharded caller;
+    TRANSIENT faults re-raise for the retry layer.  Kernel faults
+    during plan EXECUTION are handled further down, by the plan's own
+    degradation chain (resilience.degrade)."""
     if plan is False:
         return None
     if plan is not None:
@@ -107,10 +115,20 @@ def resolve_tube_plan(shape, plan=None, precision=None,
     if min_segment is not None and shape[-1] <= min_segment:
         return None
     from .. import plans
+    from ..resilience import FaultKind, classify, maybe_fault
 
     try:
+        maybe_fault("resolve")
         resolved = plans.plan_for(shape, layout="pi", precision=precision)
     except ValueError:
+        return None
+    except Exception as e:
+        kind = classify(e)
+        if kind is FaultKind.TRANSIENT:
+            raise
+        plans.warn(f"tube-plan resolution for shape {tuple(shape)} "
+                   f"DEGRADED to the jnp tube ({kind.value}: "
+                   f"{type(e).__name__}: {str(e)[:200]})")
         return None
     return None if resolved.variant == "jnp" else resolved
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience.inject import maybe_fault
 from ..utils.compat import pvary_all, shape_struct, vma_of
 from .bits import bit_reverse_indices, ilog2
 from .butterfly import stage_full
@@ -662,6 +663,7 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
     """Two-kernel whole-FFT: long-range stages as a column-grid kernel,
     tile-local FFTs as the row-grid kernel — exactly two HBM round trips,
     no XLA elementwise passes in between."""
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     n = xr.shape[-1]
     tile = _choose_tile(n, tile)
     if cb is not None and (cb % LANE or tile % cb):
@@ -727,6 +729,7 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     (see _long_range_kernel_sep)."""
     from jax.experimental import pallas as pl
 
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     if interpret is None:
         interpret = _use_interpret()
     n = xr.shape[-1]
@@ -877,6 +880,7 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     if interpret is None:
         interpret = _use_interpret()
     if precision is None:
@@ -1167,6 +1171,7 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     if interpret is None:
         interpret = _use_interpret()
     if precision is None:
@@ -1401,6 +1406,7 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
     scoped VMEM on hardware AND cost a full extra HBM read per plane."""
     from jax.experimental import pallas as pl
 
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     if interpret is None:
         interpret = _use_interpret()
     if precision is None:
@@ -1506,6 +1512,7 @@ def fft_rows_pallas(xr, xi, interpret: bool | None = None, precision=None,
     outside that range fall back to the jnp path
     (models.fft.fft_planes_fast handles the dispatch).
     """
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     n = xr.shape[-1]
     if n < LANE or n > MAX_ROW_TILE or n & (n - 1):
         raise ValueError(

@@ -13,6 +13,14 @@ DCN, and the 2-D/3-D transposes ride ICI within a slice and DCN across.
 
 Single-process validation path: the driver's dryrun_multichip and the
 test suite use XLA_FLAGS=--xla_force_host_platform_device_count instead.
+
+Rendezvous discipline (resilience subsystem): collective regions run
+under :func:`collective_watchdog` — a configurable deadline
+(``PIFFT_RENDEZVOUS_DEADLINE_S``) surfaced as a structured
+``CollectiveTimeout`` diagnostic instead of the buried rendezvous.cc
+"thread may be stuck" C++ line MULTICHIP_r05 recorded.  The watchdog,
+the :class:`CollectiveTimeout` type, and the deadline knob are
+re-exported here so parallel callers need only this module.
 """
 
 from __future__ import annotations
@@ -24,13 +32,25 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..resilience import (  # noqa: F401  (re-exports: rendezvous discipline)
+    CollectiveTimeout,
+    HostDesyncError,
+    collective_watchdog,
+    rendezvous_deadline_s,
+)
+
 
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> bool:
     """Initialize the JAX distributed runtime if this looks like (or is
     explicitly configured as) a multi-process job.  Returns True if
-    initialization happened."""
+    initialization happened.
+
+    The rendezvous deadline knob bounds initialization too: a
+    coordinator that never forms the job surfaces as a classified
+    :class:`CollectiveTimeout` (TRANSIENT — the launcher may retry)
+    instead of an open-ended hang."""
     coordinator = coordinator or os.environ.get("PIFFT_COORDINATOR")
     if num_processes is None:
         num_processes = int(os.environ.get("PIFFT_NUM_PROCESSES", "0") or 0)
@@ -39,11 +59,37 @@ def init_distributed(coordinator: Optional[str] = None,
         process_id = int(pid) if pid is not None else None
     if not coordinator or num_processes <= 1:
         return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    kwargs = {}
+    if os.environ.get("PIFFT_RENDEZVOUS_DEADLINE_S", "").strip():
+        # jax.distributed.initialize grew initialization_timeout after
+        # 0.4.x-era releases; pass it only when both the knob is set and
+        # this jax accepts it.  rendezvous_deadline_s() owns the parse
+        # (a malformed value warns and serves the default — it must not
+        # crash init when the watchdog tolerates the same knob).
+        kwargs["initialization_timeout"] = max(
+            int(round(rendezvous_deadline_s())), 1)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except TypeError:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:
+        from ..resilience import FaultKind, classify
+
+        if classify(e) is FaultKind.TRANSIENT:
+            raise CollectiveTimeout(
+                f"distributed init did not form a {num_processes}-process "
+                f"job at {coordinator} ({type(e).__name__}: "
+                f"{str(e)[:200]})") from e
+        raise
     return True
 
 

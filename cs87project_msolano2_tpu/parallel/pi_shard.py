@@ -30,6 +30,7 @@ from ..utils.compat import shard_map
 
 from ..models.pi_fft import funnel_single, resolve_tube_plan, tube
 from ..ops.twiddle import twiddle_tables
+from ..resilience.inject import maybe_fault
 
 # segment length above which the plan path engages by default: the
 # unrolled jnp tube's compile time explodes past one VMEM tile
@@ -46,6 +47,7 @@ def pi_fft_sharded(xr, xi, mesh, axis: str = "p", plan=None):
     module docstring); the funnel stays the replicated scalar-select
     chain either way, so the body remains collective-free.
     """
+    maybe_fault("shard")  # resilience injection site (docs/RESILIENCE.md)
     p = mesh.shape[axis]
     n = xr.shape[-1]
     tables = twiddle_tables(n)
@@ -84,6 +86,7 @@ def pi_fft_sharded_batched(xr, xi, mesh, data_axis: str = "data",
     plan exactly as in :func:`pi_fft_sharded` (keyed on the
     (B/dp, n/p) segment block each device actually transforms).
     """
+    maybe_fault("shard")  # resilience injection site (docs/RESILIENCE.md)
     p = mesh.shape[seq_axis]
     n = xr.shape[-1]
     tables = twiddle_tables(n)

@@ -132,11 +132,34 @@ def measured_ms(key: PlanKey, *, verbose: bool = True):
     if plan.source == "tuned" and plan.ms is not None:
         return plan.ms, plan
     try:
-        return default_timer(plan.fn, plan.key), plan
+        ms = default_timer(plan.fn, plan.key)
+        if plan.degraded:
+            # the winner demoted mid-measurement (resilience.degrade):
+            # before accepting a degraded-chain time, one forced re-race
+            # may find a kernel that still compiles — the old
+            # cliff-recovery policy, now behind the degradation net
+            try:
+                retuned = tune_or_static(key, force=True, verbose=verbose)
+            except TuningError as e:
+                if verbose:
+                    print(f"# re-race after demotion failed ({e}); "
+                          f"keeping the degraded measurement",
+                          file=sys.stderr)
+                retuned = None
+            if retuned is not None and retuned.ms is not None \
+                    and not retuned.degraded:
+                return retuned.ms, retuned
+        return ms, plan
     except Exception as e:
+        from ..resilience import FaultKind, classify
+
+        kind = classify(e)
+        if kind is FaultKind.TRANSIENT:
+            raise  # the moment failed, not the plan: retry, don't re-race
         if verbose:
             print(f"# plan {plan.variant} {plan.params} failed "
-                  f"({type(e).__name__}); re-tuning", file=sys.stderr)
+                  f"({kind.value} {type(e).__name__}); re-tuning",
+                  file=sys.stderr)
         plan = tune_or_static(key, force=True, verbose=verbose)
         if plan.ms is None:  # offline static fallback: nothing to race
             raise

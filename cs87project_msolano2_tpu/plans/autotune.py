@@ -108,7 +108,13 @@ def tune(key: PlanKey, *, force: bool = False,
             fn = ladder.build_executor(key, variant, params)
             ms = float(timer(fn, key))
         except Exception as e:  # compile/lowering failure: non-fatal
-            reason = f"{type(e).__name__}: {str(e)[:200]}"
+            from ..resilience import classify
+
+            # the FaultKind leads the reason so a race record doubles as
+            # a fault-taxonomy record (capacity rejections at the
+            # scoped-VMEM cliff vs permanent lowering failures)
+            reason = (f"{classify(e).value} "
+                      f"{type(e).__name__}: {str(e)[:200]}")
             results.append(CandidateResult(variant, dict(params),
                                            "rejected", None, reason))
             _log(verbose, f"# plan candidate {label} rejected: {reason}")

@@ -157,6 +157,12 @@ class Plan:
     from the disk store), or "static" (measured-good default — the only
     source offline mode ever produces).  `ms` is the tuned per-call time
     when known; `tuning` the full race record.
+
+    `degraded`/`demotions` record the resilience subsystem's demotion
+    trail: when the chosen kernel dies of a CAPACITY/PERMANENT fault,
+    the executor walks the degradation chain (resilience.degrade) and
+    every step lands here AND in the cache record — a degraded plan is
+    announced, persisted, and visible in `plan show`, never silent.
     """
 
     key: PlanKey
@@ -165,25 +171,34 @@ class Plan:
     source: str = "static"
     ms: Optional[float] = None
     tuning: list = dataclasses.field(default_factory=list)
+    degraded: bool = False
+    demotions: list = dataclasses.field(default_factory=list)
     _fn: Optional[Callable] = dataclasses.field(
         default=None, repr=False, compare=False)
 
     @property
     def fn(self) -> Callable:
         """The traceable executor (xr, xi) -> (yr, yi): composable under
-        jit / shard_map / fori_loop.  Built lazily from the ladder and
-        cached on the plan."""
+        jit / shard_map / fori_loop.  Built lazily from the ladder,
+        wrapped in the degradation chain (resilience.degrade — CAPACITY/
+        PERMANENT kernel faults demote down the ladder instead of
+        killing the caller), and cached on the plan."""
         if self._fn is None:
             from . import ladder
+            from ..resilience.degrade import resilient_executor
 
-            self._fn = ladder.build_executor(self.key, self.variant,
-                                             self.params)
+            self._fn = resilient_executor(
+                self, ladder.build_executor(self.key, self.variant,
+                                            self.params))
         return self._fn
 
     def execute(self, xr, xi):
         """Forward transform on float planes — THE dispatch point.
         Traceable; for a standalone donated/jitted entry use
         :meth:`executable`."""
+        from ..resilience.inject import maybe_fault
+
+        maybe_fault("plan")
         return self.fn(xr, xi)
 
     def execute_inverse(self, xr, xi):
@@ -206,15 +221,23 @@ class Plan:
              "source": self.source}
         if self.ms is not None:
             d["ms"] = round(self.ms, 4)
+        if self.degraded:
+            d["degraded"] = True
+            d["demoted_to"] = self.demotions[-1]["to"]
+            d["demotions"] = [dict(rec) for rec in self.demotions]
         return d
 
     def to_record(self) -> dict:
-        return {
+        rec = {
             "variant": self.variant,
             "params": dict(self.params),
             "ms": self.ms,
             "tuning": [r.to_record() for r in self.tuning],
         }
+        if self.degraded:
+            rec["degraded"] = True
+            rec["demotions"] = [dict(d) for d in self.demotions]
+        return rec
 
     @classmethod
     def from_record(cls, key: PlanKey, rec: dict,
@@ -227,4 +250,6 @@ class Plan:
             ms=rec.get("ms"),
             tuning=[CandidateResult.from_record(r)
                     for r in rec.get("tuning") or []],
+            degraded=bool(rec.get("degraded", False)),
+            demotions=[dict(d) for d in rec.get("demotions") or []],
         )

@@ -1,0 +1,69 @@
+"""Resilience subsystem: classify the fault, retry what is transient,
+degrade what is not, and journal so preemption loses one cell, not the
+run.
+
+The paper's claim rests on COMPLETED (n, p) sweeps — a single OOM, a
+failed Mosaic lowering, or a stuck collective used to abort a sweep or
+silently corrupt a row (MULTICHIP_r05 records a real all_to_all
+rendezvous hanging 20 s before recovering).  This package is the one
+place that discipline lives:
+
+* ``taxonomy`` — :class:`PifftError` subclasses wrapping the backend
+                 error zoo (``XlaRuntimeError``, Mosaic lowering,
+                 ``RESOURCE_EXHAUSTED``, collective timeout, host
+                 desync) and :func:`classify`, which tags any exception
+                 TRANSIENT / CAPACITY / PERMANENT.
+* ``retry``    — :func:`with_retry` / :func:`call_with_retry`: bounded
+                 attempts, exponential backoff + jitter, per-FaultKind
+                 policy.  Replaces the harness's old ``run_with_retry``
+                 and bench.py's bare excepts.
+* ``degrade``  — the plan degradation chain (fourstep -> two-trip rql ->
+                 ``jnp.fft.fft`` -> numpy reference) wired into
+                 ``plans.core.Plan``; every demotion is recorded on the
+                 plan and announced through ``plans.warn`` so a degraded
+                 run is never mistaken for a healthy one.
+* ``inject``   — fault injection (``PIFFT_FAULT=<site>:<kind>:<prob>``
+                 env or the :func:`inject` context manager) with sites
+                 in ops/plans/parallel/bench, so every policy above is
+                 testable on CPU in tier-1.
+* ``watchdog`` — :func:`collective_watchdog`: a configurable rendezvous
+                 deadline surfaced as a structured
+                 :class:`CollectiveTimeout` diagnostic instead of a
+                 buried C++ log line.
+* ``journal``  — atomic per-cell JSONL checkpointing behind
+                 ``bench.py --resume`` and the harness sweeps.
+
+See docs/RESILIENCE.md for the full ladder and the chaos-smoke CI gate.
+"""
+
+from __future__ import annotations
+
+from .degrade import DEGRADE_CHAIN, resilient_executor  # noqa: F401
+from .inject import (  # noqa: F401
+    KINDS,
+    KNOWN_SITES,
+    FaultSpec,
+    InjectedFault,
+    active_specs,
+    inject,
+    maybe_fault,
+)
+from .journal import Journal  # noqa: F401
+from .retry import (  # noqa: F401
+    FAST_POLICY,
+    RetryPolicy,
+    call_with_retry,
+    with_retry,
+)
+from .taxonomy import (  # noqa: F401
+    CapacityError,
+    CollectiveTimeout,
+    FaultKind,
+    HostDesyncError,
+    LoweringError,
+    PifftError,
+    TransientBackendError,
+    classify,
+    wrap,
+)
+from .watchdog import collective_watchdog, rendezvous_deadline_s  # noqa: F401

@@ -1,0 +1,197 @@
+"""The plan degradation chain: when a kernel plan dies of a CAPACITY or
+PERMANENT fault, demote it down Bailey's constraint ladder instead of
+killing the run —
+
+    fourstep / fused / rows  ->  two-trip rql  ->  jnp.fft.fft
+                                              ->  numpy reference
+
+The order is the four-step constraint order: the single-pass designs
+need the most VMEM/DMA machinery, the two-trip rql only scoped column
+blocks, ``jnp.fft.fft`` only XLA, and the numpy reference (via
+``jax.pure_callback``) only a host — each rung strictly weaker in what
+it demands of the backend, strictly equal in what it computes.  Every
+demotion is recorded on the plan (``plan.degraded`` /
+``plan.demotions``), pushed back through the plan cache, and announced
+through ``plans.warn``, so a degraded run is never mistaken for a
+healthy one — bench rows carry ``degraded: true`` and the demoted
+variant.
+
+TRANSIENT faults are NOT degraded: they re-raise for the retry layer
+(``resilience.retry``) — demoting a perfectly good kernel because the
+relay blinked would quietly forfeit the measurement.
+
+The wrapper catches at Python/trace time, which is where the faults it
+handles actually strike: injection probes, Mosaic lowering rejections,
+and scoped-VMEM overflows all surface while the executor traces/lowers.
+A runtime HBM OOM inside an already-compiled program propagates to the
+jit call site instead, where bench/harness retry-or-reraise policy owns
+it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .taxonomy import FaultKind, classify
+
+#: the demotion ladder, weakest-demand last (docs/RESILIENCE.md)
+DEGRADE_CHAIN = ("rql", "jnp-fft", "numpy-ref")
+
+#: parameters for the rql rung: auto tile/cb (always lowerable at any
+#: feasible n) and the short-tile-safe tail
+_RQL_PARAMS = {"tile": None, "cb": None, "tail": 128}
+
+
+def _rungs_after(variant: str) -> tuple:
+    """The chain below `variant` — a ladder variant OR an
+    already-landed chain rung (a plan never demotes sideways or up)."""
+    if variant in DEGRADE_CHAIN:
+        return DEGRADE_CHAIN[DEGRADE_CHAIN.index(variant) + 1:]
+    if variant == "two-kernel":
+        return DEGRADE_CHAIN[1:]
+    if variant == "jnp":
+        return DEGRADE_CHAIN[2:]
+    return DEGRADE_CHAIN
+
+
+def _pi_take(key):
+    """Index array mapping natural order -> this key's layout (None when
+    no permutation is needed).  pi layout is per-transform bit-reversed:
+    pi[i] = natural[bitrev(i)]."""
+    if key.layout != "pi":
+        return None
+    from ..ops.bits import bit_reverse_indices
+
+    return bit_reverse_indices(key.n)
+
+
+def build_rung(key, rung: str) -> Callable:
+    """The executable for one chain rung at `key`'s shape/layout.
+    Raises (statically) when the rung cannot serve the key — the chain
+    walker treats that exactly like the rung failing and moves on."""
+    if rung == "rql":
+        from ..plans import ladder
+
+        if key.batch != ():
+            raise ValueError("rql rung is a 1-D whole-transform path")
+        return ladder.build_executor(key, "rql", dict(_RQL_PARAMS))
+
+    if rung == "jnp-fft":
+        import jax.numpy as jnp
+
+        idx = _pi_take(key)
+
+        def jnp_run(xr, xi):
+            y = jnp.fft.fft(xr.astype(jnp.complex64)
+                            + 1j * xi.astype(jnp.complex64))
+            yr = jnp.real(y).astype(jnp.float32)
+            yi = jnp.imag(y).astype(jnp.float32)
+            if idx is not None:
+                take = jnp.asarray(idx)
+                yr = jnp.take(yr, take, axis=-1)
+                yi = jnp.take(yi, take, axis=-1)
+            return yr, yi
+
+        return jnp_run
+
+    if rung == "numpy-ref":
+        import jax
+        import numpy as np
+
+        idx = _pi_take(key)
+        shape = key.batch + (key.n,)
+
+        def host_fft(ar, ai):
+            y = np.fft.fft(np.asarray(ar).astype(np.complex128)
+                           + 1j * np.asarray(ai).astype(np.complex128),
+                           axis=-1)
+            if idx is not None:
+                y = y[..., idx]
+            return (y.real.astype(np.float32), y.imag.astype(np.float32))
+
+        out_struct = (jax.ShapeDtypeStruct(shape, np.float32),
+                      jax.ShapeDtypeStruct(shape, np.float32))
+
+        def numpy_run(xr, xi):
+            return jax.pure_callback(host_fft, out_struct, xr, xi)
+
+        return numpy_run
+
+    raise ValueError(f"unknown degradation rung {rung!r}")
+
+
+def _note_demotion(plan, from_variant: str, rung: str,
+                   exc: BaseException, kind: FaultKind,
+                   skipped: list) -> None:
+    """Record ONE demotion: the rung that actually SERVED, with the
+    fault that evicted `from_variant` as the reason and any rungs that
+    were tried and failed on the way in `skipped` — the trail never
+    claims a rung that never ran."""
+    from ..plans import cache
+    from ..plans.core import warn
+
+    record = {
+        "from": from_variant,
+        "to": rung,
+        "kind": kind.value,
+        "reason": f"{type(exc).__name__}: {str(exc)[:200]}",
+    }
+    if skipped:
+        record["skipped"] = list(skipped)
+    plan.degraded = True
+    plan.demotions.append(record)
+    warn(f"plan DEGRADED {from_variant} -> {rung} for "
+         f"{plan.key.token()} ({kind.value}: {record['reason']})"
+         + (f" [also failed: {'; '.join(skipped)}]" if skipped else "")
+         + " — results stay correct; performance does not")
+    # record the demotion in the IN-PROCESS plan cache only: a demotion
+    # is a property of this session's environment, and persisting it
+    # would taint every future (possibly healthy) session with
+    # degraded=True — and let an injected chaos fault poison the user's
+    # real plan store.  The disk record keeps the tuned winner; the
+    # session-visible trail lives on the memoized plan, the warn line,
+    # and the bench record's degraded tags.
+    cache.memoize(plan)
+
+
+def resilient_executor(plan, raw: Callable) -> Callable:
+    """Wrap a plan's raw executor with the degradation chain.
+
+    CAPACITY/PERMANENT faults from the current executor walk the chain
+    downward (each rung's own such faults continue the walk); TRANSIENT
+    faults re-raise untouched for the retry layer.  The walk is
+    STICKY: once a rung serves, later calls start there — a dead
+    kernel is never re-traced per call, the demotion is recorded once
+    (for the rung that served, with the failed intermediates in its
+    ``skipped`` list), and the trail only ever moves down.  The last
+    rung's failure propagates — when even the numpy reference cannot
+    run there is nothing honest left to serve."""
+    state = {"fn": raw, "variant": plan.variant}
+
+    def run(xr, xi):
+        try:
+            return state["fn"](xr, xi)
+        except Exception as e:
+            kind = classify(e)
+            if kind is FaultKind.TRANSIENT:
+                raise
+            exc, last, skipped = e, kind, []
+            for rung in _rungs_after(state["variant"]):
+                try:
+                    fn = build_rung(plan.key, rung)
+                    out = fn(xr, xi)
+                except Exception as e2:
+                    k2 = classify(e2)
+                    if k2 is FaultKind.TRANSIENT:
+                        raise
+                    skipped.append(f"{rung}: {k2.value} "
+                                   f"{type(e2).__name__}: {str(e2)[:80]}")
+                    exc, last = e2, k2
+                    continue
+                _note_demotion(plan, state["variant"], rung, e, kind,
+                               skipped)
+                state["fn"], state["variant"] = fn, rung
+                return out
+            raise exc
+
+    return run

@@ -1,0 +1,180 @@
+"""Fault injection: make every recovery policy testable on CPU tier-1.
+
+Injection sites are one-line ``maybe_fault("<site>")`` probes at the
+places real faults strike: kernel entries (ops/pallas_fft.py — site
+``tube``, because a kernel IS the tube transform), plan dispatch
+(plans/core.py — ``plan``), tube-plan resolution (models/pi_fft.py —
+``resolve``), the sharded paths (parallel/pi_shard.py — ``shard``),
+the collective watchdog (``collective``), the bench timing loops
+(``bench``) and the harness sweep cells (``harness``).
+
+Arming:
+
+* environment — ``PIFFT_FAULT=<site>:<kind>[:<prob>[:<count>]]``,
+  comma-separated for multiple specs; ``site`` is an fnmatch pattern,
+  ``kind`` one of transient/capacity/permanent/timeout, ``prob``
+  defaults to 1.0, ``count`` caps total firings (unlimited when
+  omitted).  ``PIFFT_FAULT=tube:capacity:1.0`` is the chaos-smoke CI
+  configuration (make bench-chaos).
+* in-process — the :func:`inject` context manager, which tests use to
+  scope a fault to one call.
+
+Injected exceptions carry the REAL signature text of the fault class
+they imitate ("RESOURCE_EXHAUSTED", "UNAVAILABLE", Mosaic wording), so
+the taxonomy's pattern tables — not a test-only side channel — do the
+classification.  When nothing is armed the probe is one dict check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import random
+from contextlib import contextmanager
+from typing import Optional
+
+from .taxonomy import CollectiveTimeout
+
+#: site -> where it fires (the `pifft faults list` table)
+KNOWN_SITES = {
+    "tube": "kernel-variant entry points in ops/pallas_fft.py "
+            "(fourstep / rql / fused / two-kernel / mf / rows) — the "
+            "segment transform every plan executes",
+    "plan": "plans.core.Plan.execute dispatch",
+    "resolve": "models.pi_fft.resolve_tube_plan (tube-plan resolution "
+               "for the sharded paths)",
+    "shard": "parallel.pi_shard sharded pi-FFT entries",
+    "collective": "resilience.watchdog.collective_watchdog arm point "
+                  "(parallel/multihost.py rendezvous discipline)",
+    "bench": "bench.py measurement loops",
+    "harness": "harness/run_experiments.py sweep cells",
+}
+
+KINDS = ("transient", "capacity", "permanent", "timeout")
+
+
+class InjectedFault(RuntimeError):
+    """Marker base for injected faults (so logs can tell chaos from
+    reality); the message carries the imitated signature, which is what
+    :func:`~.taxonomy.classify` keys on."""
+
+
+# message templates reproduce the real signatures the taxonomy tables
+# match (taxonomy.py documents their provenance)
+_TEMPLATES = {
+    "transient": "UNAVAILABLE: injected transient fault at site {site!r} "
+                 "(connection reset by injection)",
+    "capacity": "RESOURCE_EXHAUSTED: injected capacity fault at site "
+                "{site!r} (attempting to allocate more than the device "
+                "has)",
+    "permanent": "Mosaic lowering failed: injected permanent fault at "
+                 "site {site!r}",
+}
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault: fnmatch `site` pattern, `kind`, firing
+    probability, optional total-firing cap, and the firing counter."""
+
+    site: str
+    kind: str
+    prob: float = 1.0
+    count: Optional[int] = None
+    fired: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        parts = text.strip().split(":")
+        if not 2 <= len(parts) <= 4 or not parts[0]:
+            raise ValueError(
+                f"bad fault spec {text!r} (want site:kind[:prob[:count]])")
+        kind = parts[1].lower()
+        if kind not in KINDS:
+            raise ValueError(f"bad fault kind {parts[1]!r} "
+                             f"(want one of {KINDS})")
+        prob = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
+        count = int(parts[3]) if len(parts) > 3 and parts[3] else None
+        return cls(site=parts[0], kind=kind, prob=prob, count=count)
+
+    def exhausted(self) -> bool:
+        return self.count is not None and self.fired >= self.count
+
+
+def parse_specs(text: str) -> list:
+    """Every spec in a comma-separated PIFFT_FAULT value."""
+    return [FaultSpec.parse(part)
+            for part in text.split(",") if part.strip()]
+
+
+# env-armed specs, cached on the raw env value so firing counters
+# survive across probe calls but a changed env re-parses
+_ENV_CACHE: list = [None, []]  # [raw value, parsed specs]
+# context-manager-armed specs (stacked; inner scopes fire first)
+_SCOPED: list = []
+# deterministic by default so chaos runs reproduce;
+# PIFFT_FAULT_SEED overrides
+_RNG = random.Random(int(os.environ.get("PIFFT_FAULT_SEED", "0") or 0))
+
+
+def _env_specs() -> list:
+    raw = os.environ.get("PIFFT_FAULT", "")
+    if raw != _ENV_CACHE[0]:
+        try:
+            parsed = parse_specs(raw)
+        except ValueError as e:
+            # a typo'd spec must not silently disable chaos: fail loud —
+            # and keep failing (the cache key is only updated on a
+            # successful parse, so EVERY probe under the bad value
+            # raises instead of silently serving the stale spec list)
+            raise ValueError(f"PIFFT_FAULT: {e}") from e
+        _ENV_CACHE[0] = raw
+        _ENV_CACHE[1] = parsed
+    return _ENV_CACHE[1]
+
+
+def active_specs() -> list:
+    """Scoped (innermost first) then env-armed specs."""
+    return list(reversed(_SCOPED)) + _env_specs()
+
+
+def _raise_for(spec: FaultSpec, site: str) -> None:
+    spec.fired += 1
+    if spec.kind == "timeout":
+        raise CollectiveTimeout(
+            f"injected collective timeout at site {site!r} (rendezvous "
+            f"deadline exceeded)")
+    raise InjectedFault(_TEMPLATES[spec.kind].format(site=site))
+
+
+def maybe_fault(site: str) -> None:
+    """The probe: raise the armed fault for `site`, if any fires.
+
+    Near-zero cost when nothing is armed.  Probes run at Python call /
+    trace time (never inside traced computation), so an injected fault
+    propagates exactly like a real compile-time or dispatch failure —
+    catchable by the retry and degradation layers under test."""
+    if not _SCOPED and not _env_specs():
+        return
+    for spec in active_specs():
+        if spec.exhausted() or not fnmatch.fnmatch(site, spec.site):
+            continue
+        if spec.prob >= 1.0 or _RNG.random() < spec.prob:
+            _raise_for(spec, site)
+
+
+@contextmanager
+def inject(site: str, kind: str, prob: float = 1.0,
+           count: Optional[int] = None):
+    """Scope a fault to a with-block (the test-suite arming path).
+    Yields the live :class:`FaultSpec` so callers can assert on
+    ``spec.fired``."""
+    spec = FaultSpec(site=site, kind=kind, prob=prob, count=count)
+    if kind not in KINDS:
+        raise ValueError(f"bad fault kind {kind!r} (want one of {KINDS})")
+    _SCOPED.append(spec)
+    try:
+        yield spec
+    finally:
+        _SCOPED.remove(spec)

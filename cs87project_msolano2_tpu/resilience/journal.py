@@ -1,0 +1,89 @@
+"""Atomic per-cell JSONL journal: preemption loses one cell, not the
+run.
+
+One record per line, ``{"cell": <id>, ...payload}``, appended with a
+single write + flush + fsync so a kill can at worst truncate the LAST
+line — and :meth:`Journal.load` tolerates exactly that (a trailing
+partial line is skipped with a diagnostic, never an error).  Drives
+``bench.py --resume`` and the harness sweeps' completed-cell skipping
+(docs/RESILIENCE.md, resume semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class Journal:
+    """Append-only JSONL checkpoint keyed by cell id."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cells: Optional[dict] = None
+
+    # ------------------------------------------------------------ read
+
+    def load(self) -> dict:
+        """cell id -> last recorded payload.  Corrupt lines (the
+        half-written tail a kill leaves) are skipped with a diagnostic;
+        a later record for the same cell wins."""
+        cells: dict = {}
+        if os.path.exists(self.path):
+            dropped = 0
+            with open(self.path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        dropped += 1
+                        continue
+                    if isinstance(rec, dict) and "cell" in rec:
+                        cells[str(rec["cell"])] = rec
+            if dropped:
+                from ..plans.core import warn
+
+                warn(f"journal {self.path}: skipped {dropped} "
+                     f"corrupt line(s) (interrupted write); the cells "
+                     f"they held will re-run")
+        self._cells = cells
+        return cells
+
+    def _loaded(self) -> dict:
+        if self._cells is None:
+            self.load()
+        return self._cells
+
+    def has(self, cell: str) -> bool:
+        return str(cell) in self._loaded()
+
+    def get(self, cell: str) -> Optional[dict]:
+        return self._loaded().get(str(cell))
+
+    # ----------------------------------------------------------- write
+
+    def record(self, cell: str, payload: Optional[dict] = None) -> dict:
+        """Append one cell record; the line is flushed and fsynced
+        before return so a later kill cannot take it back."""
+        rec = dict(payload or {})
+        rec["cell"] = str(cell)
+        line = json.dumps(rec, sort_keys=True)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._loaded()[str(cell)] = rec
+        return rec
+
+    def reset(self) -> None:
+        """Start the journal over (a fresh, non-resumed run must not
+        inherit stale cells)."""
+        if os.path.exists(self.path):
+            os.remove(self.path)
+        self._cells = {}

@@ -1,0 +1,127 @@
+"""``with_retry``: bounded attempts with exponential backoff + jitter,
+policy keyed on the :class:`~.taxonomy.FaultKind` of each failure.
+
+This is the ONE retry loop the project owns — it replaced the harness's
+``run_with_retry`` and bench.py's bare excepts.  The defaults encode the
+observed failure profile: relay drops ('remote_compile: response body
+closed') and worker restarts (UNAVAILABLE for >60 s after a kill) heal
+within the 30/60/120 s backoff ladder, so TRANSIENT gets 4 attempts;
+CAPACITY and PERMANENT get exactly 1 — an OOM retried is an OOM again,
+and the degradation chain (resilience.degrade), not repetition, is the
+answer.  ``sleep``/``rng`` are injectable so tests assert the exact
+schedule against a mock clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import random
+import sys
+import time
+from typing import Callable, Optional
+
+from .taxonomy import FaultKind, classify
+
+#: attempts per kind when the policy does not override them
+DEFAULT_ATTEMPTS = {
+    FaultKind.TRANSIENT: 4,
+    FaultKind.CAPACITY: 1,
+    FaultKind.PERMANENT: 1,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How many attempts each FaultKind earns and how long to wait.
+
+    Backoff before retry ``i`` (1-based) is
+    ``base_s * factor**(i-1) * (1 + jitter * u)`` with ``u`` uniform in
+    [0, 1), capped at ``max_backoff_s`` — exponential so a restarting
+    worker gets its >60 s, jittered so parallel sweep shards do not
+    reconnect in lockstep."""
+
+    attempts: dict = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_ATTEMPTS))
+    base_s: float = 30.0
+    factor: float = 2.0
+    jitter: float = 0.25
+    max_backoff_s: float = 600.0
+
+    def attempts_for(self, kind: FaultKind) -> int:
+        return max(int(self.attempts.get(kind,
+                                         DEFAULT_ATTEMPTS[kind])), 1)
+
+    def backoff_s(self, retry_index: int, u: float) -> float:
+        """Pause before the `retry_index`-th retry (1-based); `u` is the
+        caller's uniform sample so schedules are testable."""
+        raw = self.base_s * (self.factor ** (retry_index - 1))
+        return min(raw * (1.0 + self.jitter * u), self.max_backoff_s)
+
+
+#: a policy for interactive/smoke contexts where sleeping 30 s on a
+#: blip would cost more than the retry saves
+FAST_POLICY = RetryPolicy(base_s=0.05, max_backoff_s=1.0)
+
+
+def call_with_retry(fn: Callable, *args,
+                    policy: Optional[RetryPolicy] = None,
+                    on_retry: Optional[Callable] = None,
+                    label: str = "",
+                    sleep: Callable = time.sleep,
+                    rng: Callable = random.random,
+                    **kwargs):
+    """``fn(*args, **kwargs)`` under `policy`.
+
+    Each failure is classified; kinds whose attempt budget is 1 (the
+    CAPACITY/PERMANENT default — ValueError's cell-infeasibility
+    contract rides on this) re-raise immediately, TRANSIENT faults are
+    retried with exponential backoff + jitter until their budget is
+    spent, then re-raised.  ``on_retry(exc, attempt, pause_s)`` runs
+    before each pause (the harness resets its timing-program warm state
+    there).  The attempt budget is per-kind within one call: a fault of
+    a new kind draws from that kind's own budget."""
+    policy = policy or RetryPolicy()
+    used: dict = {}
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            kind = classify(e)
+            used[kind] = used.get(kind, 0) + 1
+            if used[kind] >= policy.attempts_for(kind):
+                raise
+            pause = policy.backoff_s(used[kind], rng())
+            if on_retry is not None:
+                on_retry(e, used[kind], pause)
+            else:
+                print(f"# {kind.value} fault"
+                      + (f" in {label}" if label else "")
+                      + f" ({type(e).__name__}: {str(e)[:120]}); retry "
+                        f"{used[kind]}/{policy.attempts_for(kind) - 1} "
+                        f"in {pause:.1f}s", file=sys.stderr)
+            sleep(pause)
+
+
+def with_retry(fn: Optional[Callable] = None, *,
+               policy: Optional[RetryPolicy] = None,
+               on_retry: Optional[Callable] = None,
+               label: str = "",
+               sleep: Callable = time.sleep,
+               rng: Callable = random.random):
+    """Decorator form of :func:`call_with_retry`.
+
+    ``@with_retry`` bare or ``@with_retry(policy=..., on_retry=...)``;
+    the wrapped callable retries per the policy on every call."""
+
+    def deco(f: Callable) -> Callable:
+        @functools.wraps(f)
+        def run(*args, **kwargs):
+            return call_with_retry(
+                f, *args, policy=policy, on_retry=on_retry,
+                label=label or getattr(f, "__name__", ""),
+                sleep=sleep, rng=rng, **kwargs)
+
+        return run
+
+    return deco if fn is None else deco(fn)

@@ -1,0 +1,165 @@
+"""Typed failure taxonomy: every fault the measurement stack can hit,
+named, and :func:`classify` mapping any raised exception onto the three
+recovery classes the policies key on.
+
+The backend error zoo is stringly typed — ``XlaRuntimeError`` carries
+gRPC-style status words ("RESOURCE_EXHAUSTED", "UNAVAILABLE"), Mosaic
+lowering failures arrive as RuntimeError text, the axon relay drops
+connections with bare socket messages — so classification is by
+exception TYPE first (our own :class:`PifftError` subclasses carry their
+kind; ConnectionError/MemoryError/ValueError have unambiguous meanings)
+and message PATTERN second.  The pattern tables double as documentation
+of every failure signature observed in the bench/sweep logs
+(BENCH_r*.json, MULTICHIP_r*.json, harness history).
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+
+
+class FaultKind(enum.Enum):
+    """What a fault means for the recovery policy.
+
+    TRANSIENT — the operation is fine, the moment was not (relay drop,
+    worker restart, stuck-then-recovered collective): retry with
+    backoff.  CAPACITY — the configuration asks for more memory than
+    the device has (HBM OOM, scoped-VMEM overflow): retrying is futile,
+    demote to a smaller/leaner plan.  PERMANENT — the program itself is
+    wrong for this backend (Mosaic lowering rejection, invalid
+    argument, infeasible cell): neither retry nor the same plan again.
+    """
+
+    TRANSIENT = "transient"
+    CAPACITY = "capacity"
+    PERMANENT = "permanent"
+
+
+class PifftError(RuntimeError):
+    """Base of the typed failure taxonomy; ``kind`` drives policy."""
+
+    kind = FaultKind.PERMANENT
+
+
+class TransientBackendError(PifftError):
+    """Infrastructure blinked: relay connection drop, worker restart,
+    UNAVAILABLE / DEADLINE_EXCEEDED status — retry with backoff."""
+
+    kind = FaultKind.TRANSIENT
+
+
+class CapacityError(PifftError):
+    """The configuration exceeds device memory (RESOURCE_EXHAUSTED,
+    HBM OOM, the 16 MB scoped-VMEM cliff) — demote, don't retry."""
+
+    kind = FaultKind.CAPACITY
+
+
+class LoweringError(PifftError):
+    """The kernel cannot lower on this backend (Mosaic rejection,
+    unimplemented op) — permanent for this plan, demote."""
+
+    kind = FaultKind.PERMANENT
+
+
+class CollectiveTimeout(TransientBackendError):
+    """A collective rendezvous exceeded its deadline (the MULTICHIP_r05
+    all_to_all hang, surfaced structurally instead of as a buried C++
+    log line).  Transient: the r05 hang recovered by itself."""
+
+
+class HostDesyncError(PifftError):
+    """Multi-host processes disagree about the job topology (process
+    count / global device mismatch) — no local retry can fix it."""
+
+    kind = FaultKind.PERMANENT
+
+
+# message signatures, checked in order: CAPACITY before TRANSIENT
+# (an OOM report may also mention the op that was being retried), both
+# before the PERMANENT default.  Sources: XlaRuntimeError status words,
+# Mosaic diagnostics, and the relay/worker failures the harness logs
+# (run_with_retry history: 'remote_compile: response body closed',
+# UNAVAILABLE for >60 s after a worker kill).
+_CAPACITY_PAT = re.compile(
+    r"RESOURCE_EXHAUSTED|out of memory|\bOOM\b|attempting to allocate"
+    r"|exceeds the limit|ran out of memory|vmem|scoped\s+memory"
+    r"|allocation.*fail",
+    re.IGNORECASE)
+_TRANSIENT_PAT = re.compile(
+    r"UNAVAILABLE|DEADLINE_EXCEEDED|\bABORTED\b|\bCANCELLED\b"
+    r"|connection (reset|refused|closed|aborted)|response body closed"
+    r"|broken pipe|socket|remote_compile|rendezvous|heartbeat"
+    r"|coordination service|preempt|worker.*(restart|unreachable)"
+    r"|temporarily",
+    re.IGNORECASE)
+_LOWERING_PAT = re.compile(
+    r"mosaic|lowering|UNIMPLEMENTED|unsupported.*(lower|primitive|op)"
+    r"|cannot lower",
+    re.IGNORECASE)
+_DESYNC_PAT = re.compile(
+    r"desync|process (id|index|count).*mismatch"
+    r"|different number of (processes|devices)|global device",
+    re.IGNORECASE)
+
+
+def _message(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: {exc}"
+
+
+def classify(exc: BaseException) -> FaultKind:
+    """Map any exception to the FaultKind the recovery policies key on.
+
+    Our own :class:`PifftError` subclasses carry their kind; unambiguous
+    builtin types short-circuit (MemoryError is CAPACITY, connection/
+    timeout errors are TRANSIENT, ValueError/TypeError — the "this cell
+    is infeasible" contract the harness relies on — are PERMANENT);
+    everything else is classified by message signature, defaulting to
+    PERMANENT (the safe default: an unknown fault must not be retried
+    into a corrupted row)."""
+    if isinstance(exc, PifftError):
+        return exc.kind
+    if isinstance(exc, MemoryError):
+        return FaultKind.CAPACITY
+    if isinstance(exc, (ConnectionError, TimeoutError, BrokenPipeError,
+                        EOFError)):
+        return FaultKind.TRANSIENT
+    if isinstance(exc, (ValueError, TypeError, NotImplementedError,
+                        AssertionError)):
+        return FaultKind.PERMANENT
+    msg = _message(exc)
+    if _CAPACITY_PAT.search(msg):
+        return FaultKind.CAPACITY
+    if _TRANSIENT_PAT.search(msg):
+        return FaultKind.TRANSIENT
+    return FaultKind.PERMANENT
+
+
+_WRAPPERS = {
+    FaultKind.TRANSIENT: TransientBackendError,
+    FaultKind.CAPACITY: CapacityError,
+    FaultKind.PERMANENT: LoweringError,
+}
+
+
+def wrap(exc: BaseException) -> PifftError:
+    """The typed form of `exc`: PifftErrors pass through; anything else
+    is wrapped in the subclass matching its classification (PERMANENT
+    faults get :class:`LoweringError` when the message looks like a
+    lowering rejection, :class:`HostDesyncError` on a desync signature,
+    plain :class:`PifftError` otherwise), with ``__cause__`` preserved
+    so the original traceback survives."""
+    if isinstance(exc, PifftError):
+        return exc
+    kind = classify(exc)
+    cls = _WRAPPERS[kind]
+    if kind is FaultKind.PERMANENT:
+        msg = _message(exc)
+        if _DESYNC_PAT.search(msg):
+            cls = HostDesyncError
+        elif not _LOWERING_PAT.search(msg):
+            cls = PifftError
+    wrapped = cls(_message(exc))
+    wrapped.__cause__ = exc
+    return wrapped

@@ -1,0 +1,106 @@
+"""Collective watchdog: a configurable rendezvous deadline surfaced as
+a structured :class:`~.taxonomy.CollectiveTimeout` diagnostic.
+
+MULTICHIP_r05 recorded the raw form of the problem: an all_to_all
+rendezvous hung for 20 s, the ONLY signal was a C++ ``rendezvous.cc``
+log line ("This thread ... may be stuck"), and eight seconds later a
+second line declared it a false positive.  Nothing in the run's own
+output said either thing.  The watchdog makes the deadline explicit and
+ours: wrap a collective region in :func:`collective_watchdog` and a
+stall past the (configurable, logged) deadline emits a structured
+``CollectiveTimeout`` warning through ``plans.warn`` while the region
+runs — and, in ``strict`` mode, raises :class:`CollectiveTimeout` once
+it completes, so the retry layer can classify it (TRANSIENT) instead of
+a human grepping C++ logs.
+
+No wall clocks are read (the timing layer owns those — PIF102): the
+watchdog thread counts deadline-sized waits on an event, so "recovered
+after >= k x deadline" is derived purely from the wait count.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+
+from .inject import maybe_fault
+from .taxonomy import CollectiveTimeout
+
+#: default rendezvous deadline; the C++ warner fires at a hardcoded
+#: 20 s, so a 60 s default stays quiet through the r05-style
+#: stuck-then-recovered window and only speaks when something is
+#: genuinely wedged
+DEFAULT_RENDEZVOUS_DEADLINE_S = 60.0
+
+
+def rendezvous_deadline_s() -> float:
+    """The configured rendezvous deadline
+    (``PIFFT_RENDEZVOUS_DEADLINE_S`` overrides the default)."""
+    raw = os.environ.get("PIFFT_RENDEZVOUS_DEADLINE_S", "").strip()
+    try:
+        return float(raw) if raw else DEFAULT_RENDEZVOUS_DEADLINE_S
+    except ValueError:
+        from ..plans.core import warn
+
+        warn(f"PIFFT_RENDEZVOUS_DEADLINE_S={raw!r} is not a number; "
+             f"using {DEFAULT_RENDEZVOUS_DEADLINE_S}")
+        return DEFAULT_RENDEZVOUS_DEADLINE_S
+
+
+class WatchdogReport:
+    """What the watchdog saw: ``fired`` deadline expiries (0 = the
+    region finished inside its deadline)."""
+
+    def __init__(self, label: str, deadline_s: float):
+        self.label = label
+        self.deadline_s = deadline_s
+        self.fired = 0
+
+
+@contextmanager
+def collective_watchdog(label: str, deadline_s: float | None = None,
+                        strict: bool = False):
+    """Arm a rendezvous deadline around a collective region.
+
+    While the with-block runs, a daemon thread wakes every `deadline_s`
+    (default :func:`rendezvous_deadline_s`) and emits a structured
+    ``CollectiveTimeout`` warning naming the region — the in-band
+    replacement for rendezvous.cc's buried "may be stuck" line.  On
+    exit, a region that overran at least one deadline either raises
+    :class:`CollectiveTimeout` (``strict=True``) or warns that it
+    recovered (the r05 false-positive case, now visible in OUR output).
+    Yields the live :class:`WatchdogReport`."""
+    from ..plans.core import warn
+
+    deadline = float(deadline_s if deadline_s is not None
+                     else rendezvous_deadline_s())
+    maybe_fault("collective")
+    report = WatchdogReport(label, deadline)
+    done = threading.Event()
+
+    def watch():
+        while not done.wait(deadline):
+            report.fired += 1
+            warn(f"CollectiveTimeout: {label} still waiting after "
+                 f">= {report.fired * deadline:.0f}s (deadline "
+                 f"{deadline:.0f}s; PIFFT_RENDEZVOUS_DEADLINE_S "
+                 f"overrides)")
+
+    thread = threading.Thread(target=watch, name=f"pifft-watchdog-{label}",
+                              daemon=True)
+    thread.start()
+    try:
+        yield report
+    finally:
+        done.set()
+        thread.join(timeout=deadline + 1.0)
+    if report.fired:
+        if strict:
+            raise CollectiveTimeout(
+                f"{label} exceeded its rendezvous deadline "
+                f"({report.fired} x {deadline:.0f}s)")
+        warn(f"{label} recovered after >= {report.fired * deadline:.0f}s "
+             f"(stuck-then-unstuck, the MULTICHIP_r05 pattern; raise "
+             f"PIFFT_RENDEZVOUS_DEADLINE_S if this deadline is too "
+             f"twitchy)")

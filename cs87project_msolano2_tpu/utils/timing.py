@@ -192,8 +192,9 @@ def reset_program_warm_state() -> int:
 
     The warm-skip (`has_run` per _PROGRAM_CACHE entry) assumes the relay
     retains compiled programs for the life of this process.  After a
-    relay reconnect or worker restart — the exact events the harness's
-    run_with_retry absorbs — the server-side compilation is gone, and a
+    relay reconnect or worker restart — the exact TRANSIENT events the
+    resilience retry policy absorbs — the server-side compilation is
+    gone, and a
     fetch issued with warm=False would time the remote recompile inside
     the timed window (with the harness default reps=1 nothing masks it).
     Callers that just survived a transient infrastructure error call

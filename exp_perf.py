@@ -66,8 +66,10 @@ def main():
                 print(f"[{rnd}] {name}: {ms:.4f} ms  ({gf(ms):.0f} GF)",
                       flush=True)
             except Exception as e:
-                print(f"[{rnd}] {name}: FAILED {type(e).__name__}: "
-                      f"{str(e)[:100]}", flush=True)
+                from cs87project_msolano2_tpu.resilience import classify
+
+                print(f"[{rnd}] {name}: FAILED {classify(e).value} "
+                      f"{type(e).__name__}: {str(e)[:100]}", flush=True)
 
     # accuracy at bench shape (fetches — last)
     rng = np.random.default_rng(0)

@@ -4,10 +4,20 @@ append TSV rows, estimate remaining time, optionally cross-verify.
 
 Parity with the reference drivers (cpu/pthreads/run-experiments-and-
 analyze-results:27-69, gpu/cuda/run-experiments:15-73) plus what they
-lacked: resume (append-only TSV is scanned and completed (n, p) cells are
-skipped — the reference's interrupted sweeps kept completed rows, we also
-skip re-running them), per-config cross-backend verification, and a
+lacked: resume (completed (n, p, rep) cells are skipped, journaled in
+an atomic per-cell JSONL next to the append-only TSV — the reference's
+interrupted sweeps kept completed rows, we also skip re-running them,
+and a kill that truncates the TSV's last line can no longer lose the
+sweep's place), per-config cross-backend verification, and a
 --backend list so one sweep drives the dual-backend agreement story.
+
+Fault discipline (docs/RESILIENCE.md): every cell runs under the shared
+``resilience.with_retry`` policy — TRANSIENT infrastructure faults
+(relay drops, worker restarts) retry on the 30/60/120 s backoff ladder
+exactly as the old local ``run_with_retry`` did, while CAPACITY and
+PERMANENT faults (and ValueError's cell-infeasibility contract, which
+classifies PERMANENT) re-raise immediately: an OOM retried three times
+is three OOMs and twenty minutes of sweep lost.
 
 TSV contract: `n  p  total_ms  funnel_ms  tube_ms` (5 columns, exactly
 the reference's …pthreads.c:487-491), one file per backend.
@@ -28,6 +38,12 @@ import numpy as np  # noqa: E402
 
 from cs87project_msolano2_tpu.backends.registry import get_backend  # noqa: E402
 from cs87project_msolano2_tpu.cli import make_input  # noqa: E402
+from cs87project_msolano2_tpu.resilience import (  # noqa: E402
+    Journal,
+    classify,
+    call_with_retry,
+    maybe_fault,
+)
 from cs87project_msolano2_tpu.utils.timing import (  # noqa: E402
     reset_program_warm_state,
 )
@@ -64,8 +80,18 @@ def result_path(outdir: str, backend: str,
     return os.path.join(outdir, f"fourier-parallel-pi-{stem}{tail}")
 
 
-def done_counts(path: str) -> Counter:
-    """(n, p) -> completed replication count, from an existing TSV."""
+def journal_for(path: str) -> Journal:
+    """The per-cell JSONL journal riding next to a sweep TSV."""
+    return Journal(f"{path}.journal.jsonl")
+
+
+def done_counts(path: str, journal: Journal | None = None) -> Counter:
+    """(n, p) -> completed replication count.
+
+    The TSV scan (pre-journal sweeps) and the JSONL journal are merged
+    per-cell by max: a TSV written before the journal existed still
+    resumes, and a kill that truncated the TSV's final line cannot
+    erase a rep the fsynced journal already committed."""
     done: Counter = Counter()
     if os.path.exists(path):
         with open(path) as fh:
@@ -73,6 +99,14 @@ def done_counts(path: str) -> Counter:
                 parts = line.rstrip("\n").split("\t")
                 if len(parts) in (5, 6) and parts[0].isdigit():
                     done[(int(parts[0]), int(parts[1]))] += 1
+    if journal is not None:
+        from_journal: Counter = Counter()
+        for cell_id in journal.load():
+            parts = cell_id.split(":")
+            if len(parts) == 3 and parts[0].isdigit() and parts[1].isdigit():
+                from_journal[(int(parts[0]), int(parts[1]))] += 1
+        for cell_key, count in from_journal.items():
+            done[cell_key] = max(done[cell_key], count)
     return done
 
 
@@ -130,38 +164,36 @@ def grid_cells(backend_name: str, ns: list[int], ps: list[int],
     return backend, cells, oversubscribed
 
 
-def run_with_retry(backend, x, p, attempts: int = 4, pause_s: float = 30.0,
-                   fetch: bool = False, timers: bool = True):
-    """backend.run with retries on transient infrastructure errors.
+def _on_retry(exc: BaseException, attempt: int, pause: float) -> None:
+    """Between-retry hook for the shared policy: the relay that just
+    dropped likely lost its compiled programs too, so reset the slope
+    cache's warm-skip flags — no post-reconnect recompile may land
+    inside a timed window."""
+    nreset = reset_program_warm_state()
+    print(f"# {classify(exc).value} backend error ({type(exc).__name__}: "
+          f"{str(exc)[:120]}); retry {attempt} in {pause:.0f}s"
+          + (f" (re-warming {nreset} cached timing programs)"
+             if nreset else ""), file=sys.stderr)
 
-    Remote-accelerator relays drop connections under long sweeps
-    (observed: 'remote_compile: response body closed' mid-sweep, killing
-    hours of remaining grid), and a crashed TPU worker process takes
-    over a minute to come back (observed: UNAVAILABLE for >60 s after a
-    worker kill) — hence exponential backoff (30, 60, 120 s).
-    ValueError (cell infeasibility) passes through untouched; anything
-    else is retried, then re-raised — the append-only TSV keeps
-    completed rows either way.
+
+def run_cell(backend, x, p, fetch: bool = False, timers: bool = True):
+    """backend.run under the shared resilience retry policy.
+
+    The old local ``run_with_retry`` (4 attempts, 30/60/120 s backoff,
+    ValueError passthrough) is now the DEFAULT ``resilience.RetryPolicy``
+    plus classification: TRANSIENT infrastructure faults earn the
+    backoff ladder (observed relay drops and >60 s worker restarts),
+    CAPACITY/PERMANENT — including ValueError's cell-infeasibility
+    contract — re-raise on first failure.  The append-only TSV and the
+    fsynced journal keep completed rows either way.
     """
-    for attempt in range(attempts):
-        try:
-            return backend.run(x, p, fetch=fetch, timers=timers)
-        except ValueError:
-            raise
-        except Exception as e:
-            if attempt == attempts - 1:
-                raise
-            # the relay that just dropped likely lost its compiled
-            # programs too: reset the slope cache's warm-skip flags so
-            # no post-reconnect recompile lands inside a timed window
-            nreset = reset_program_warm_state()
-            pause = pause_s * (2 ** attempt)
-            print(f"# transient backend error ({type(e).__name__}: "
-                  f"{str(e)[:120]}); retry {attempt + 1}/{attempts - 1} "
-                  f"in {pause:.0f}s"
-                  + (f" (re-warming {nreset} cached timing programs)"
-                     if nreset else ""), file=sys.stderr)
-            time.sleep(pause)
+
+    def attempt():
+        maybe_fault("harness")  # resilience injection site
+        return backend.run(x, p, fetch=fetch, timers=timers)
+
+    return call_with_retry(attempt, on_retry=_on_retry,
+                           label=f"cell n={x.shape[-1]} p={p}")
 
 
 def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
@@ -175,7 +207,13 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
     backend, cells, oversubscribed = grid_cells(
         backend_name, ns, ps, oversubscribe)
     path = result_path(outdir, backend_name, oversubscribed, full)
-    done = done_counts(path) if resume else Counter()
+    journal = journal_for(path)
+    if not os.path.exists(path):
+        # a rotated/deleted TSV invalidates the sidecar: the journal may
+        # only ever claim cells whose data exists, so a redone sweep
+        # must not skip cells an old journal remembers
+        journal.reset()
+    done = done_counts(path, journal) if resume else Counter()
 
     todo = sum(max(reps - done[c], 0) for c in cells)
     # ETA display only — not a measurement (row timings come from the
@@ -188,7 +226,7 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
             x = make_input(n, seed)
             for rep in range(done[(n, p)], reps):
                 try:
-                    res = run_with_retry(backend, x, p)
+                    res = run_cell(backend, x, p)
                 except ValueError as e:
                     # per-(n, p) infeasibility (e.g. einsum's p*n cap) is
                     # a property of the cell, not an error of the sweep
@@ -203,6 +241,14 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
                 fh.write(f"{n}\t{p}\t{res.total_ms:.6f}\t{res.funnel_ms:.6f}"
                          f"\t{res.tube_ms:.6f}{mark}\n")
                 fh.flush()
+                # fsync the TSV row BEFORE the (itself fsynced) journal
+                # claim: the journal may only ever claim cells whose
+                # data exists, even across a host crash — a flushed-but-
+                # unsynced row could die in the page cache after the
+                # journal line already survived
+                os.fsync(fh.fileno())
+                journal.record(f"{n}:{p}:{rep}",
+                               {"total_ms": res.total_ms})
                 completed += 1
                 if completed % 10 == 0 or completed == todo:
                     # pifft ETA only, see t_start note above
@@ -229,7 +275,7 @@ def verify_pass(backend_name: str, ns: list[int], ps: list[int],
             # timers=False: verification needs the output, not another
             # loop-slope pass — re-timing every verified cell measured
             # ~20+ min of a big-n sweep's wall clock on the relay
-            res = run_with_retry(backend, x, p, fetch=True, timers=False)
+            res = run_cell(backend, x, p, fetch=True, timers=False)
         except ValueError as e:
             print(f"# {backend_name} n={n} p={p} verify skipped: {e}",
                   file=sys.stderr)

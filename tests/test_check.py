@@ -311,6 +311,119 @@ def test_pif104_noqa_with_justification():
     assert run(code, "PIF104") == []
 
 
+# ---------------------------------- PIF105 broad except around kernel
+
+
+def test_pif105_flags_broad_except_around_timed_call():
+    code = """
+        from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+        def measure(body, args):
+            try:
+                return loop_slope_ms(body, args)
+            except Exception as e:
+                print(e)
+                return None
+    """
+    found = run(code, "PIF105")
+    assert rule_ids(found) == ["PIF105"]
+    assert "classify" in found[0].message
+
+
+def test_pif105_flags_bare_except_around_pallas_call():
+    code = """
+        from jax.experimental import pallas as pl
+
+        def launch(k, s, x):
+            try:
+                return pl.pallas_call(k, out_shape=s)(x)
+            except:
+                return None
+    """
+    found = run(code, "PIF105")
+    assert rule_ids(found) == ["PIF105"]
+
+
+def test_pif105_classifying_handler_is_fine():
+    code = """
+        from cs87project_msolano2_tpu.resilience import classify
+        from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+        def measure(body, args, warn):
+            try:
+                return loop_slope_ms(body, args)
+            except Exception as e:
+                warn(f"failed ({classify(e).value})")
+                return None
+    """
+    assert run(code, "PIF105") == []
+
+
+def test_pif105_with_retry_handler_is_fine():
+    code = """
+        from cs87project_msolano2_tpu.resilience import call_with_retry
+        from cs87project_msolano2_tpu.utils.timing import time_ms
+
+        def measure(body, args):
+            try:
+                return time_ms(body, args)
+            except Exception as e:
+                return call_with_retry(body, args)
+    """
+    assert run(code, "PIF105") == []
+
+
+def test_pif105_narrow_type_and_unrelated_try_pass():
+    code = """
+        from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+        def measure(body, args):
+            try:
+                return loop_slope_ms(body, args)
+            except ValueError:
+                return None
+
+        def other(fn):
+            try:
+                return fn()
+            except Exception as e:
+                print(e)
+    """
+    assert run(code, "PIF105") == []
+
+
+def test_pif105_resilience_and_timing_layers_exempt():
+    code = """
+        from cs87project_msolano2_tpu.utils.timing import time_ms
+
+        def probe(fn, args):
+            try:
+                return time_ms(fn, args)
+            except Exception as e:
+                print(e)
+    """
+    import textwrap as tw
+
+    for exempt_path in (
+            os.path.join(PKG, "resilience", "snippet.py"),
+            os.path.join(PKG, "utils", "timing.py")):
+        assert check.check_source(exempt_path, tw.dedent(code),
+                                  rules=["PIF105"]) == []
+
+
+def test_pif105_noqa_escape():
+    code = """
+        from cs87project_msolano2_tpu.utils.timing import loop_slope_ms
+
+        def measure(body, args):
+            try:
+                return loop_slope_ms(body, args)
+            except Exception as e:  # pifft: noqa[PIF105] (prototype script)
+                print(e)
+    """
+    assert run(code, "PIF105") == []
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 

@@ -1,0 +1,633 @@
+"""Resilience subsystem tests (docs/RESILIENCE.md): taxonomy
+classification, the with_retry backoff schedule against a mock clock,
+the full plan degradation chain's parity vs numpy under injected
+faults, the collective watchdog, journal corruption tolerance, and
+bench --resume picking up a half-written journal.  All tier-1 safe
+under JAX_PLATFORMS=cpu (conftest forces it)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import plans
+from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+from cs87project_msolano2_tpu.resilience import (
+    CapacityError,
+    CollectiveTimeout,
+    FaultKind,
+    FaultSpec,
+    HostDesyncError,
+    InjectedFault,
+    Journal,
+    LoweringError,
+    PifftError,
+    RetryPolicy,
+    TransientBackendError,
+    call_with_retry,
+    classify,
+    collective_watchdog,
+    inject,
+    maybe_fault,
+    with_retry,
+    wrap,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_memory():
+    """Degradation state lives on cached Plan objects: each test starts
+    with an empty in-process plan cache so one test's demotions can
+    never leak into another's."""
+    plans.cache.clear(memory=True)
+    yield
+    plans.cache.clear(memory=True)
+
+
+def _pi_reference(xr, xi):
+    n = xr.shape[-1]
+    y = np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128))
+    return y[..., bit_reverse_indices(n)]
+
+
+def _planes(n, seed=0, batch=()):
+    rng = np.random.default_rng(seed)
+    shape = (*batch, n)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+def _rel_err(yr, yi, ref):
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    return np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+
+
+# ------------------------------------------------------------- taxonomy
+
+
+@pytest.mark.parametrize("exc,kind", [
+    # the real signatures the bench/sweep logs recorded (taxonomy.py)
+    (RuntimeError("RESOURCE_EXHAUSTED: Attempting to allocate 12.58G"),
+     FaultKind.CAPACITY),
+    (RuntimeError("Ran out of memory in memory space vmem"),
+     FaultKind.CAPACITY),
+    (MemoryError("host"), FaultKind.CAPACITY),
+    (RuntimeError("UNAVAILABLE: connection attempt failed"),
+     FaultKind.TRANSIENT),
+    (RuntimeError("remote_compile: response body closed"),
+     FaultKind.TRANSIENT),
+    # the MULTICHIP_r05 hang signature
+    (RuntimeError("This thread has been waiting for `all to all "
+                  "RendezvousKey{...}` for 20 seconds and may be stuck"),
+     FaultKind.TRANSIENT),
+    (ConnectionResetError("peer"), FaultKind.TRANSIENT),
+    (TimeoutError("deadline"), FaultKind.TRANSIENT),
+    (RuntimeError("Mosaic lowering failed: unsupported layout"),
+     FaultKind.PERMANENT),
+    (RuntimeError("INVALID_ARGUMENT: bad shape"), FaultKind.PERMANENT),
+    (ValueError("cell infeasible"), FaultKind.PERMANENT),
+    (NotImplementedError("no"), FaultKind.PERMANENT),
+    (RuntimeError("something entirely novel"), FaultKind.PERMANENT),
+])
+def test_classify_signatures(exc, kind):
+    assert classify(exc) is kind
+
+
+def test_classify_own_types_carry_their_kind():
+    assert classify(TransientBackendError("x")) is FaultKind.TRANSIENT
+    assert classify(CapacityError("x")) is FaultKind.CAPACITY
+    assert classify(LoweringError("x")) is FaultKind.PERMANENT
+    assert classify(CollectiveTimeout("x")) is FaultKind.TRANSIENT
+    assert classify(HostDesyncError("x")) is FaultKind.PERMANENT
+
+
+def test_wrap_picks_subclass_and_preserves_cause():
+    raw = RuntimeError("RESOURCE_EXHAUSTED: oom")
+    w = wrap(raw)
+    assert isinstance(w, CapacityError) and w.__cause__ is raw
+
+    assert isinstance(wrap(RuntimeError("Mosaic lowering failed")),
+                      LoweringError)
+    assert isinstance(
+        wrap(RuntimeError("process count mismatch across hosts")),
+        HostDesyncError)
+    assert isinstance(wrap(RuntimeError("UNAVAILABLE")),
+                      TransientBackendError)
+    # PifftErrors pass through unwrapped
+    err = CollectiveTimeout("stuck")
+    assert wrap(err) is err
+    # unknown permanents wrap to the base type, still PERMANENT
+    w2 = wrap(RuntimeError("novel"))
+    assert type(w2) is PifftError and w2.kind is FaultKind.PERMANENT
+
+
+# ---------------------------------------------------------------- retry
+
+
+def test_retry_backoff_schedule_mock_clock():
+    sleeps = []
+    calls = [0]
+
+    def always_transient():
+        calls[0] += 1
+        raise TransientBackendError("blip")
+
+    policy = RetryPolicy(base_s=1.0, factor=2.0, jitter=0.0)
+    with pytest.raises(TransientBackendError):
+        call_with_retry(always_transient, policy=policy,
+                        sleep=sleeps.append, rng=lambda: 0.0,
+                        on_retry=lambda *a: None)
+    # 4 attempts total, exponential pauses between them
+    assert calls[0] == 4
+    assert sleeps == [1.0, 2.0, 4.0]
+
+
+def test_retry_jitter_and_cap():
+    policy = RetryPolicy(base_s=10.0, factor=2.0, jitter=0.25,
+                         max_backoff_s=15.0)
+    # u=1.0: 10 * 1.25 = 12.5, then 20 * 1.25 capped at 15
+    assert policy.backoff_s(1, 1.0) == pytest.approx(12.5)
+    assert policy.backoff_s(2, 1.0) == pytest.approx(15.0)
+
+
+def test_retry_recovers_midway_and_calls_hook():
+    hook_calls = []
+    state = [0]
+
+    def flaky():
+        state[0] += 1
+        if state[0] < 3:
+            raise ConnectionError("reset")
+        return "ok"
+
+    out = call_with_retry(
+        flaky, policy=RetryPolicy(base_s=0.0, jitter=0.0),
+        sleep=lambda s: None,
+        on_retry=lambda exc, attempt, pause: hook_calls.append(
+            (type(exc).__name__, attempt)))
+    assert out == "ok"
+    assert hook_calls == [("ConnectionError", 1), ("ConnectionError", 2)]
+
+
+def test_retry_capacity_permanent_and_valueerror_fail_fast():
+    for exc in (CapacityError("oom"), LoweringError("mosaic"),
+                ValueError("infeasible cell")):
+        calls = [0]
+
+        def once(exc=exc):
+            calls[0] += 1
+            raise exc
+
+        with pytest.raises(type(exc)):
+            call_with_retry(once, sleep=lambda s: pytest.fail(
+                "must not sleep on a non-retryable fault"))
+        assert calls[0] == 1
+
+
+def test_with_retry_decorator():
+    state = [0]
+
+    @with_retry(policy=RetryPolicy(base_s=0.0, jitter=0.0),
+                sleep=lambda s: None)
+    def flaky(x):
+        state[0] += 1
+        if state[0] < 2:
+            raise TransientBackendError("blip")
+        return x * 2
+
+    assert flaky(21) == 42
+
+
+# ---------------------------------------------------------------- inject
+
+
+def test_fault_spec_parse_forms():
+    s = FaultSpec.parse("tube:capacity")
+    assert (s.site, s.kind, s.prob, s.count) == ("tube", "capacity", 1.0,
+                                                 None)
+    s = FaultSpec.parse("bench:transient:0.5:3")
+    assert (s.prob, s.count) == (0.5, 3)
+    for bad in ("tube", "tube:nosuchkind", ":capacity", "a:b:c:d:e"):
+        with pytest.raises(ValueError):
+            FaultSpec.parse(bad)
+
+
+def test_inject_count_cap_and_site_glob():
+    with inject("tu*", "permanent", count=2) as spec:
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                maybe_fault("tube")
+        maybe_fault("tube")  # cap reached: no longer fires
+        maybe_fault("plan")  # different site: never matched
+        assert spec.fired == 2
+
+
+def test_inject_env_armed(monkeypatch):
+    monkeypatch.setenv("PIFFT_FAULT", "plan:timeout:1.0:1")
+    with pytest.raises(CollectiveTimeout):
+        maybe_fault("plan")
+    maybe_fault("plan")  # count exhausted
+    monkeypatch.setenv("PIFFT_FAULT", "plan:notakind")
+    with pytest.raises(ValueError):
+        maybe_fault("plan")
+    # a typo'd spec keeps failing loud — it must never fall back to the
+    # previously parsed (stale) spec list
+    with pytest.raises(ValueError):
+        maybe_fault("plan")
+
+
+def test_inject_prob_zero_never_fires():
+    with inject("tube", "capacity", prob=0.0) as spec:
+        for _ in range(20):
+            maybe_fault("tube")
+        assert spec.fired == 0
+
+
+# ----------------------------------------------------- degradation chain
+
+
+def test_degradation_chain_full_parity_vs_numpy(capsys):
+    """The acceptance path: every kernel entry dies of CAPACITY, the
+    chain walks rows -> rql -> jnp-fft, the answer stays numerically
+    correct, the demotions are recorded, and the run SAYS it degraded."""
+    n = 1 << 10
+    xr, xi = _planes(n)
+    ref = _pi_reference(xr, xi)
+    with inject("tube", "capacity") as spec:
+        plan = plans.get_plan(plans.make_key(n, layout="pi"))
+        yr, yi = plan.execute(xr, xi)
+    assert spec.fired >= 2  # the original kernel AND the rql rung died
+    assert _rel_err(yr, yi, ref) < 1e-5
+    assert plan.degraded
+    # ONE record: the rung that actually served, with the failed
+    # intermediate (rql) in its skipped list — the trail never claims
+    # a rung that never ran
+    assert [d["to"] for d in plan.demotions] == ["jnp-fft"]
+    (rec,) = plan.demotions
+    assert rec["from"] == plan.variant and rec["kind"] == "capacity"
+    assert any(s.startswith("rql:") for s in rec["skipped"])
+    err = capsys.readouterr().err
+    assert "DEGRADED" in err
+    d = plan.describe()
+    assert d["degraded"] is True and d["demoted_to"] == "jnp-fft"
+
+
+def test_degradation_is_sticky_across_calls(capsys):
+    """Once a rung serves, later calls start there: the dead kernel is
+    not re-traced, the injection site never re-fires, and the demotion
+    trail does not grow (the duplicate/upward-demotion regression)."""
+    n = 1 << 9
+    xr, xi = _planes(n, seed=7)
+    with inject("tube", "capacity") as spec:
+        plan = plans.get_plan(plans.make_key(n, layout="pi"))
+        plan.execute(xr, xi)
+        fired_after_first = spec.fired
+        yr, yi = plan.execute(xr, xi)
+        assert spec.fired == fired_after_first  # no dead-kernel re-trace
+    assert len(plan.demotions) == 1
+    assert _rel_err(yr, yi, _pi_reference(xr, xi)) < 1e-5
+
+
+def test_degradation_permanent_fault_also_demotes():
+    n = 1 << 9
+    xr, xi = _planes(n, seed=1)
+    with inject("tube", "permanent"):
+        plan = plans.get_plan(plans.make_key(n, layout="pi"))
+        yr, yi = plan.execute(xr, xi)
+    assert plan.degraded
+    assert _rel_err(yr, yi, _pi_reference(xr, xi)) < 1e-5
+
+
+def test_degradation_under_jit_trace():
+    import jax
+
+    n = 1 << 9
+    xr, xi = _planes(n, seed=2)
+    with inject("tube", "capacity"):
+        plan = plans.get_plan(plans.make_key(n, layout="pi"))
+        yr, yi = jax.jit(plan.fn)(xr, xi)
+    assert plan.degraded
+    assert _rel_err(yr, yi, _pi_reference(xr, xi)) < 1e-5
+
+
+def test_transient_fault_is_not_degraded():
+    """A relay blip must re-raise for the retry layer — demoting a
+    healthy kernel on a transient would forfeit the measurement."""
+    n = 1 << 9
+    with inject("tube", "transient"):
+        plan = plans.get_plan(plans.make_key(n, layout="pi"))
+        xr, xi = _planes(n)
+        with pytest.raises(InjectedFault):
+            plan.execute(xr, xi)
+    assert not plan.degraded
+
+
+def test_numpy_ref_rung_parity_batched():
+    from cs87project_msolano2_tpu.resilience.degrade import build_rung
+
+    key = plans.make_key(256, batch=(4,), layout="pi")
+    xr, xi = _planes(256, seed=3, batch=(4,))
+    yr, yi = build_rung(key, "numpy-ref")(xr, xi)
+    assert _rel_err(yr, yi, _pi_reference(xr, xi)) < 1e-5
+
+
+def test_degraded_plan_record_round_trip():
+    key = plans.make_key(512, layout="pi")
+    with inject("tube", "capacity"):
+        plan = plans.get_plan(key)
+        plan.execute(*_planes(512))
+    rec = plan.to_record()
+    back = plans.Plan.from_record(key, rec)
+    assert back.degraded and \
+        [d["to"] for d in back.demotions] == ["jnp-fft"]
+
+
+def test_demotion_never_touches_the_disk_store(tmp_path, monkeypatch):
+    """A demotion is session state: it must not be written to the
+    persistent plan store, where it would taint future healthy
+    sessions (and let injected chaos poison the real cache)."""
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    with inject("tube", "capacity"):
+        plan = plans.get_plan(plans.make_key(512, layout="pi"))
+        plan.execute(*_planes(512))
+    assert plan.degraded
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("plans-")]
+
+
+def test_resolve_tube_plan_degrades_to_jnp_tube(capsys):
+    from cs87project_msolano2_tpu.models.pi_fft import resolve_tube_plan
+
+    with inject("resolve", "capacity"):
+        assert resolve_tube_plan((1 << 17,)) is None
+    assert "DEGRADED" in capsys.readouterr().err
+    # transient resolution faults re-raise instead
+    with inject("resolve", "transient"):
+        with pytest.raises(InjectedFault):
+            resolve_tube_plan((1 << 17,))
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def test_watchdog_quiet_region_stays_quiet(capsys):
+    with collective_watchdog("fast region", deadline_s=5.0) as report:
+        pass
+    assert report.fired == 0
+    assert "CollectiveTimeout" not in capsys.readouterr().err
+
+
+def test_watchdog_flags_stall_and_recovery(capsys):
+    with collective_watchdog("slow region", deadline_s=0.05) as report:
+        time.sleep(0.2)
+    assert report.fired >= 1
+    err = capsys.readouterr().err
+    assert "CollectiveTimeout" in err and "slow region" in err
+    assert "recovered" in err
+
+
+def test_watchdog_strict_raises():
+    with pytest.raises(CollectiveTimeout):
+        with collective_watchdog("wedged", deadline_s=0.05, strict=True):
+            time.sleep(0.15)
+
+
+def test_watchdog_injected_timeout_classifies_transient():
+    with inject("collective", "timeout"):
+        with pytest.raises(CollectiveTimeout) as ei:
+            with collective_watchdog("injected"):
+                pass
+    assert classify(ei.value) is FaultKind.TRANSIENT
+
+
+def test_rendezvous_deadline_env(monkeypatch):
+    from cs87project_msolano2_tpu.resilience.watchdog import (
+        DEFAULT_RENDEZVOUS_DEADLINE_S,
+        rendezvous_deadline_s,
+    )
+
+    assert rendezvous_deadline_s() == DEFAULT_RENDEZVOUS_DEADLINE_S
+    monkeypatch.setenv("PIFFT_RENDEZVOUS_DEADLINE_S", "7.5")
+    assert rendezvous_deadline_s() == 7.5
+    monkeypatch.setenv("PIFFT_RENDEZVOUS_DEADLINE_S", "junk")
+    assert rendezvous_deadline_s() == DEFAULT_RENDEZVOUS_DEADLINE_S
+
+
+# --------------------------------------------------------------- journal
+
+
+def test_journal_round_trip_and_last_wins(tmp_path):
+    j = Journal(str(tmp_path / "j.jsonl"))
+    j.record("a", {"ms": 1.0})
+    j.record("b", {"ms": 2.0})
+    j.record("a", {"ms": 3.0})  # re-record: later wins
+    j2 = Journal(j.path)
+    cells = j2.load()
+    assert set(cells) == {"a", "b"}
+    assert cells["a"]["ms"] == 3.0
+    assert j2.has("a") and not j2.has("c")
+
+
+def test_journal_tolerates_half_written_tail(tmp_path, capsys):
+    j = Journal(str(tmp_path / "j.jsonl"))
+    j.record("a", {"ms": 1.0})
+    j.record("b", {"ms": 2.0})
+    with open(j.path, "a") as fh:
+        fh.write('{"cell": "c", "ms": 3.')  # the kill mid-write
+    cells = Journal(j.path).load()
+    assert set(cells) == {"a", "b"}  # c re-runs; a and b survive
+    assert "corrupt" in capsys.readouterr().err
+
+
+def test_harness_done_counts_merges_tsv_and_journal(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "run_experiments",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "harness", "run_experiments.py"))
+    he = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(he)
+
+    tsv = str(tmp_path / "fourier-parallel-pi-serial-results.tsv")
+    with open(tsv, "w") as fh:
+        # two completed reps of (1024, 2), one of (1024, 4)
+        fh.write("1024\t2\t1.0\t0.5\t0.5\n1024\t2\t1.1\t0.5\t0.6\n"
+                 "1024\t4\t0.9\t0.4\t0.5\n")
+    journal = he.journal_for(tsv)
+    # journal knows a rep the (truncated) TSV lost, and fewer of (1024,2)
+    journal.record("1024:4:0", {"total_ms": 0.9})
+    journal.record("1024:4:1", {"total_ms": 0.8})
+    journal.record("1024:2:0", {"total_ms": 1.0})
+    done = he.done_counts(tsv, journal)
+    assert done[(1024, 2)] == 2  # TSV max wins
+    assert done[(1024, 4)] == 2  # journal max wins
+
+
+def test_harness_stale_journal_dies_with_its_tsv(tmp_path):
+    """Deleting/rotating a sweep TSV must invalidate its sidecar
+    journal: a redone sweep may not skip cells whose data is gone."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "run_experiments",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "harness", "run_experiments.py"))
+    he = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(he)
+
+    out = str(tmp_path)
+    path = he.sweep("serial", [1024], [1, 2], reps=2, outdir=out,
+                    resume=True, seed=0)
+    assert len(open(path).read().strip().splitlines()) == 4
+    os.remove(path)  # the user redoes the sweep
+    path2 = he.sweep("serial", [1024], [1, 2], reps=2, outdir=out,
+                     resume=True, seed=0)
+    assert path2 == path
+    # all four cells re-ran: the stale journal did not claim them
+    assert len(open(path).read().strip().splitlines()) == 4
+
+
+# -------------------------------------------------------- bench --resume
+
+
+def _bench_record(capsys, monkeypatch, argv):
+    import bench
+
+    monkeypatch.setattr(bench, "SMOKE_N", 1 << 9)
+    monkeypatch.setattr(bench, "SMOKE_LARGE_LOGNS", (10,))
+    rc = bench.main(argv)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def test_bench_resume_same_cells_without_recompute(tmp_path, capsys,
+                                                   monkeypatch):
+    """The acceptance criterion: a journaled run and its --resume re-run
+    produce the same result cells, and completed cells are NOT
+    re-executed."""
+    import bench
+
+    jpath = str(tmp_path / "bench-journal.jsonl")
+    rc1, rec1 = _bench_record(capsys, monkeypatch,
+                              ["--smoke", "--journal", jpath])
+    assert rc1 == 0
+
+    def must_not_run(*a, **k):
+        raise AssertionError("completed cell re-executed under --resume")
+
+    monkeypatch.setattr(bench, "measure_tpu_ms", must_not_run)
+    monkeypatch.setattr(bench, "measure_xla_fft_ms", must_not_run)
+    rc2, rec2 = _bench_record(capsys, monkeypatch,
+                              ["--smoke", "--journal", jpath, "--resume"])
+    assert rc2 == 0
+    assert rec1 == rec2
+
+
+def test_bench_resume_recomputes_only_killed_cell(tmp_path, capsys,
+                                                  monkeypatch):
+    """Kill-mid-run simulation: the journal's last line is half-written;
+    --resume re-measures exactly that cell and the final record carries
+    the same cell set as an uninterrupted run."""
+    jpath = str(tmp_path / "bench-journal.jsonl")
+    rc1, rec1 = _bench_record(capsys, monkeypatch,
+                              ["--smoke", "--journal", jpath])
+    assert rc1 == 0
+    lines = open(jpath).read().splitlines()
+    with open(jpath, "w") as fh:
+        fh.write("\n".join(lines[:-1]))
+        fh.write('\n{"cell": "n2^10", "n2^10_ms"')  # truncated by a kill
+    rc2, rec2 = _bench_record(capsys, monkeypatch,
+                              ["--smoke", "--journal", jpath, "--resume"])
+    assert rc2 == 0
+    assert set(rec1) == set(rec2)
+    # the undamaged cells were loaded, the damaged one re-measured
+    err = capsys.readouterr().err
+    assert "corrupt" not in err  # capsys already drained; sanity only
+
+
+def test_bench_resume_refuses_mismatched_config(tmp_path, capsys,
+                                                monkeypatch):
+    """Resuming a journal written by a different bench configuration
+    (smoke vs full, different sizes) must refuse loudly BEFORE any
+    measurement — splicing toy smoke numbers into a full-N headline
+    record would publish a wrong number."""
+    import bench
+
+    jpath = str(tmp_path / "bench-journal.jsonl")
+    rc1, _ = _bench_record(capsys, monkeypatch,
+                           ["--smoke", "--journal", jpath])
+    assert rc1 == 0
+
+    def must_not_run(*a, **k):
+        raise AssertionError("measured despite config mismatch")
+
+    monkeypatch.setattr(bench, "measure_tpu_ms", must_not_run)
+    monkeypatch.setattr(bench, "measure_xla_fft_ms", must_not_run)
+    monkeypatch.setattr(bench, "measure_c_baseline_ms", must_not_run)
+    # full (non-smoke) resume against the smoke journal: usage error
+    rc = bench.main(["--journal", jpath, "--resume"])
+    assert rc == 2
+    assert "different bench configuration" in capsys.readouterr().err
+
+
+def test_bench_failed_row_is_not_canonized_by_resume(tmp_path, capsys,
+                                                     monkeypatch):
+    """A large-n row whose measurement failed outright returns {}; the
+    journal must NOT record that as a completed cell — --resume has to
+    re-measure it."""
+    import bench
+
+    jpath = str(tmp_path / "bench-journal.jsonl")
+    real_measure = bench.measure_tpu_ms
+
+    def flagship_only(n, smoke=False):
+        if n == 1 << 10:  # the large-n row (SMOKE_LARGE_LOGNS patch)
+            raise RuntimeError("RESOURCE_EXHAUSTED: bad moment")
+        return real_measure(n, smoke=smoke)
+
+    monkeypatch.setattr(bench, "measure_tpu_ms", flagship_only)
+    rc1, rec1 = _bench_record(capsys, monkeypatch,
+                              ["--smoke", "--journal", jpath])
+    assert rc1 == 0 and "n2^10_ms" not in rec1
+    cells = Journal(jpath).load()
+    assert "n2^10" not in cells  # the failure was not journaled
+    # the bad moment passes: --resume re-measures exactly that row
+    monkeypatch.setattr(bench, "measure_tpu_ms", real_measure)
+    rc2, rec2 = _bench_record(capsys, monkeypatch,
+                              ["--smoke", "--journal", jpath, "--resume"])
+    assert rc2 == 0 and "n2^10_ms" in rec2
+
+
+def test_bench_smoke_chaos_degrades_and_completes(capsys, monkeypatch):
+    """make bench-chaos in miniature: with every kernel entry dying of
+    CAPACITY, bench --smoke still exits 0, tags the record degraded,
+    and records the demotion trail."""
+    with inject("tube", "capacity"):
+        rc, rec = _bench_record(capsys, monkeypatch, ["--smoke"])
+    assert rc == 0
+    assert rec.get("degraded") is True
+    assert rec["plan"]["degraded"] is True
+    assert rec["plan"]["demotions"]
+
+
+# ------------------------------------------------------------ sharded path
+
+
+def test_sharded_pi_fft_survives_resolve_fault(devices8):
+    """The sharded entry's tube-plan resolution degrading to the jnp
+    tube must leave the transform correct on a real (virtual) mesh."""
+    import jax
+
+    from cs87project_msolano2_tpu.parallel import make_mesh, pi_fft_sharded
+
+    n = 128 * 8
+    mesh = make_mesh(8)
+    xr, xi = _planes(n, seed=5)
+    with inject("resolve", "capacity"):
+        yr, yi = jax.jit(
+            lambda a, b: pi_fft_sharded(a, b, mesh))(xr, xi)
+    assert _rel_err(np.asarray(yr), np.asarray(yi),
+                    _pi_reference(xr, xi)) < 1e-4
