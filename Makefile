@@ -6,7 +6,8 @@
 # analyze-datasets uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all clean recompile test bench bench-smoke bench-chaos replicate \
+.PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
+        bench-chaos replicate \
         run-experiments run-experiments-and-analyze-results analyze \
         analyze-datasets check lint
 
@@ -46,6 +47,31 @@ bench: all
 # the CI rot check: whole reporting pipeline at toy sizes, offline
 bench-smoke:
 	PIFFT_PLAN_CACHE=off python3 bench.py --smoke
+
+# the CI observability check (docs/OBSERVABILITY.md): the same smoke
+# run with the event stream armed — every emitted event must validate
+# against the schema, the Chrome export must load, and the summary must
+# report nonzero plan-cache activity (the counters are actually wired,
+# not just declared)
+bench-smoke-obs:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 bench.py --smoke \
+	  --events /tmp/pifft-obs-events.jsonl \
+	  --trace-out /tmp/pifft-obs-trace.json \
+	  | tee /tmp/pifft-bench-obs.json && \
+	python3 -m cs87project_msolano2_tpu.cli obs validate \
+	  --events /tmp/pifft-obs-events.jsonl && \
+	python3 -m cs87project_msolano2_tpu.cli obs summary \
+	  --events /tmp/pifft-obs-events.jsonl --json \
+	  | python3 -c "import json, sys; \
+	s = json.load(sys.stdin); c = s['metrics']['counters']; \
+	act = sum(v for k, v in c.items() if k.startswith('pifft_plan_cache_')); \
+	assert act > 0, c; \
+	rec = json.load(open('/tmp/pifft-bench-obs.json')); \
+	assert rec.get('run') in s['runs'], (rec.get('run'), s['runs']); \
+	json.load(open('/tmp/pifft-obs-trace.json')); \
+	print('# obs smoke ok: %d events, plan-cache activity %g, run %s' \
+	      % (s['event_count'], act, rec['run']))"
 
 # the CI chaos check (docs/RESILIENCE.md): with every kernel entry
 # dying of an injected CAPACITY fault, the degradation chain must carry

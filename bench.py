@@ -61,6 +61,18 @@ numbers are meaningless (interpret mode); the JSON shape, the plan
 resolution, and every measurement seam are real.  ``make bench-chaos``
 runs it with ``PIFFT_FAULT=tube:capacity:1.0`` and asserts the
 degradation chain carried the run to rc=0 with a recorded demotion.
+
+Observability (docs/OBSERVABILITY.md): ``--events PATH`` arms the
+structured event stream (JSONL sink) — every cell runs under a named
+span with a funnel/tube phase probe nested inside it, plan-cache /
+retry / demotion activity is counted, the final metrics snapshot is
+appended as the last event, and the JSON record carries the ``run`` id
+every event shares.  ``--trace-out PATH`` additionally writes the
+run's spans as Chrome trace JSON (Perfetto-loadable);
+``pifft obs {summary, export, validate}`` post-processes the events
+file.  Without the flags (and without ``PIFFT_OBS*`` in the
+environment) the whole layer is a no-op.  ``make bench-smoke-obs`` is
+the CI gate over all of this.
 """
 
 import argparse
@@ -280,6 +292,28 @@ def measure_large_n_ms(logns=LARGE_LOGNS, smoke: bool = False) -> dict:
     return out
 
 
+def _phase_probe(n: int) -> None:
+    """One small funnel/tube decomposition run under the current cell
+    span, so the trace carries named, NESTED funnel/tube phase spans
+    (and XProf TraceAnnotations) for this cell.  Observability
+    structure only — never timed, never part of any measurement — and
+    sized down (the phase spans record their own probe shape; the cell
+    span carries the real n) so the probe stays trivial next to the
+    measurement it decorates.  A no-op unless --events/--trace-out (or
+    PIFFT_OBS*) armed the obs subsystem."""
+    from cs87project_msolano2_tpu import obs
+
+    if not obs.enabled():
+        return
+    from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
+
+    pn = min(n, 1 << 12)
+    rng = np.random.default_rng(0)
+    xr = rng.standard_normal(pn).astype(np.float32)
+    xi = rng.standard_normal(pn).astype(np.float32)
+    pi_fft_pi_layout(xr, xi, min(8, pn))
+
+
 def measure_c_baseline_ms() -> float:
     from cs87project_msolano2_tpu.backends.cpu import num_cores
     from cs87project_msolano2_tpu.backends.registry import get_backend
@@ -309,7 +343,21 @@ def main(argv=None) -> int:
                          "(default journal: bench-journal.jsonl); a "
                          "killed bench re-run this way recomputes only "
                          "what the kill took")
+    ap.add_argument("--events", default=None, metavar="PATH",
+                    help="write the structured observability event "
+                         "stream (JSONL) to PATH and tag the record "
+                         "with the run id (docs/OBSERVABILITY.md)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write the run's spans as Chrome trace JSON "
+                         "(open in Perfetto / chrome://tracing)")
     args = ap.parse_args(argv)
+
+    from cs87project_msolano2_tpu import obs
+
+    if args.events:
+        obs.enable(events_path=args.events)
+    elif args.trace_out and not obs.enabled():
+        obs.enable()
 
     n = SMOKE_N if args.smoke else N
     logns = SMOKE_LARGE_LOGNS if args.smoke else LARGE_LOGNS
@@ -341,20 +389,29 @@ def main(argv=None) -> int:
         else:
             journal.record("config", config)
 
-    def cell(name, compute):
+    def cell(name, compute, probe_n=None):
         """compute() -> JSON-safe payload dict, checkpointed per cell.
         An EMPTY payload (a row whose measurement failed outright) is
         never journaled: --resume must re-measure it, not canonize the
-        failure as a completed cell."""
+        failure as a completed cell.  Each computed cell runs under a
+        named observability span (with a nested funnel/tube phase probe
+        for transform cells) and lands in the event stream — no-ops
+        while the obs subsystem is disabled."""
         if journal is not None and journal.has(name):
             rec = dict(journal.get(name))
             rec.pop("cell", None)
             plans.warn(f"bench --resume: cell {name} loaded from journal "
                        f"(not re-measured)")
+            obs.emit("bench_cell_loaded", cell={"name": name})
             return rec
-        out = compute()
+        with obs.span("cell", cell={"name": name, "n": probe_n or n}):
+            if probe_n is not None:
+                _phase_probe(probe_n)
+            out = compute()
         if journal is not None and out:
             journal.record(name, out)
+        obs.emit("bench_cell", cell={"name": name},
+                 ok=bool(out), **(out if out else {}))
         return out
 
     def flagship_cell():
@@ -372,14 +429,15 @@ def main(argv=None) -> int:
         ms = measure_xla_fft_ms(n, smoke=args.smoke)
         return {} if ms is None else {"xla_ms": ms}
 
-    flagship = cell("flagship", flagship_cell)
+    flagship = cell("flagship", flagship_cell, probe_n=n)
     xla = cell("xla", xla_cell)
     large = {}
     degraded_rows = False
     for logn in logns:
         row = cell(f"n2^{logn}",
                    lambda logn=logn: measure_large_n_row(
-                       logn, smoke=args.smoke))
+                       logn, smoke=args.smoke),
+                   probe_n=1 << logn)
         degraded_rows |= bool(row.get(f"n2^{logn}_degraded"))
         large.update(row)
     if args.smoke:
@@ -416,6 +474,18 @@ def main(argv=None) -> int:
         record["vs_xla_fft"] = round(xla_ms / tpu_ms, 2)
         record["xla_fft_ms"] = round(xla_ms, 4)
     record.update(large)
+    if obs.enabled():
+        # the run id ties this record to every event/span/metric the
+        # run emitted; the metrics snapshot is the stream's last word
+        record["run"] = obs.run_id()
+        from cs87project_msolano2_tpu.obs import export, metrics
+
+        obs.emit("metrics", snapshot=metrics.snapshot())
+        obs.flush()
+        if args.trace_out:
+            export.write_chrome_trace(args.trace_out)
+            plans.warn(f"chrome trace written to {args.trace_out} "
+                       f"(open in Perfetto)")
     print(json.dumps(record))
     return 0
 

@@ -68,6 +68,6 @@ def num_cores() -> int:
 
 
 # kept for API symmetry with timing-free callers; a raw clock read, not
-# a measurement, so the timing-layer rule is waived here
+# a measurement, so the sanctioned-clock rules are waived here
 def wall_ms() -> float:
-    return time.perf_counter() * 1e3  # pifft: noqa[PIF102]
+    return time.perf_counter() * 1e3  # pifft: noqa[PIF102, PIF106]

@@ -38,6 +38,18 @@ SHAPE_PARAM_NAMES = ("n", "p", "k", "shape", "tile", "cb", "qb", "tail",
 # timing discipline do not apply inside it
 TIMING_LAYER = ("*utils/timing.py",)
 
+# the SANCTIONED CLOCK layers: utils/timing.py (device measurement —
+# loop-slope, relay discipline) and obs/spans.py (observability spans
+# and progress/ETA arithmetic).  Every other module routes clock reads
+# through one of them (PIF102/PIF106).
+SANCTIONED_CLOCK_LAYERS = ("*utils/timing.py", "*obs/spans.py")
+
+# the monotonic measurement clocks PIF106 polices — including the _ns
+# forms and bare references (a clock passed as a callable dodges the
+# call-site rules)
+MEASUREMENT_CLOCKS = ("time.perf_counter", "time.perf_counter_ns",
+                      "time.monotonic", "time.monotonic_ns")
+
 
 def _is_wall_clock(ctx: FileContext, call: ast.Call,
                    names=WALL_CLOCK) -> bool:
@@ -149,7 +161,7 @@ class WallClockOutsideTimingLayer(Rule):
     invariant = ("only the timing layer knows when block_until_ready is "
                  "a lie (the axon relay) and when the loop-slope method "
                  "is required — ad-hoc wall-clock bypasses that choice")
-    default_config = {"exempt": TIMING_LAYER}
+    default_config = {"exempt": SANCTIONED_CLOCK_LAYERS}
 
     def check(self, ctx: FileContext, config: dict) -> Iterator:
         for node in ast.walk(ctx.tree):
@@ -392,6 +404,46 @@ class BroadExceptAroundKernel(Rule):
             if target and target.split(".")[-1] in classified:
                 return True
         return False
+
+
+@register
+class MeasurementClockOutsideSanctionedLayers(Rule):
+    id = "PIF106"
+    name = "measurement-clock-outside-sanctioned-layers"
+    summary = ("time.perf_counter/time.monotonic (calls AND bare "
+               "references) outside utils/timing.py and obs/spans.py — "
+               "all measurement goes through the sanctioned clocks")
+    invariant = ("two layers own monotonic clock reads: utils/timing.py "
+                 "(device measurement — it alone knows when "
+                 "block_until_ready lies and the loop-slope method is "
+                 "required) and obs/spans.py (span timestamps and "
+                 "progress/ETA arithmetic).  A clock read anywhere else "
+                 "is an unsanctioned measurement the relay discipline "
+                 "never vetted — and unlike PIF102 this rule also "
+                 "catches the clock *referenced* (aliased, passed as a "
+                 "timer callable), which dodges call-site rules")
+    default_config = {
+        "exempt": SANCTIONED_CLOCK_LAYERS,
+        "clocks": MEASUREMENT_CLOCKS,
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        clocks = set(config["clocks"])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Attribute, ast.Name)):
+                continue
+            name = dotted_name(node)
+            if name is None:
+                continue
+            target = ctx.imports.resolve(name)
+            if target in clocks:
+                yield self.finding(
+                    ctx, node,
+                    f"`{target}` referenced outside the sanctioned "
+                    f"clock layers — route device measurement through "
+                    f"utils.timing and span/ETA arithmetic through "
+                    f"obs.spans.clock (or justify with "
+                    f"# pifft: noqa[PIF106])")
 
 
 def _is_broad_handler(type_node, broad) -> bool:

@@ -67,6 +67,15 @@ class RecompileGuard:
             # no-jit debug runs don't fail budgets spuriously
             if not jax.config.jax_disable_jit:
                 rec["traces"] += 1
+                from ..obs import events, metrics
+
+                metrics.inc("pifft_recompiles_total", fn=rec["name"])
+                if rec["traces"] > rec["budget"]:
+                    # the over-budget trace is the anomaly worth a
+                    # structured record (every function traces once)
+                    events.emit("recompile_over_budget", fn=rec["name"],
+                                traces=rec["traces"],
+                                budget=rec["budget"])
             return fn(*args, **kwargs)
 
         return jax.jit(counted, **jit_kwargs)

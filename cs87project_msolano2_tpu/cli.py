@@ -7,6 +7,8 @@
     python -m cs87project_msolano2_tpu check [path ...] [--rule ID]
                                          [--json] [--baseline FILE]
     python -m cs87project_msolano2_tpu faults {list | inject <spec>}
+    python -m cs87project_msolano2_tpu obs {summary | export | validate}
+                                         [--events FILE] [--format F]
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
@@ -30,6 +32,14 @@ the spec in-process and drives a small pi-layout transform through the
 plan layer, reporting what fired, how it classified, and whether the
 retry/degradation policies carried the run — the one-command demo that
 the recovery ladder works on THIS machine.
+
+The `obs` subcommand fronts the observability subsystem
+(docs/OBSERVABILITY.md): `summary` rolls an event stream (the JSONL
+file `bench.py --events` / `PIFFT_OBS_EVENTS` wrote) into a human
+table (`--json` for machines), `export --format {chrome,prom}`
+converts it to Chrome trace JSON (Perfetto) or the Prometheus textfile
+format, and `validate` schema-checks every event (the CI obs-smoke
+gate).
 """
 
 from __future__ import annotations
@@ -261,6 +271,83 @@ def faults_main(argv) -> int:
     return 0 if err < 1e-5 else 1
 
 
+def obs_main(argv) -> int:
+    """`obs {summary|export|validate}` — post-process a structured
+    event stream (docs/OBSERVABILITY.md)."""
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu obs",
+        description="summarize / export / validate an observability "
+                    "event stream (a JSONL file written by "
+                    "bench.py --events or PIFFT_OBS_EVENTS)",
+    )
+    ap.add_argument("action", choices=("summary", "export", "validate"))
+    ap.add_argument("--events", default="pifft-events.jsonl",
+                    metavar="FILE",
+                    help="the event-stream JSONL file (default: "
+                         "pifft-events.jsonl)")
+    ap.add_argument("--format", choices=("chrome", "prom"),
+                    default="chrome",
+                    help="export format: Chrome trace JSON (Perfetto) "
+                         "or Prometheus textfile")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="export: write here instead of stdout")
+    ap.add_argument("--json", action="store_true",
+                    help="summary: machine-readable output")
+    args = ap.parse_args(argv)
+
+    import json as _json
+    import os
+
+    from .obs import events as obs_events
+    from .obs import export as obs_export
+
+    if not os.path.exists(args.events):
+        print(f"error: no event stream at {args.events} (run with "
+              f"bench.py --events or PIFFT_OBS_EVENTS=<path>)",
+              file=sys.stderr)
+        return 2
+    records, dropped = obs_events.load_events(args.events)
+
+    if args.action == "validate":
+        problems = obs_export.validate_stream(records)
+        for ident, problem in problems:
+            print(f"{args.events}: event {ident}: {problem}",
+                  file=sys.stderr)
+        tail = (f", {dropped} corrupt line(s) skipped" if dropped else "")
+        if problems:
+            print(f"obs validate: {len(problems)} schema problem(s) in "
+                  f"{len(records)} event(s){tail}", file=sys.stderr)
+            return 1
+        print(f"obs validate: {len(records)} event(s) OK{tail}")
+        return 0
+
+    if args.action == "summary":
+        summary = obs_export.summarize(records, dropped)
+        print(_json.dumps(summary, indent=1, sort_keys=True)
+              if args.json else obs_export.format_summary(summary))
+        return 0
+
+    # export
+    if args.format == "chrome":
+        doc = obs_export.chrome_trace(
+            obs_export.spans_from_events(records))
+        text = _json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    else:
+        snap = obs_export.last_metrics_snapshot(records)
+        if snap is None:
+            print("error: the stream has no metrics snapshot (the run "
+                  "died before its final flush)", file=sys.stderr)
+            return 1
+        text = obs_export.prometheus_text(snap)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.format} export to {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -268,6 +355,8 @@ def main(argv=None) -> int:
         return plan_main(argv[1:])
     if argv and argv[0] == "faults":
         return faults_main(argv[1:])
+    if argv and argv[0] == "obs":
+        return obs_main(argv[1:])
     if argv and argv[0] == "check":
         from .check.cli import main as check_main
 
@@ -305,7 +394,7 @@ def main(argv=None) -> int:
 
     x = make_input(args.n, args.seed)
     try:
-        from .utils.tracing import trace
+        from .obs.profiler import trace
 
         with trace(args.trace):
             res = b.run(x, args.p, reps=args.reps)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.spans import span as _span
 from ..ops.bits import ilog2
 from ..ops.butterfly import stage_full, stage_half
 
@@ -39,18 +40,26 @@ def _tables_for(n, tables):
 
 
 def funnel(xr, xi, p, tables=None):
-    """Replicated funnel phase.  xr/xi: (..., n) -> (..., p, n // p)."""
+    """Replicated funnel phase.  xr/xi: (..., n) -> (..., p, n // p).
+
+    The phase runs under an observability span (``annotate=True`` also
+    names it via ``jax.profiler.TraceAnnotation``, so a captured XProf
+    trace shows "funnel" as a named region); when the obs subsystem is
+    disabled the span is a shared no-op.  Under jit the span covers
+    TRACE time, not device time — docs/OBSERVABILITY.md."""
     n = xr.shape[-1]
-    k = ilog2(p)
-    tables = _tables_for(n, tables)
-    cr = jnp.broadcast_to(xr[..., None, :], (*xr.shape[:-1], p, n))
-    ci = jnp.broadcast_to(xi[..., None, :], (*xi.shape[:-1], p, n))
-    pis = jnp.arange(p, dtype=jnp.int32)[:, None]  # (p, 1)
-    for i in range(k):
-        wr, wi = tables[i]
-        bottom = (pis >> (k - 1 - i)) & 1
-        cr, ci = stage_half(cr, ci, jnp.asarray(wr), jnp.asarray(wi), bottom)
-    return cr, ci
+    with _span("funnel", cell={"n": n, "p": p}, annotate=True):
+        k = ilog2(p)
+        tables = _tables_for(n, tables)
+        cr = jnp.broadcast_to(xr[..., None, :], (*xr.shape[:-1], p, n))
+        ci = jnp.broadcast_to(xi[..., None, :], (*xi.shape[:-1], p, n))
+        pis = jnp.arange(p, dtype=jnp.int32)[:, None]  # (p, 1)
+        for i in range(k):
+            wr, wi = tables[i]
+            bottom = (pis >> (k - 1 - i)) & 1
+            cr, ci = stage_half(cr, ci, jnp.asarray(wr), jnp.asarray(wi),
+                                bottom)
+        return cr, ci
 
 
 def funnel_single(xr, xi, pi, p, tables=None):
@@ -81,13 +90,14 @@ def tube(sr, si, n, p, tables=None):
     transform use the same tables as a standalone s-point transform, which
     is why zero communication works).
     """
-    k = ilog2(p)
-    s = sr.shape[-1]
-    tables = _tables_for(n, tables)
-    for i in range(ilog2(s)):
-        wr, wi = tables[k + i]
-        sr, si = stage_full(sr, si, jnp.asarray(wr), jnp.asarray(wi))
-    return sr, si
+    with _span("tube", cell={"n": n, "p": p}, annotate=True):
+        k = ilog2(p)
+        s = sr.shape[-1]
+        tables = _tables_for(n, tables)
+        for i in range(ilog2(s)):
+            wr, wi = tables[k + i]
+            sr, si = stage_full(sr, si, jnp.asarray(wr), jnp.asarray(wi))
+        return sr, si
 
 
 def resolve_tube_plan(shape, plan=None, precision=None,
@@ -146,7 +156,9 @@ def tube_planned(sr, si, n, p, plan=None, precision=None):
     plan = resolve_tube_plan(sr.shape, plan, precision)
     if plan is None:
         return tube(sr, si, n, p)
-    return plan.execute(sr, si)
+    with _span("tube", cell={"n": n, "p": p, "variant": plan.variant},
+               annotate=True):
+        return plan.execute(sr, si)
 
 
 def pi_fft_pi_layout(xr, xi, p, tables=None):
@@ -214,7 +226,8 @@ def tube_scan(sr, si, n, p):
     trailing axis.  Mathematically identical to ``tube`` (the n-plan
     levels k.. equal a standalone s-point plan, see ``tube``); compiles
     in O(1) stages instead of O(log s)."""
-    return fft_stages_scan(sr, si)
+    with _span("tube", cell={"n": n, "p": p}, annotate=True):
+        return fft_stages_scan(sr, si)
 
 
 def pi_fft_pi_layout_scan(xr, xi, p, tables=None):

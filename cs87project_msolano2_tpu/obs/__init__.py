@@ -1,0 +1,72 @@
+"""Observability subsystem: structured events, phase spans, a metrics
+registry, and trace exporters — ONE spine for every measurement-adjacent
+signal (docs/OBSERVABILITY.md).
+
+Before this package the signals were scattered: ``utils/timing.py``
+wall-clocks, a bare ``jax.profiler`` wrapper, ``plans.warn`` stderr
+lines, and resilience events (retries, demotions, collective timeouts)
+that were printed but never counted or correlated with the run that
+produced them.  Here they all become one stream with one identity:
+
+* ``events``   — schema'd records ``{run, seq, t, kind, cell, payload}``
+                 in a thread-safe bounded buffer, with an optional
+                 atomic JSONL sink (the resilience journal's writer).
+* ``spans``    — nested, thread-aware phase spans (context manager +
+                 decorator) with ``jax.profiler.TraceAnnotation``
+                 pass-through, exported as Chrome trace JSON
+                 (Perfetto-loadable).  Owns the sanctioned non-timing
+                 clock (PIF106).
+* ``metrics``  — labeled counters/gauges/histograms (plan-cache
+                 hits/misses, autotune fates, retries per FaultKind,
+                 demotions per rung, recompiles, bytes moved).
+* ``export``   — Chrome trace / Prometheus textfile / human summary,
+                 fronted by ``pifft obs {summary, export, validate}``.
+* ``profiler`` — the XProf deep-trace wrapper (moved from
+                 ``utils/tracing.py``; a deprecation shim remains).
+
+The OFF state is the contract: everything is gated on one module-level
+flag (``events._STATE``), so a disabled process pays one attribute read
+per call — no locks, no allocation, zero events (verified by test).
+Enable explicitly (:func:`enable`, ``bench.py --events``) or by
+environment: ``PIFFT_OBS_EVENTS=<path>`` arms the JSONL sink,
+``PIFFT_OBS=1`` buffers in-process only.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import events, export, metrics, profiler, spans  # noqa: F401
+from .events import (  # noqa: F401
+    disable,
+    emit,
+    enable,
+    enabled,
+    flush,
+    run_id,
+    validate_event,
+)
+from .profiler import trace  # noqa: F401
+from .spans import span, traced  # noqa: F401
+
+
+def _env_autoenable() -> None:
+    """Arm observability from the environment at import time, so any
+    entry point (CLI, harness, a user script) can opt in without code:
+    ``PIFFT_OBS_EVENTS=<path>`` writes the JSONL sink, ``PIFFT_OBS=1``
+    keeps events in-process for a later in-process export."""
+    if enabled():
+        return
+    path = os.environ.get("PIFFT_OBS_EVENTS", "").strip()
+    if path:
+        # append, not truncate: the env form outlives single processes
+        # (multi-process jobs and repeated CLI runs share one path, and
+        # atomic lines interleave safely) — the summary separates runs
+        # by run id.  Explicit enable()/--events truncates instead: one
+        # run owns that file.
+        enable(events_path=path, append=True)
+    elif os.environ.get("PIFFT_OBS", "").strip() == "1":
+        enable()
+
+
+_env_autoenable()

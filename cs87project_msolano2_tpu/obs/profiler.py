@@ -1,0 +1,55 @@
+"""Deep-profiler integration (moved here from ``utils/tracing.py``):
+the ``jax.profiler`` trace behind one context manager, no-op when
+profiling is unavailable.
+
+This is the XProf half of the observability story: phase spans
+(:mod:`.spans`, ``annotate=True``) name funnel/tube/cell regions via
+``jax.profiler.TraceAnnotation``, and :func:`trace` captures the deep
+trace those annotations land in.  Workflow: wrap the region in
+``trace(outdir)``, open the result in XProf/TensorBoard, and the
+annotated phases appear as named host-side slices alongside the device
+timeline (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+
+
+@contextlib.contextmanager
+def trace(outdir: str | None):
+    """`with trace("/tmp/trace"):` profiles the block; None disables.
+
+    Only start_trace is guarded: if it fails the block still runs
+    unprofiled, but an exception raised *inside* the block propagates
+    unchanged (a single yield per path — yielding from an except branch
+    would make contextlib re-raise RuntimeError and mask the original).
+    """
+    if not outdir:
+        yield
+        return
+    from . import events
+
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(outdir)
+        started = True
+    except Exception as e:
+        print(f"# profiling unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        events.emit("profile_unavailable", outdir=outdir,
+                    error=f"{type(e).__name__}: {e}")
+    if started:
+        events.emit("profile_start", outdir=outdir)
+    try:
+        yield
+    finally:
+        if started:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"# profiler trace written to {outdir}", file=sys.stderr)
+            events.emit("profile_written", outdir=outdir)

@@ -1,0 +1,208 @@
+"""Nested phase spans: host-side begin/end intervals with run/cell
+identity, exported as Chrome trace events and mirrored into the event
+stream.
+
+This module (together with ``utils/timing.py``) is a SANCTIONED CLOCK
+LAYER: it may read ``time.perf_counter`` directly; everything else in
+the project routes through it (check rules PIF102/PIF106).  The
+distinction from the timing layer matters and is deliberate:
+
+* ``utils/timing.py`` produces **measurements** — device numbers a row
+  or a law fit may cite, which on the axon relay requires the
+  loop-slope method because ``block_until_ready`` is not a barrier.
+* spans produce **observability** — host-side wall intervals (trace
+  time, dispatch time, sweep-cell wall time, ETA arithmetic) that
+  narrate where a run spent its time.  A span duration is NEVER a
+  device measurement unless the span was closed over an explicit
+  device-sync boundary (the ``sync=`` argument, which routes through
+  ``timing.block`` and inherits its documented relay caveat).
+
+Spans nest per thread (a thread-local stack tracks parent/depth), cost
+one flag check when observability is disabled (the disabled path
+returns a shared no-op singleton — no allocation, no locks), and
+pass through :class:`jax.profiler.TraceAnnotation` when requested so
+funnel/tube/cell phases show up NAMED in an XProf/TensorBoard trace
+captured around them.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Optional
+
+
+def clock() -> float:
+    """THE sanctioned monotonic clock (seconds).  For progress/ETA
+    arithmetic and span timestamps — never for device measurement
+    (that is ``utils.timing``'s job; see the module docstring)."""
+    return time.perf_counter()
+
+
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class _NoopSpan:
+    """The disabled path: one shared instance, no state, no work."""
+
+    __slots__ = ()
+    dur_s = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One live span.  Use via :func:`span`; on exit the finished
+    record goes to :func:`events.record_span` (buffer + event stream).
+    """
+
+    __slots__ = ("name", "cell", "args", "annotate", "sync",
+                 "t0", "dur_s", "_parent", "_depth", "_ann")
+
+    def __init__(self, name: str, cell: Optional[dict], annotate: bool,
+                 sync: Optional[Callable], args: dict):
+        self.name = name
+        self.cell = cell
+        self.args = args
+        self.annotate = annotate
+        self.sync = sync
+        self.t0 = None
+        self.dur_s = None
+        self._parent = None
+        self._depth = 0
+        self._ann = None
+
+    def set(self, **args):
+        """Attach/overwrite span attributes mid-flight (they land in
+        the record's ``args``)."""
+        self.args.update(args)
+        return self
+
+    def __enter__(self):
+        stack = _stack()
+        if stack:
+            self._parent = stack[-1].name
+            self._depth = stack[-1]._depth + 1
+        stack.append(self)
+        if self.annotate:
+            try:
+                import jax
+
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except (ImportError, AttributeError, TypeError, RuntimeError):
+                # profiler machinery unavailable (no jax, headless
+                # build): the span itself still records — annotation is
+                # strictly additive
+                self._ann = None
+        self.t0 = clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        sync_error = None
+        if self.sync is not None:
+            # explicit device-sync boundary: close over the fetched/
+            # blocked value so the interval covers device completion
+            # (timing.block's relay caveat applies — see module doc).
+            # A sync failure is CAPTURED, never raised here: the
+            # cleanup below (annotation exit, stack pop, span record)
+            # must always run or every later span on this thread
+            # mis-nests — the error re-raises after cleanup instead.
+            try:
+                from ..utils.timing import block
+
+                block(self.sync() if callable(self.sync) else self.sync)
+            except Exception as e:
+                sync_error = e
+        self.dur_s = clock() - self.t0
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+            self._ann = None
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # tolerate out-of-order exits
+            stack.remove(self)
+        from . import events
+
+        st = events._STATE
+        if st is not None:
+            rec = {"name": self.name, "ts_s": round(self.t0 - st.t0, 9),
+                   "dur_s": round(self.dur_s, 9),
+                   "tid": threading.get_ident(), "depth": self._depth}
+            if self._parent:
+                rec["parent"] = self._parent
+            if self.cell:
+                rec["cell"] = dict(self.cell)
+            if self.args:
+                rec["args"] = dict(self.args)
+            if exc_type is not None:
+                rec["error"] = exc_type.__name__
+            elif sync_error is not None:
+                rec["error"] = type(sync_error).__name__
+            events.record_span(rec)
+        if sync_error is not None and exc_type is None:
+            raise sync_error
+        # already unwinding: the body's original exception wins
+        return False
+
+
+def span(name: str, cell: Optional[dict] = None, annotate: bool = False,
+         sync: Optional[Callable] = None, **args):
+    """A phase span context manager.
+
+        with span("tube", cell={"n": n, "p": p}):
+            ...
+
+    When observability is disabled this returns the shared no-op
+    singleton — a true no-op (no locks, no allocation).  `annotate=True`
+    additionally enters ``jax.profiler.TraceAnnotation(name)`` so the
+    phase is named in an XProf trace; `sync` (a pytree or a callable
+    returning one) closes the span over ``timing.block`` of that value.
+    """
+    from . import events
+
+    if events._STATE is None:
+        return NOOP_SPAN
+    return Span(name, cell, annotate, sync, args)
+
+
+def traced(name: Optional[str] = None, annotate: bool = False):
+    """Decorator form: ``@traced("phase")`` wraps every call of the
+    function in a span (no-op while observability is disabled)."""
+
+    def deco(fn: Callable) -> Callable:
+        label = name or getattr(fn, "__name__", "span")
+
+        @functools.wraps(fn)
+        def run(*a, **kw):
+            with span(label, annotate=annotate):
+                return fn(*a, **kw)
+
+        return run
+
+    return deco
+
+
+def current_depth() -> int:
+    """Nesting depth of the calling thread's open spans (0 = none) —
+    test/diagnostic helper."""
+    return len(_stack())

@@ -59,6 +59,8 @@ def init_distributed(coordinator: Optional[str] = None,
         process_id = int(pid) if pid is not None else None
     if not coordinator or num_processes <= 1:
         return False
+    from ..obs import events, spans
+
     kwargs = {}
     if os.environ.get("PIFFT_RENDEZVOUS_DEADLINE_S", "").strip():
         # jax.distributed.initialize grew initialization_timeout after
@@ -69,18 +71,25 @@ def init_distributed(coordinator: Optional[str] = None,
         kwargs["initialization_timeout"] = max(
             int(round(rendezvous_deadline_s())), 1)
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_processes,
-            process_id=process_id,
-            **kwargs,
-        )
+        # the job-formation rendezvous is a collective region like any
+        # other: span it so a slow coordinator shows up named in the
+        # trace/event stream (docs/OBSERVABILITY.md)
+        with spans.span("collective:init_distributed",
+                        processes=num_processes):
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
     except TypeError:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        with spans.span("collective:init_distributed",
+                        processes=num_processes, compat="no-timeout"):
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
     except Exception as e:
         from ..resilience import FaultKind, classify
 
@@ -90,6 +99,8 @@ def init_distributed(coordinator: Optional[str] = None,
                 f"job at {coordinator} ({type(e).__name__}: "
                 f"{str(e)[:200]})") from e
         raise
+    events.emit("distributed_init", coordinator=coordinator,
+                processes=num_processes, process_id=process_id)
     return True
 
 

@@ -101,26 +101,38 @@ def tune(key: PlanKey, *, force: bool = False,
         raise TuningUnavailable(f"no tunable candidates for {key.token()}")
     timer = timer or default_timer
 
-    results = []
-    for variant, params in cands:
-        label = f"{variant} {params}"
-        try:
-            fn = ladder.build_executor(key, variant, params)
-            ms = float(timer(fn, key))
-        except Exception as e:  # compile/lowering failure: non-fatal
-            from ..resilience import classify
+    from ..obs import metrics, spans
 
-            # the FaultKind leads the reason so a race record doubles as
-            # a fault-taxonomy record (capacity rejections at the
-            # scoped-VMEM cliff vs permanent lowering failures)
-            reason = (f"{classify(e).value} "
-                      f"{type(e).__name__}: {str(e)[:200]}")
+    results = []
+    with spans.span("autotune", cell={"n": key.n, "layout": key.layout},
+                    candidates=len(cands)):
+        for variant, params in cands:
+            label = f"{variant} {params}"
+            try:
+                fn = ladder.build_executor(key, variant, params)
+                ms = float(timer(fn, key))
+            except Exception as e:  # compile/lowering failure: non-fatal
+                from ..resilience import classify
+
+                # the FaultKind leads the reason so a race record
+                # doubles as a fault-taxonomy record (capacity
+                # rejections at the scoped-VMEM cliff vs permanent
+                # lowering failures)
+                fault = classify(e).value
+                reason = (f"{fault} "
+                          f"{type(e).__name__}: {str(e)[:200]}")
+                results.append(CandidateResult(variant, dict(params),
+                                               "rejected", None, reason))
+                metrics.inc("pifft_autotune_candidates_total",
+                            status="rejected", kind=fault)
+                _log(verbose,
+                     f"# plan candidate {label} rejected: {reason}")
+                continue
             results.append(CandidateResult(variant, dict(params),
-                                           "rejected", None, reason))
-            _log(verbose, f"# plan candidate {label} rejected: {reason}")
-            continue
-        results.append(CandidateResult(variant, dict(params), "timed", ms))
-        _log(verbose, f"# plan candidate {label}: {ms:.4f} ms")
+                                           "timed", ms))
+            metrics.inc("pifft_autotune_candidates_total",
+                        status="accepted", kind="timed")
+            _log(verbose, f"# plan candidate {label}: {ms:.4f} ms")
 
     timed = [r for r in results if r.status == "timed"]
     if not timed:
@@ -137,6 +149,12 @@ def tune(key: PlanKey, *, force: bool = False,
     plan = Plan(key=key, variant=best.variant, params=dict(best.params),
                 source="tuned", ms=best.ms, tuning=results)
     cache.store(plan, persist=persist)
+    from ..obs import events
+
+    events.emit("plan_tuned",
+                cell={"n": key.n, "variant": best.variant},
+                ms=best.ms, params=dict(best.params),
+                candidates=[r.to_record() for r in results])
     _log(verbose, f"# plan tuned: {key.token()} -> {best.variant} "
                   f"{best.params} ({best.ms:.4f} ms)")
     return plan

@@ -106,20 +106,29 @@ def memoize(plan: Plan) -> None:
 
 def lookup(key: PlanKey) -> Optional[Plan]:
     """Memory first, then disk.  Returns None on a full miss — the
-    caller decides between static defaults and tuning."""
+    caller decides between static defaults and tuning.  Hit/miss
+    traffic is counted per level in the observability registry
+    (``pifft_plan_cache_{hits,misses}_total`` — docs/OBSERVABILITY.md),
+    a no-op while that subsystem is disabled."""
+    from ..obs import metrics
+
     token = key.token()
     with _LOCK:
         hit = _MEM.get(token)
         if hit is not None:
             _MEM.move_to_end(token)
+            metrics.inc("pifft_plan_cache_hits_total", level="memory")
             return hit
     rec = _load_store(key.device_kind).get(token)
     if rec is None:
+        metrics.inc("pifft_plan_cache_misses_total")
         return None
     try:
         plan = Plan.from_record(key, rec, source="cache")
     except (KeyError, TypeError, ValueError):
+        metrics.inc("pifft_plan_cache_misses_total")
         return None
+    metrics.inc("pifft_plan_cache_hits_total", level="disk")
     memoize(plan)
     return plan
 

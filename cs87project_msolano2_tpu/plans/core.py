@@ -28,8 +28,16 @@ def warn(msg: str) -> None:
     """One-line diagnostic to stderr, `# `-prefixed like the tuner's
     log lines.  Deliberate-swallow sites (PIF501) route through this so
     a degraded session — store never persisting, autotune dying — says
-    so in a greppable, consistent format."""
+    so in a greppable, consistent format.
+
+    Every warn is also mirrored into the observability event stream
+    (kind ``warn``) when that subsystem is enabled, so degradations and
+    diagnostics are machine-readable alongside bench/event JSON — the
+    stderr line is preserved either way (docs/OBSERVABILITY.md)."""
     print(f"# {msg}", file=sys.stderr)
+    from ..obs import events
+
+    events.emit("warn", msg=msg)
 
 
 def current_device_kind() -> str:

@@ -140,6 +140,11 @@ def _note_demotion(plan, from_variant: str, rung: str,
         record["skipped"] = list(skipped)
     plan.degraded = True
     plan.demotions.append(record)
+    from ..obs import events, metrics
+
+    metrics.inc("pifft_demotions_total", to=rung)
+    events.emit("demotion",
+                cell={"n": plan.key.n, "variant": from_variant}, **record)
     warn(f"plan DEGRADED {from_variant} -> {rung} for "
          f"{plan.key.token()} ({kind.value}: {record['reason']})"
          + (f" [also failed: {'; '.join(skipped)}]" if skipped else "")

@@ -15,6 +15,56 @@ import json
 import os
 from typing import Optional
 
+# ------------------------------------------------------- shared writer
+#
+# The atomic-line JSONL discipline is used by more than the journal:
+# the observability event sink (obs/events.py) appends the same way, so
+# the primitives live here as the single implementation.
+
+
+def open_append(path: str):
+    """An append-mode UTF-8 handle for a JSONL file, parents created."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    return open(path, "a", encoding="utf-8")
+
+
+def write_line(fh, rec: dict, *, fsync: bool = True) -> None:
+    """Append one record as a single flushed line.  ``fsync=True`` (the
+    journal's checkpoint semantics) adds the durability barrier; the
+    event sink passes False and batches its barrier in ``obs.flush``.
+    Either way a kill can at worst truncate the LAST line, which
+    :func:`load_records` tolerates."""
+    fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    fh.flush()
+    if fsync:
+        os.fsync(fh.fileno())
+
+
+def load_records(path: str) -> tuple:
+    """(records, dropped) from a JSONL file: every parseable dict line,
+    plus how many corrupt lines (the half-written tail an interrupted
+    write leaves) were skipped.  A missing file is (no records, 0
+    dropped), never an error."""
+    records: list = []
+    dropped = 0
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    dropped += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+                else:
+                    dropped += 1
+    return records, dropped
+
 
 class Journal:
     """Append-only JSONL checkpoint keyed by cell id."""
@@ -29,27 +79,17 @@ class Journal:
         """cell id -> last recorded payload.  Corrupt lines (the
         half-written tail a kill leaves) are skipped with a diagnostic;
         a later record for the same cell wins."""
+        records, dropped = load_records(self.path)
         cells: dict = {}
-        if os.path.exists(self.path):
-            dropped = 0
-            with open(self.path, encoding="utf-8") as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        dropped += 1
-                        continue
-                    if isinstance(rec, dict) and "cell" in rec:
-                        cells[str(rec["cell"])] = rec
-            if dropped:
-                from ..plans.core import warn
+        for rec in records:
+            if "cell" in rec:
+                cells[str(rec["cell"])] = rec
+        if dropped:
+            from ..plans.core import warn
 
-                warn(f"journal {self.path}: skipped {dropped} "
-                     f"corrupt line(s) (interrupted write); the cells "
-                     f"they held will re-run")
+            warn(f"journal {self.path}: skipped {dropped} "
+                 f"corrupt line(s) (interrupted write); the cells "
+                 f"they held will re-run")
         self._cells = cells
         return cells
 
@@ -71,13 +111,8 @@ class Journal:
         before return so a later kill cannot take it back."""
         rec = dict(payload or {})
         rec["cell"] = str(cell)
-        line = json.dumps(rec, sort_keys=True)
-        d = os.path.dirname(os.path.abspath(self.path))
-        os.makedirs(d, exist_ok=True)
-        with open(self.path, "a", encoding="utf-8") as fh:
-            fh.write(line + "\n")
-            fh.flush()
-            os.fsync(fh.fileno())
+        with open_append(self.path) as fh:
+            write_line(fh, rec, fsync=True)
         self._loaded()[str(cell)] = rec
         return rec
 

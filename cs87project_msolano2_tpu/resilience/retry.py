@@ -90,8 +90,19 @@ def call_with_retry(fn: Callable, *args,
             kind = classify(e)
             used[kind] = used.get(kind, 0) + 1
             if used[kind] >= policy.attempts_for(kind):
+                from ..obs import events
+
+                events.emit("retry_exhausted", label=label,
+                            kind=kind.value, attempts=used[kind],
+                            error=f"{type(e).__name__}: {str(e)[:200]}")
                 raise
             pause = policy.backoff_s(used[kind], rng())
+            from ..obs import events, metrics
+
+            metrics.inc("pifft_retries_total", kind=kind.value)
+            events.emit("retry", label=label, kind=kind.value,
+                        attempt=used[kind], pause_s=round(pause, 3),
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
             if on_retry is not None:
                 on_retry(e, used[kind], pause)
             else:

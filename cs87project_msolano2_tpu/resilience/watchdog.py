@@ -80,8 +80,11 @@ def collective_watchdog(label: str, deadline_s: float | None = None,
     done = threading.Event()
 
     def watch():
+        from ..obs import metrics
+
         while not done.wait(deadline):
             report.fired += 1
+            metrics.inc("pifft_watchdog_fires_total", label=label)
             warn(f"CollectiveTimeout: {label} still waiting after "
                  f">= {report.fired * deadline:.0f}s (deadline "
                  f"{deadline:.0f}s; PIFFT_RENDEZVOUS_DEADLINE_S "
@@ -90,12 +93,24 @@ def collective_watchdog(label: str, deadline_s: float | None = None,
     thread = threading.Thread(target=watch, name=f"pifft-watchdog-{label}",
                               daemon=True)
     thread.start()
+    from ..obs import spans
+
     try:
-        yield report
+        # the collective span: the watched region shows up named in the
+        # trace/event stream, with how many deadlines it overran
+        with spans.span(f"collective:{label}",
+                        deadline_s=deadline) as sp:
+            yield report
+            sp.set(fired=report.fired)
     finally:
         done.set()
         thread.join(timeout=deadline + 1.0)
     if report.fired:
+        from ..obs import events
+
+        events.emit("collective_timeout", label=label,
+                    fired=report.fired, deadline_s=deadline,
+                    recovered=not strict)
         if strict:
             raise CollectiveTimeout(
                 f"{label} exceeded its rendezvous deadline "

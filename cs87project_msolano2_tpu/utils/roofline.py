@@ -68,7 +68,17 @@ def roofline_utilization(n: int, ms: float,
     measured at `ms` per call, charging the minimum traffic (see
     fft_min_hbm_bytes).  None when the device peak is unknown or the
     measurement is degenerate."""
+    from ..obs import metrics
+
+    if ms is not None and ms > 0.0:
+        # observability: the minimum-traffic convention is also the
+        # bytes-moved meter — every utilization computation accounts
+        # its floor traffic so a run's total data motion is queryable
+        metrics.inc("pifft_hbm_min_bytes_total", fft_min_hbm_bytes(n))
     peak = hbm_peak_bytes_per_s(device_kind)
     if peak is None or ms is None or ms <= 0.0:
         return None
-    return fft_min_hbm_bytes(n) / (ms * 1e-3) / peak
+    util = fft_min_hbm_bytes(n) / (ms * 1e-3) / peak
+    metrics.set_gauge("pifft_roofline_util", util,
+                      n=f"2^{max(n, 1).bit_length() - 1}")
+    return util

@@ -1,40 +1,19 @@
-"""Tracing/profiling (SURVEY.md §5 row 1): the reference's per-stage
-wall-clock timers are utils/timing.py; this adds the TPU-native deep
-profiler — a jax.profiler trace you can open in XProf/TensorBoard —
-behind one context manager, no-op when profiling is unavailable."""
+"""DEPRECATED shim: the profiler integration moved into the
+observability subsystem — import :func:`trace` from
+``cs87project_msolano2_tpu.obs.profiler`` (or just ``...obs``) instead.
+
+Kept so existing callers and scripts keep working; new code should not
+import this path (docs/OBSERVABILITY.md)."""
 
 from __future__ import annotations
 
-import contextlib
-import sys
+import warnings
 
+from ..obs.profiler import trace  # noqa: F401
 
-@contextlib.contextmanager
-def trace(outdir: str | None):
-    """`with trace("/tmp/trace"):` profiles the block; None disables.
-
-    Only start_trace is guarded: if it fails the block still runs
-    unprofiled, but an exception raised *inside* the block propagates
-    unchanged (a single yield per path — yielding from an except branch
-    would make contextlib re-raise RuntimeError and mask the original).
-    """
-    if not outdir:
-        yield
-        return
-    started = False
-    try:
-        import jax
-
-        jax.profiler.start_trace(outdir)
-        started = True
-    except Exception as e:
-        print(f"# profiling unavailable ({type(e).__name__}: {e})",
-              file=sys.stderr)
-    try:
-        yield
-    finally:
-        if started:
-            import jax
-
-            jax.profiler.stop_trace()
-            print(f"# profiler trace written to {outdir}", file=sys.stderr)
+warnings.warn(
+    "cs87project_msolano2_tpu.utils.tracing moved to "
+    "cs87project_msolano2_tpu.obs.profiler; this shim will be removed",
+    DeprecationWarning,
+    stacklevel=2,
+)

@@ -19,6 +19,14 @@ PERMANENT faults (and ValueError's cell-infeasibility contract, which
 classifies PERMANENT) re-raise immediately: an OOM retried three times
 is three OOMs and twenty minutes of sweep lost.
 
+Observability (docs/OBSERVABILITY.md): every cell runs under a
+``sweep_cell`` span; ``--events PATH`` (or ``PIFFT_OBS_EVENTS``) arms
+the structured event stream — one event per completed/skipped cell,
+progress events carrying the remaining-time estimate (computed from
+the completed-cell span durations, the reference harness's ETA
+feature), and a final metrics snapshot.  Disarmed, the layer is a
+no-op.
+
 TSV contract: `n  p  total_ms  funnel_ms  tube_ms` (5 columns, exactly
 the reference's …pthreads.c:487-491), one file per backend.
 """
@@ -28,7 +36,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 from collections import Counter
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -36,8 +43,10 @@ sys.path.insert(0, REPO)
 
 import numpy as np  # noqa: E402
 
+from cs87project_msolano2_tpu import obs  # noqa: E402
 from cs87project_msolano2_tpu.backends.registry import get_backend  # noqa: E402
 from cs87project_msolano2_tpu.cli import make_input  # noqa: E402
+from cs87project_msolano2_tpu.obs.spans import clock  # noqa: E402
 from cs87project_msolano2_tpu.resilience import (  # noqa: E402
     Journal,
     classify,
@@ -216,22 +225,31 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
     done = done_counts(path, journal) if resume else Counter()
 
     todo = sum(max(reps - done[c], 0) for c in cells)
-    # ETA display only — not a measurement (row timings come from the
-    # backend's own loop-slope timers)
-    t_start = time.perf_counter()  # pifft: noqa[PIF102]
+    # completed-cell wall durations (the sweep_cell spans' own clock,
+    # obs.spans.clock — the sanctioned progress/ETA clock, PIF106);
+    # feeds the remaining-time estimate below.  Display only, never a
+    # measurement (row timings come from the backend's loop-slope
+    # timers).
+    cell_s: list = []
     completed = 0
 
     with open(path, "a") as fh:
         for n, p in cells:
             x = make_input(n, seed)
             for rep in range(done[(n, p)], reps):
+                cell_id = {"n": n, "p": p, "rep": rep}
+                t0 = clock()
                 try:
-                    res = run_cell(backend, x, p)
+                    with obs.span("sweep_cell", cell=cell_id,
+                                  backend=backend_name):
+                        res = run_cell(backend, x, p)
                 except ValueError as e:
                     # per-(n, p) infeasibility (e.g. einsum's p*n cap) is
                     # a property of the cell, not an error of the sweep
                     print(f"# {backend_name} n={n} p={p} skipped: {e}",
                           file=sys.stderr)
+                    obs.emit("sweep_cell_skipped", cell=cell_id,
+                             backend=backend_name, reason=str(e)[:200])
                     todo -= reps - rep
                     break
                 # degraded = loop-slope fell back to dispatch-inclusive
@@ -249,13 +267,23 @@ def sweep(backend_name: str, ns: list[int], ps: list[int], reps: int,
                 os.fsync(fh.fileno())
                 journal.record(f"{n}:{p}:{rep}",
                                {"total_ms": res.total_ms})
+                cell_s.append(clock() - t0)
+                obs.emit("sweep_cell", cell=cell_id, backend=backend_name,
+                         total_ms=res.total_ms, funnel_ms=res.funnel_ms,
+                         tube_ms=res.tube_ms,
+                         degraded=bool(getattr(res, "degraded", False)),
+                         dur_s=round(cell_s[-1], 6))
                 completed += 1
                 if completed % 10 == 0 or completed == todo:
-                    # pifft ETA only, see t_start note above
-                    elapsed = time.perf_counter() - t_start  # pifft: noqa[PIF102]
-                    eta = elapsed / completed * (todo - completed)
+                    # remaining time from the completed-cell durations
+                    # (the reference harness's ETA feature, SURVEY.md
+                    # H4): mean completed cell x cells left
+                    eta = sum(cell_s) / len(cell_s) * (todo - completed)
                     print(f"# {backend_name} {completed}/{todo} "
                           f"(n={n} p={p}) eta {eta:5.0f}s", file=sys.stderr)
+                    obs.emit("sweep_progress", backend=backend_name,
+                             completed=completed, todo=todo,
+                             eta_s=round(eta, 1))
     return path
 
 
@@ -311,7 +339,15 @@ def main(argv=None) -> int:
     ap.add_argument("--full", action="store_true",
                     help="write the deep-replication …-results-full.tsv "
                          "(reference parity: gpu/cuda …-results-full.csv)")
+    ap.add_argument("--events", default=None, metavar="PATH",
+                    help="write the structured observability event "
+                         "stream (per-cell events, progress/ETA, the "
+                         "final metrics snapshot) to a JSONL file — "
+                         "docs/OBSERVABILITY.md")
     args = ap.parse_args(argv)
+
+    if args.events:
+        obs.enable(events_path=args.events)
 
     ns = parse_grid(args.n_grid)
     ps = parse_grid(args.p_grid)
@@ -325,6 +361,11 @@ def main(argv=None) -> int:
     if args.verify:
         for b in backends:
             verify_pass(b, ns, ps, args.seed, args.oversubscribe)
+    if obs.enabled():
+        from cs87project_msolano2_tpu.obs import metrics
+
+        obs.emit("metrics", snapshot=metrics.snapshot())
+        obs.flush()
     return 0
 
 

@@ -424,6 +424,73 @@ def test_pif105_noqa_escape():
     assert run(code, "PIF105") == []
 
 
+# -------------------------------- PIF106 measurement-clock references
+
+
+def test_pif106_flags_calls_and_bare_references():
+    code = """
+        import time
+        from time import perf_counter as pc
+
+        def f():
+            t = time.monotonic()
+            timer = pc          # a bare reference dodges call rules
+            return t, timer
+    """
+    findings = run(code, "PIF106")
+    # the call's attribute AND the aliased bare reference both flag
+    assert rule_ids(findings) == ["PIF106", "PIF106"]
+    assert any("time.monotonic" in f.message for f in findings)
+    assert any("time.perf_counter" in f.message for f in findings)
+
+
+def test_pif106_flags_ns_clocks_pif102_misses():
+    findings = run("""
+        import time
+
+        def f():
+            return time.monotonic_ns()
+    """, "PIF106")
+    assert rule_ids(findings) == ["PIF106"]
+
+
+def test_pif106_sanctioned_clock_layers_exempt():
+    code = """
+        import time
+
+        def stamp():
+            return time.perf_counter()
+    """
+    import textwrap as tw
+
+    for exempt_path in (
+            os.path.join(PKG, "utils", "timing.py"),
+            os.path.join(PKG, "obs", "spans.py")):
+        assert check.check_source(exempt_path, tw.dedent(code),
+                                  rules=["PIF106"]) == []
+
+
+def test_pif106_unrelated_time_usage_passes():
+    code = """
+        import time
+
+        def nap():
+            time.sleep(0.1)
+            return time.strftime("%H:%M")
+    """
+    assert run(code, "PIF106") == []
+
+
+def test_pif106_noqa_escape():
+    code = """
+        import time
+
+        def wall_ms():
+            return time.perf_counter() * 1e3  # pifft: noqa[PIF106]
+    """
+    assert run(code, "PIF106") == []
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 

@@ -1,0 +1,498 @@
+"""Observability subsystem tests (docs/OBSERVABILITY.md): the disabled
+path is a TRUE no-op, spans nest per thread, the exporters produce
+valid Chrome-trace / Prometheus output, the JSONL sink survives the
+half-written tail a kill leaves, and the counters are actually wired —
+asserted through a real ``bench.py --smoke --events`` run (the
+acceptance criterion: nested funnel/tube spans under the per-cell
+span, zero events when disabled)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs
+from cs87project_msolano2_tpu.obs import events, export, metrics, spans
+
+
+@pytest.fixture
+def obs_run():
+    """An enabled observability run, torn down clean even on failure."""
+    rid = obs.enable()
+    yield rid
+    obs.disable()
+    metrics.reset()
+
+
+@pytest.fixture(autouse=True)
+def _never_leak_enabled_state():
+    yield
+    if obs.enabled():  # a failing test must not poison the next one
+        obs.disable()
+        metrics.reset()
+
+
+# ------------------------------------------------------- disabled path
+
+
+def test_disabled_path_is_true_noop():
+    assert not obs.enabled()
+    assert obs.run_id() is None
+    assert obs.emit("anything", x=1) is None
+    assert events.snapshot() == []
+    # zero object churn: every disabled span() is the SAME singleton
+    s1, s2 = obs.span("a", cell={"n": 8}), obs.span("b")
+    assert s1 is s2 is spans.NOOP_SPAN
+    with s1 as sp:
+        assert sp.dur_s is None
+    metrics.inc("c")
+    metrics.set_gauge("g", 1.0)
+    metrics.observe("h", 0.5)
+    snap = metrics.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_bench_smoke_disabled_emits_zero_events(capsys, monkeypatch):
+    """The acceptance criterion's OFF half: the same bench run with
+    observability disabled emits zero events and touches no metric —
+    verified by running it, not by inspection."""
+    import bench
+
+    assert not obs.enabled()
+    metrics.reset()
+    monkeypatch.setattr(bench, "SMOKE_N", 1 << 9)
+    monkeypatch.setattr(bench, "SMOKE_LARGE_LOGNS", (10,))
+    assert bench.main(["--smoke"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "run" not in rec  # no run id without a run
+    assert not obs.enabled()
+    assert events.snapshot() == []
+    assert events.span_snapshot() == []
+    assert metrics.snapshot() == {"counters": {}, "gauges": {},
+                                  "histograms": {}}
+
+
+# ------------------------------------------------------ events + schema
+
+
+def test_emit_envelope_and_validation(obs_run):
+    rec = obs.emit("demo", cell={"n": 64, "p": 8}, value=3)
+    assert rec["run"] == obs_run and rec["kind"] == "demo"
+    assert rec["cell"] == {"n": 64, "p": 8}
+    assert rec["payload"] == {"value": 3}
+    assert events.validate_event(rec) == []
+    # seq is strictly increasing
+    rec2 = obs.emit("demo2")
+    assert rec2["seq"] == rec["seq"] + 1 and rec2["t"] >= rec["t"]
+
+
+@pytest.mark.parametrize("broken, fragment", [
+    ({"v": 1, "run": "r", "seq": 0, "t": 0.0}, "kind"),
+    ({"v": 1, "run": "r", "seq": -1, "t": 0.0, "kind": "x"}, "negative"),
+    ({"v": 99, "run": "r", "seq": 0, "t": 0.0, "kind": "x"}, "version"),
+    ({"v": 1, "run": 7, "seq": 0, "t": 0.0, "kind": "x"}, "run"),
+    ({"v": 1, "run": "r", "seq": 0, "t": 0.0, "kind": "span",
+      "payload": {"name": "a"}}, "payload"),
+    ("not a dict", "object"),
+])
+def test_validate_event_rejects(broken, fragment):
+    problems = events.validate_event(broken)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_jsonl_sink_tolerates_half_written_tail(tmp_path, obs_run):
+    # re-enable with a sink (the fixture's run has none)
+    obs.disable()
+    path = str(tmp_path / "events.jsonl")
+    rid = obs.enable(events_path=path)
+    for i in range(3):
+        obs.emit("tick", i=i)
+    obs.flush()
+    obs.disable()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"v": 1, "run": "' + rid + '", "seq": 3, "t"')  # kill
+    recs, dropped = events.load_events(path)
+    assert len(recs) == 3 and dropped == 1
+    assert export.validate_stream(recs) == []
+    assert [r["payload"]["i"] for r in recs] == [0, 1, 2]
+
+
+def test_warn_mirrors_into_event_stream(obs_run, capsys):
+    from cs87project_msolano2_tpu.plans import warn
+
+    warn("observability mirror check")
+    assert "# observability mirror check" in capsys.readouterr().err
+    evs = [e for e in events.snapshot() if e["kind"] == "warn"]
+    assert evs and evs[-1]["payload"]["msg"] == "observability mirror check"
+
+
+# -------------------------------------------------------------- spans
+
+
+def test_span_nesting_and_attributes(obs_run):
+    with obs.span("outer", cell={"n": 64}) as outer:
+        with obs.span("inner") as inner:
+            inner.set(extra=1)
+    assert outer.dur_s >= inner.dur_s >= 0.0
+    recs = events.span_snapshot()
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["inner"]["args"] == {"extra": 1}
+    assert by_name["outer"]["depth"] == 0 and "parent" not in by_name["outer"]
+    # spans mirror into the event stream with the envelope identity
+    span_events = [e for e in events.snapshot() if e["kind"] == "span"]
+    assert len(span_events) == 2
+    assert all(events.validate_event(e) == [] for e in span_events)
+
+
+def test_span_nesting_is_thread_local(obs_run):
+    barrier = threading.Barrier(2)
+
+    def worker(tag):
+        with obs.span(f"outer-{tag}"):
+            barrier.wait(timeout=30)  # both outers open concurrently
+            with obs.span(f"inner-{tag}"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    recs = events.span_snapshot()
+    assert len(recs) == 4
+    by_name = {r["name"]: r for r in recs}
+    for tag in ("a", "b"):
+        inner, outer = by_name[f"inner-{tag}"], by_name[f"outer-{tag}"]
+        # nesting never crosses threads, even with both stacks open
+        assert inner["parent"] == f"outer-{tag}"
+        assert inner["tid"] == outer["tid"]
+    assert by_name["outer-a"]["tid"] != by_name["outer-b"]["tid"]
+
+
+def test_span_records_error_and_unwinds(obs_run):
+    with pytest.raises(ValueError):
+        with obs.span("doomed"):
+            raise ValueError("boom")
+    assert spans.current_depth() == 0
+    rec = events.span_snapshot()[-1]
+    assert rec["name"] == "doomed" and rec["error"] == "ValueError"
+
+
+def test_span_sync_failure_still_unwinds(obs_run):
+    """A failing sync= boundary must re-raise AFTER cleanup: the
+    thread-local stack pops and the span records, so later spans on the
+    thread are not mis-nested under the dead one."""
+
+    def bad_sync():
+        raise RuntimeError("fetch failed")
+
+    with pytest.raises(RuntimeError, match="fetch failed"):
+        with obs.span("synced", sync=bad_sync):
+            pass
+    assert spans.current_depth() == 0
+    rec = events.span_snapshot()[-1]
+    assert rec["name"] == "synced" and rec["error"] == "RuntimeError"
+    with obs.span("after"):
+        pass
+    after = events.span_snapshot()[-1]
+    assert after["depth"] == 0 and "parent" not in after
+
+
+def test_sink_truncates_by_default_appends_on_request(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    obs.enable(events_path=path)
+    obs.emit("one")
+    obs.disable()
+    rid2 = obs.enable(events_path=path)  # reused path: fresh stream
+    obs.emit("two")
+    obs.disable()
+    recs, _ = events.load_events(path)
+    assert [r["kind"] for r in recs] == ["two"]
+    assert all(r["run"] == rid2 for r in recs)
+    obs.enable(events_path=path, append=True)  # deliberate accumulation
+    obs.emit("three")
+    obs.disable()
+    recs, _ = events.load_events(path)
+    assert [r["kind"] for r in recs] == ["two", "three"]
+    metrics.reset()
+
+
+def test_non_json_payload_keeps_sink_alive(tmp_path, capsys):
+    path = str(tmp_path / "ev.jsonl")
+    obs.enable(events_path=path)
+    obs.emit("good1")
+    obs.emit("bad", value=object())  # not JSON-serializable
+    obs.emit("good2")
+    obs.disable()
+    recs, dropped = events.load_events(path)
+    kinds = [r["kind"] for r in recs]
+    # the bad event is skipped; the sink stays alive for later events
+    # (including the warn that reports the skip — itself a sink write)
+    assert [k for k in kinds if k != "warn"] == ["good1", "good2"]
+    assert "warn" in kinds and dropped == 0
+    assert "obs sink write failed" in capsys.readouterr().err
+    metrics.reset()
+
+
+def test_buffer_overflow_drops_oldest_and_counts(tmp_path):
+    obs.enable(buffer_max=4)
+    for i in range(6):
+        obs.emit("tick", i=i)
+    snap = events.snapshot()
+    assert len(snap) == 4
+    assert [r["payload"]["i"] for r in snap] == [2, 3, 4, 5]
+    assert events.dropped() == 2
+    obs.disable()
+    metrics.reset()
+
+
+def test_traced_decorator(obs_run):
+    @obs.traced("decorated")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert [r["name"] for r in events.span_snapshot()] == ["decorated"]
+
+
+# ----------------------------------------------------------- exporters
+
+
+def test_chrome_trace_is_valid_and_nested(obs_run):
+    with obs.span("cell", cell={"n": 64}):
+        with obs.span("funnel"):
+            pass
+        with obs.span("tube"):
+            pass
+    doc = json.loads(json.dumps(export.chrome_trace()))
+    evs = doc["traceEvents"]
+    assert len(evs) == 3
+    for e in evs:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float))
+        assert e["name"] and e["pid"] and "tid" in e
+    cell = next(e for e in evs if e["name"] == "cell")
+    for phase in ("funnel", "tube"):
+        ph = next(e for e in evs if e["name"] == phase)
+        # ts/dur containment = nesting in Perfetto
+        assert cell["ts"] <= ph["ts"]
+        assert ph["ts"] + ph["dur"] <= cell["ts"] + cell["dur"] + 1e-3
+        assert ph["args"]["parent"] == "cell"
+
+
+def test_prometheus_textfile_format(obs_run):
+    metrics.inc("pifft_plan_cache_hits_total", 2, level="memory")
+    metrics.inc("pifft_plan_cache_misses_total")
+    metrics.set_gauge("pifft_roofline_util", 0.41, n="2^22")
+    metrics.observe("pifft_cell_seconds", 0.3)
+    metrics.observe("pifft_cell_seconds", 7.0)
+    text = export.prometheus_text()
+    lines = text.splitlines()
+    assert '# TYPE pifft_plan_cache_hits_total counter' in lines
+    assert 'pifft_plan_cache_hits_total{level="memory"} 2' in lines
+    assert 'pifft_plan_cache_misses_total 1' in lines
+    assert '# TYPE pifft_roofline_util gauge' in lines
+    assert 'pifft_roofline_util{n="2^22"} 0.41' in lines
+    assert '# TYPE pifft_cell_seconds histogram' in lines
+    # cumulative buckets: the +Inf bucket equals the count
+    assert 'pifft_cell_seconds_bucket{le="+Inf"} 2' in lines
+    assert 'pifft_cell_seconds_bucket{le="0.5"} 1' in lines
+    assert 'pifft_cell_seconds_count 2' in lines
+    assert 'pifft_cell_seconds_sum 7.3' in lines
+    # every non-comment line is "series value"
+    for line in lines:
+        if not line.startswith("#"):
+            series, value = line.rsplit(" ", 1)
+            float(value)
+            assert series
+
+
+def test_summary_rollup(obs_run):
+    with obs.span("cell"):
+        pass
+    metrics.inc("pifft_plan_cache_misses_total")
+    obs.emit("metrics", snapshot=metrics.snapshot())
+    summary = export.summarize(events.snapshot())
+    assert summary["event_count"] == 2
+    assert summary["runs"] == [obs_run]
+    assert summary["kinds"] == {"metrics": 1, "span": 1}
+    assert summary["spans"]["cell"]["count"] == 1
+    assert summary["metrics"]["counters"][
+        "pifft_plan_cache_misses_total"] == 1
+    text = export.format_summary(summary)
+    assert "pifft_plan_cache_misses_total" in text
+
+
+# ------------------------------------------------------ wiring (units)
+
+
+def test_retry_wiring(obs_run):
+    from cs87project_msolano2_tpu import resilience
+
+    state = {"calls": 0}
+
+    def flaky():
+        state["calls"] += 1
+        if state["calls"] == 1:
+            raise ConnectionError("connection reset by peer")
+        return 42
+
+    out = resilience.call_with_retry(
+        flaky, policy=resilience.FAST_POLICY, sleep=lambda s: None,
+        label="obs test")
+    assert out == 42
+    assert metrics.counter_value("pifft_retries_total",
+                                 kind="transient") == 1
+    retry_events = [e for e in events.snapshot()
+                    if e["kind"] == "retry"]
+    assert retry_events and \
+        retry_events[0]["payload"]["label"] == "obs test"
+
+
+def test_demotion_wiring(obs_run):
+    from cs87project_msolano2_tpu import plans, resilience
+
+    plans.cache.clear(memory=True)
+    key = plans.make_key(256, layout="pi")
+    rng = np.random.default_rng(0)
+    xr = rng.standard_normal(256).astype(np.float32)
+    xi = rng.standard_normal(256).astype(np.float32)
+    with resilience.inject("tube", "capacity"):
+        plan = plans.get_plan(key)
+        plan.execute(xr, xi)
+    assert plan.degraded
+    rung = plan.demotions[-1]["to"]
+    assert metrics.counter_value("pifft_demotions_total", to=rung) >= 1
+    demo = [e for e in events.snapshot() if e["kind"] == "demotion"]
+    assert demo and demo[-1]["payload"]["to"] == rung
+    plans.cache.clear(memory=True)  # never leak the degraded plan
+
+
+def test_plan_cache_metrics_wiring(obs_run):
+    from cs87project_msolano2_tpu import plans
+
+    plans.cache.clear(memory=True)
+    key = plans.make_key(128, layout="pi")
+    plans.get_plan(key)   # miss -> static default memoized
+    plans.get_plan(key)   # memory hit
+    assert metrics.counter_value("pifft_plan_cache_misses_total") >= 1
+    assert metrics.counter_value("pifft_plan_cache_hits_total",
+                                 level="memory") >= 1
+    plans.cache.clear(memory=True)
+
+
+def test_harness_sweep_emits_cell_events_and_eta(tmp_path, obs_run,
+                                                 capsys):
+    from harness.run_experiments import sweep
+
+    path = sweep("serial", [64], [1], 2, str(tmp_path), True, 0)
+    assert path.startswith(str(tmp_path))
+    evs = events.snapshot()
+    cells = [e for e in evs if e["kind"] == "sweep_cell"]
+    assert len(cells) == 2
+    for e in cells:
+        assert e["cell"]["n"] == 64 and e["cell"]["p"] == 1
+        assert e["payload"]["total_ms"] > 0
+        assert e["payload"]["dur_s"] >= 0
+    # the final progress event carries the span-duration-derived ETA
+    prog = [e for e in evs if e["kind"] == "sweep_progress"]
+    assert prog
+    last = prog[-1]["payload"]
+    assert last["completed"] == last["todo"] == 2
+    assert last["eta_s"] == 0.0
+    # every cell ran under a sweep_cell span
+    names = [s["name"] for s in events.span_snapshot()]
+    assert names.count("sweep_cell") == 2
+
+
+def test_profiler_shim_still_works(recwarn):
+    import importlib
+    import warnings
+
+    from cs87project_msolano2_tpu.obs import profiler
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("always")
+        import cs87project_msolano2_tpu.utils.tracing as shim
+
+        importlib.reload(shim)
+    assert shim.trace is profiler.trace
+    with shim.trace(None):  # the disabled path is still a pure no-op
+        pass
+
+
+# ------------------------------------- the bench acceptance criterion
+
+
+def test_bench_smoke_events_end_to_end(tmp_path, capsys, monkeypatch):
+    """`bench.py --smoke --events` + `pifft obs export --format chrome`
+    must produce a json.load-able trace with nested funnel/tube spans
+    under the per-cell span, a schema-valid event stream, nonzero
+    plan-cache activity in the final metrics snapshot, and a run-id
+    tag on the bench record."""
+    import bench
+
+    from cs87project_msolano2_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(bench, "SMOKE_N", 1 << 9)
+    monkeypatch.setattr(bench, "SMOKE_LARGE_LOGNS", (10,))
+    epath = str(tmp_path / "events.jsonl")
+    tpath = str(tmp_path / "trace.json")
+    assert bench.main(["--smoke", "--events", epath,
+                       "--trace-out", tpath]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    obs.disable()  # bench leaves the run armed; this test reads the file
+    metrics.reset()
+
+    # the record is tagged with the run id every event shares
+    recs, dropped = events.load_events(epath)
+    assert dropped == 0 and recs
+    assert export.validate_stream(recs) == []
+    assert rec["run"] and all(e["run"] == rec["run"] for e in recs)
+
+    # the CLI chrome export json.load()s and nests funnel/tube under
+    # the per-cell span (ts/dur containment per tid = Perfetto nesting)
+    rc = cli_main(["obs", "export", "--format", "chrome",
+                   "--events", epath, "--out",
+                   str(tmp_path / "export.json")])
+    assert rc == 0
+    with open(tmp_path / "export.json") as fh:
+        doc = json.load(fh)
+    evs = doc["traceEvents"]
+    assert all({"ph", "ts", "dur", "name"} <= set(e) for e in evs)
+    cells = [e for e in evs if e["name"] == "cell"]
+    funnels = [e for e in evs if e["name"] == "funnel"]
+    tubes = [e for e in evs if e["name"] == "tube"]
+    assert cells and funnels and tubes
+    for phase in funnels + tubes:
+        assert phase["args"]["parent"] == "cell"
+        host = next(c for c in cells
+                    if c["tid"] == phase["tid"]
+                    and c["ts"] <= phase["ts"]
+                    and phase["ts"] + phase["dur"]
+                    <= c["ts"] + c["dur"] + 1e-3)
+        assert host["name"] == "cell"
+
+    # --trace-out wrote the same structure in-process
+    with open(tpath) as fh:
+        direct = json.load(fh)
+    assert {e["name"] for e in direct["traceEvents"]} >= \
+        {"cell", "funnel", "tube"}
+
+    # the final metrics snapshot records nonzero plan-cache activity
+    snap = export.last_metrics_snapshot(recs)
+    assert snap is not None
+    activity = sum(v for k, v in snap["counters"].items()
+                   if k.startswith("pifft_plan_cache_"))
+    assert activity > 0
+
+    # and the summary CLI agrees end to end
+    assert cli_main(["obs", "validate", "--events", epath]) == 0
+    assert cli_main(["obs", "summary", "--events", epath]) == 0
+    out = capsys.readouterr().out
+    assert "plan_cache" in out and rec["run"] in out
