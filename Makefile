@@ -7,7 +7,7 @@
 SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
-        bench-chaos replicate \
+        bench-chaos serve-smoke serve-slo replicate \
         run-experiments run-experiments-and-analyze-results analyze \
         analyze-datasets check lint
 
@@ -85,6 +85,22 @@ bench-chaos:
 	  assert r.get('degraded') is True, r; \
 	  assert r['plan'].get('demotions'), r['plan']; \
 	  print('# chaos smoke ok: rc=0, degraded tagged, demotion recorded')"
+
+# the CI serving check (docs/SERVING.md): an in-process dispatcher on
+# CPU is hit with concurrent mixed-shape requests; the command fails
+# unless coalescing happened (k same-shape requests -> strictly fewer
+# kernel invocations, read from the obs counters), every response
+# verifies against numpy, every event is schema-valid, and the
+# per-shape p50/p99 queue-wait + compute table is reportable
+serve-smoke:
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  serve --smoke
+
+# the serving SLO suite (BENCH-round format: offered load, achieved
+# throughput, p50/p99 with the queue-wait vs compute split per cell);
+# smoke-sized here — drop --smoke for the real tier on hardware
+serve-slo:
+	PIFFT_PLAN_CACHE=off python3 bench.py --serve-load --smoke
 
 # project static analysis (check/ subsystem, docs/CHECKS.md): the
 # timing/retrace/Mosaic/plan-key invariants as AST rules, gated on the

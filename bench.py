@@ -90,6 +90,15 @@ LARGE_LOGNS = (22, 24)
 SMOKE_N = 1 << 12
 SMOKE_LARGE_LOGNS = (13,)
 
+# --serve-load: offered loads (requests/s) per served shape, open-loop
+# (serve/loadgen.py); the smoke tier is sized to finish in CI seconds
+SERVE_LOAD_NS = (1 << 16,)
+SERVE_LOAD_RPS = (100.0, 500.0)
+SERVE_LOAD_DURATION_S = 2.0
+SMOKE_SERVE_LOAD_NS = (1 << 10,)
+SMOKE_SERVE_LOAD_RPS = (80.0, 320.0)
+SMOKE_SERVE_LOAD_DURATION_S = 0.25
+
 
 def _retry(fn, *args, smoke: bool = False, label: str = ""):
     """Shared TRANSIENT-retry wrapper (resilience.with_retry policy):
@@ -326,6 +335,72 @@ def measure_c_baseline_ms() -> float:
     return get_backend("cpu").run(x, p, reps=3).total_ms
 
 
+def serve_load_main(args) -> int:
+    """``--serve-load``: the serving SLO suite (docs/SERVING.md).
+
+    Runs the open-loop load generator (serve/loadgen.py) against an
+    in-process dispatcher warmed for the load shapes, one cell per
+    (shape, offered rps), and emits ONE BENCH-round JSON line whose
+    headline is the worst completed p99; the full row set (offered
+    load, achieved throughput, p50/p99 with the queue-wait vs compute
+    split, rejections, degradations) rides in ``serve_load``.  A cell
+    that saturates (backpressure rejections, admission degradation, or
+    injected ``PIFFT_FAULT=serve:*`` chaos) is REPORTED, not fatal:
+    the record tags ``degraded`` and the run exits 0 — the resilience
+    contract."""
+    import asyncio
+
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.serve import (
+        Dispatcher,
+        ServeConfig,
+        ShapeSpec,
+    )
+    from cs87project_msolano2_tpu.serve.loadgen import run_offered_load
+
+    smoke = args.smoke
+    ns = tuple(SMOKE_SERVE_LOAD_NS if smoke else SERVE_LOAD_NS)
+    rps_list = tuple(args.load_rps
+                     or (SMOKE_SERVE_LOAD_RPS if smoke
+                         else SERVE_LOAD_RPS))
+    duration = args.load_duration or (
+        SMOKE_SERVE_LOAD_DURATION_S if smoke else SERVE_LOAD_DURATION_S)
+    cfg = ServeConfig(max_batch=8, max_wait_ms=1.0, queue_depth=32)
+    specs = [ShapeSpec(n=n) for n in ns]
+    rows = []
+
+    async def run_all():
+        async with Dispatcher(cfg, specs) as d:
+            for n in ns:
+                for rps in rps_list:
+                    rows.append(await run_offered_load(
+                        d, n, rps, duration))
+
+    asyncio.run(run_all())
+
+    completed = [r for r in rows if "p99_ms" in r]
+    record = {
+        "metric": "serve_slo_p99_ms",
+        "value": max((r["p99_ms"] for r in completed), default=None),
+        "unit": "ms",
+        "serve_load": rows,
+    }
+    if smoke:
+        record["smoke"] = True
+    if any(r["degraded"] or r["failed"] for r in rows):
+        record["degraded"] = True
+    if obs.enabled():
+        record["run"] = obs.run_id()
+        from cs87project_msolano2_tpu.obs import export, metrics
+
+        obs.emit("metrics", snapshot=metrics.snapshot())
+        obs.flush()
+        if args.trace_out:
+            export.write_chrome_trace(args.trace_out)
+    print(json.dumps(record))
+    return 0
+
+
 def main(argv=None) -> int:
     from cs87project_msolano2_tpu import plans
     from cs87project_msolano2_tpu.utils.roofline import roofline_utilization
@@ -350,6 +425,16 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="write the run's spans as Chrome trace JSON "
                          "(open in Perfetto / chrome://tracing)")
+    ap.add_argument("--serve-load", action="store_true",
+                    help="run the serving SLO suite instead of the "
+                         "kernel bench: open-loop offered load against "
+                         "the serve/ dispatcher, p50/p99 + throughput "
+                         "per (shape, rps) cell (docs/SERVING.md)")
+    ap.add_argument("--load-rps", type=float, nargs="*", default=None,
+                    help="serve-load: offered loads in requests/s "
+                         "(default: the tier's standard ladder)")
+    ap.add_argument("--load-duration", type=float, default=None,
+                    metavar="S", help="serve-load: seconds per cell")
     args = ap.parse_args(argv)
 
     from cs87project_msolano2_tpu import obs
@@ -358,6 +443,9 @@ def main(argv=None) -> int:
         obs.enable(events_path=args.events)
     elif args.trace_out and not obs.enabled():
         obs.enable()
+
+    if args.serve_load:
+        return serve_load_main(args)
 
     n = SMOKE_N if args.smoke else N
     logns = SMOKE_LARGE_LOGNS if args.smoke else LARGE_LOGNS

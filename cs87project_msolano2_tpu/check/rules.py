@@ -446,6 +446,91 @@ class MeasurementClockOutsideSanctionedLayers(Rule):
                     f"# pifft: noqa[PIF106])")
 
 
+@register
+class BlockingCallInAsyncServePath(Rule):
+    id = "PIF107"
+    name = "blocking-call-in-async-serve-path"
+    summary = ("no blocking time.sleep / sync I/O inside serve/ async "
+               "code paths — waiting funnels through the sanctioned "
+               "dispatcher helper")
+    invariant = ("the serve/ event loop multiplexes EVERY caller: one "
+                 "blocking call inside an async path stalls all "
+                 "in-flight requests' queue-wait clocks at once — a "
+                 "p99 cliff no per-request span will localize, because "
+                 "every span regresses together.  Waiting belongs to "
+                 "the sanctioned dispatcher helper "
+                 "(Dispatcher._wait_for_request, built on asyncio) and "
+                 "asyncio.sleep; file/socket I/O belongs to asyncio "
+                 "streams or executor threads (sync startup code "
+                 "outside async defs is untouched)")
+    default_config = {
+        # an INCLUDE list, unlike other rules' exempt globs: the event-
+        # loop discipline is the serving package's, not the project's.
+        # Anchored on a path SEGMENT (matched against the absolute
+        # path, which always has a leading separator): a checkout
+        # under e.g. ~/fft-serve/ must not drag the whole tree in
+        "paths": ("*/serve/*",),
+        "blocking_calls": ("time.sleep", "socket.create_connection",
+                           "subprocess.run", "subprocess.call",
+                           "subprocess.check_call",
+                           "subprocess.check_output", "os.system",
+                           "input"),
+        # raw-socket blocking methods (asyncio stream methods are
+        # awaited coroutines and never collide with these names)
+        "blocking_methods": ("recv", "recv_into", "accept", "sendall"),
+        "open_builtin": True,
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+        import os
+
+        norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+        if not any(fnmatch.fnmatch(norm, pat)
+                   for pat in config["paths"]):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            yield from self._scan_async_body(ctx, fn, config)
+
+    def _scan_async_body(self, ctx, fn, config) -> Iterator:
+        # this async function's OWN statements only: nested defs run
+        # wherever they are CALLED (possibly an executor thread, where
+        # blocking is the point), and nested async defs are scanned as
+        # their own entries by check()
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                label = self._blocking_label(ctx, node, config)
+                if label:
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking `{label}` inside async "
+                        f"`{fn.name}` stalls the whole serving event "
+                        f"loop — use asyncio (sleep/wait_for/streams), "
+                        f"the sanctioned dispatcher wait helper, or an "
+                        f"executor thread (or justify with "
+                        f"# pifft: noqa[PIF107])")
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _blocking_label(self, ctx, call, config) -> Optional[str]:
+        target = ctx.resolve_call(call)
+        if target in config["blocking_calls"]:
+            return target
+        if config["open_builtin"] and isinstance(call.func, ast.Name) \
+                and call.func.id == "open":
+            return "open"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in config["blocking_methods"]:
+            return f".{call.func.attr}()"
+        return None
+
+
 def _is_broad_handler(type_node, broad) -> bool:
     """Shared broad-handler predicate (PIF105 and PIF501)."""
     if type_node is None:

@@ -9,6 +9,8 @@
     python -m cs87project_msolano2_tpu faults {list | inject <spec>}
     python -m cs87project_msolano2_tpu obs {summary | export | validate}
                                          [--events FILE] [--format F]
+    python -m cs87project_msolano2_tpu serve [--smoke | --host H --port P]
+                                         [--shapes FILE] [...]
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
@@ -40,6 +42,13 @@ table (`--json` for machines), `export --format {chrome,prom}`
 converts it to Chrome trace JSON (Perfetto) or the Prometheus textfile
 format, and `validate` schema-checks every event (the CI obs-smoke
 gate).
+
+The `serve` subcommand fronts the serving subsystem (docs/SERVING.md):
+an asyncio dispatcher that coalesces concurrent requests into padded
+batched kernel invocations over bounded backpressured queues, warmed
+from a served shape set (`--shapes`, the same JSONL `plan warm
+--shapes` takes) — a socket front by default, `--smoke` for the
+in-process CI gate (`make serve-smoke`).
 """
 
 from __future__ import annotations
@@ -99,6 +108,11 @@ def plan_main(argv) -> int:
                     "(tune once, serve forever)",
     )
     ap.add_argument("action", choices=("show", "warm", "clear", "sweep"))
+    ap.add_argument("--shapes", default=None, metavar="FILE",
+                    help="warm: a served shape set (JSONL of {n, batch, "
+                         "precision, layout}) to warm in ONE call — the "
+                         "file `pifft serve --shapes` takes "
+                         "(docs/SERVING.md)")
     ap.add_argument("-n", type=_parse_n, default=1 << 20,
                     help="transform length for warm (int or 2^k)")
     ap.add_argument("--ns", type=_parse_n, nargs="*",
@@ -163,6 +177,24 @@ def plan_main(argv) -> int:
         return 0
 
     # warm
+    if args.shapes:
+        # the whole served shape set in one call (serve startup runs
+        # the same function): tune where the hardware answers, static
+        # default otherwise — a CPU warm never dies for lack of a tuner
+        from .serve import shapes as serve_shapes
+
+        try:
+            specs = serve_shapes.load_shapes(args.shapes)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        warmed = serve_shapes.warm(specs, force=args.force, verbose=True)
+        for spec, p in zip(specs, warmed):
+            ms = f" ({p.ms:.4f} ms)" if p.ms is not None else ""
+            print(f"warmed {spec.label()}: {p.variant} {p.params} "
+                  f"[{p.source}]{ms}")
+        print(f"warmed {len(warmed)} shape(s) from {args.shapes}")
+        return 0
     key = plans.make_key(args.n, tuple(args.batch), layout=args.layout,
                          precision=args.precision)
     try:
@@ -357,6 +389,10 @@ def main(argv=None) -> int:
         return faults_main(argv[1:])
     if argv and argv[0] == "obs":
         return obs_main(argv[1:])
+    if argv and argv[0] == "serve":
+        from .serve.cli import serve_main
+
+        return serve_main(argv[1:])
     if argv and argv[0] == "check":
         from .check.cli import main as check_main
 

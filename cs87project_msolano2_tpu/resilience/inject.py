@@ -49,6 +49,10 @@ KNOWN_SITES = {
                   "(parallel/multihost.py rendezvous discipline)",
     "bench": "bench.py measurement loops",
     "harness": "harness/run_experiments.py sweep cells",
+    "serve": "serve/batcher.py tuned-kernel batch invocation (the "
+             "serving path's fallback rungs stay clean, so chaos "
+             "degrades the service instead of killing it — "
+             "docs/SERVING.md)",
 }
 
 KINDS = ("transient", "capacity", "permanent", "timeout")

@@ -1,0 +1,55 @@
+"""Serving subsystem: async batched FFT-as-a-service
+(docs/SERVING.md).
+
+The ROADMAP's north star is a system serving heavy concurrent traffic,
+and every subsystem for that exists below this package — plans give
+warm tuned kernels, the batched executor gives a collective-free
+many-transforms-one-kernel path, resilience gives degradation, obs
+gives per-request accounting.  This package is the front door that
+turns CONCURRENT REQUESTS into BATCHED KERNEL INVOCATIONS:
+
+* ``dispatcher`` — the asyncio front: bounded per-group queues with
+                   structured backpressure (:class:`QueueFull` +
+                   ``retry_after_ms``), one coalescing worker per
+                   group, admission-time graceful degradation
+                   (window collapse, then cheap-rung mode), per-request
+                   queue-wait/compute accounting.
+* ``batcher``    — requests -> one padded ``(B_pad, n)`` kernel
+                   invocation via ``plans.plan_for`` (power-of-two
+                   batch buckets so compiled programs are few), with
+                   the serve half of the resilience ladder (transient
+                   retry in place, capacity/permanent -> fallback
+                   rungs, all tagged).
+* ``buffers``    — pooled host staging planes (+ device-side donation
+                   on real hardware).
+* ``shapes``     — the served shape set (JSONL) and the warm startup
+                   path shared with ``pifft plan warm --shapes``.
+* ``slo``        — per-shape p50/p99 with the queue-wait vs compute
+                   split.
+* ``loadgen``    — open-loop offered-load driver behind
+                   ``bench.py --serve-load``.
+* ``protocol``   — the length-prefixed JSON socket front behind
+                   ``pifft serve``.
+
+Check rule PIF107 (docs/CHECKS.md) polices this package: no blocking
+``time.sleep``/sync I/O inside its async paths — all waiting funnels
+through the sanctioned dispatcher helper.
+"""
+
+from __future__ import annotations
+
+from .batcher import BatchRunner, GroupKey, batch_bucket  # noqa: F401
+from .buffers import BufferPool  # noqa: F401
+from .dispatcher import (  # noqa: F401
+    Dispatcher,
+    DispatcherClosed,
+    QueueFull,
+    Request,
+    RequestFailed,
+    Response,
+    ServeConfig,
+    ServeError,
+    ShapeNotServed,
+)
+from .shapes import ShapeSpec, load_shapes, warm  # noqa: F401
+from .slo import LatencyStats, format_summary, percentile  # noqa: F401

@@ -1,0 +1,244 @@
+"""The coalescing batcher: many requests in, ONE padded kernel
+invocation out.
+
+Requests grouped under one :class:`GroupKey` (same n, layout,
+precision, direction) are staged into a pooled ``(B_pad, n)`` plane
+pair — ``B_pad`` rounded up to the next power of two so the whole
+serving session compiles a handful of batch buckets instead of one
+program per observed batch size (a fresh trace per size is the retrace
+bug PIF2xx exists for, at serving rates) — and run through the plan
+resolved for the PADDED batched shape via ``plans.plan_for``, exactly
+the per-shard-shape discipline ``parallel/batched.py`` uses.
+
+Execution is synchronous (the dispatcher calls it from an executor
+thread so the event loop keeps admitting requests mid-kernel) and
+carries the serving half of the resilience ladder:
+
+* TRANSIENT faults retry in place (``resilience.call_with_retry``,
+  fast policy — a serving session cannot sleep 30 s on a blip);
+* CAPACITY / PERMANENT faults fall to the degradation rungs
+  (``jnp-fft``, then the numpy reference) for THIS batch, tagged in
+  every response it carried;
+* an explicit ``rung=`` (the dispatcher's overload mode) skips the
+  tuned kernel entirely and serves the cheap rung directly.
+
+Inside the tuned path the plan's own executor is already wrapped in
+the plan degradation chain (``resilience.degrade``), so kernel faults
+demote stickily there too — ``plan.degraded`` is mirrored into the
+batch outcome either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from .. import plans
+from ..obs import metrics
+from ..obs.spans import clock, span
+from ..resilience import FAST_POLICY, FaultKind, call_with_retry, classify
+from ..resilience.degrade import build_rung
+from ..resilience.inject import maybe_fault
+from .buffers import BufferPool
+
+#: serve-side fallback rungs, weakest-demand last (the batched subset of
+#: resilience.degrade.DEGRADE_CHAIN — rql is a 1-D whole-transform path
+#: and cannot serve a batched key)
+SERVE_FALLBACK_RUNGS = ("jnp-fft", "numpy-ref")
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupKey:
+    """The coalescing identity: requests may share a kernel invocation
+    iff they share all four fields."""
+
+    n: int
+    layout: str = "natural"
+    precision: str = "split3"
+    inverse: bool = False
+
+    def label(self) -> str:
+        d = ":inv" if self.inverse else ""
+        return f"{self.n}:{self.layout}:{self.precision}{d}"
+
+
+def batch_bucket(size: int) -> int:
+    """The padded batch dim: the next power of two >= size, so the
+    session's compiled programs are one per bucket, not one per
+    observed batch size."""
+    b = 1
+    while b < size:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class BatchOutcome:
+    """One kernel invocation's results, still batch-shaped: the
+    dispatcher slices per-request rows out and builds responses."""
+
+    yr: np.ndarray
+    yi: np.ndarray
+    compute_s: float
+    size: int
+    bucket: int
+    plan_variant: str
+    degraded: bool = False
+    degrade: list = dataclasses.field(default_factory=list)
+
+
+class BatchRunner:
+    """Stages, pads, and executes one group's batches; caches the
+    jitted callable per (group, bucket, rung) so every batch after the
+    first reuses the compiled program."""
+
+    def __init__(self, pool: Optional[BufferPool] = None):
+        self.pool = pool or BufferPool()
+        self._callables: dict = {}
+
+    # ---------------------------------------------------- callables
+
+    def _plan_for(self, group: GroupKey, bucket: int):
+        return plans.plan_for((bucket, group.n), layout=group.layout,
+                              precision=group.precision)
+
+    def _callable(self, group: GroupKey, bucket: int,
+                  rung: Optional[str]):
+        """(callable, plan) for the group at this bucket — the tuned
+        plan executor, or a degradation rung built for the batched
+        key.  Direction is applied OUTSIDE the forward/rung choice: an
+        inverse group stays an inverse on every rung (a fallback that
+        quietly served the forward transform would be a wrong answer
+        tagged merely degraded)."""
+        import jax
+
+        ck = (group, bucket, rung)
+        hit = self._callables.get(ck)
+        if hit is not None:
+            return hit
+        plan = self._plan_for(group, bucket)
+        forward = build_rung(plan.key, rung) if rung is not None \
+            else plan.fn
+        if group.inverse:
+            inv_n = np.float32(group.n)
+            fwd = forward
+
+            def run(xr, xi):  # the conj trick (plans.core contract)
+                yr, yi = fwd(xr, -xi)
+                return yr / inv_n, -yi / inv_n
+        else:
+            run = forward
+        # donation lets XLA reuse the staged planes' device buffers for
+        # the outputs — meaningful on real devices, a warning on
+        # interpret backends, so gate it
+        donate = (0, 1) if plans.device_is_tunable() else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._callables[ck] = (fn, plan)
+        return fn, plan
+
+    # ----------------------------------------------------- staging
+
+    def _stage(self, group: GroupKey, planes, bucket: int):
+        xr = self.pool.acquire((bucket, group.n))
+        xi = self.pool.acquire((bucket, group.n))
+        for i, (pr, pi) in enumerate(planes):
+            xr[i], xi[i] = pr, pi
+        if len(planes) < bucket:  # padding rows must be defined
+            xr[len(planes):] = 0.0
+            xi[len(planes):] = 0.0
+        return xr, xi
+
+    # --------------------------------------------------- execution
+
+    def run(self, group: GroupKey, planes,
+            rung: Optional[str] = None) -> BatchOutcome:
+        """Execute one coalesced batch (list of (xr, xi) float planes of
+        shape (n,)).  `rung` forces a degradation rung up front (the
+        dispatcher's overload fallback); otherwise the tuned plan runs
+        and only a CAPACITY/PERMANENT fault walks the serve fallback
+        rungs.  Raises only for faults no rung could absorb."""
+        size = len(planes)
+        bucket = batch_bucket(size)
+        sxr, sxi = self._stage(group, planes, bucket)
+        degrade: list = []
+        if rung is not None:
+            degrade.append(f"overload:{rung}")
+        try:
+            with span("serve_batch", cell={"n": group.n, "size": size},
+                      bucket=bucket, rung=rung or "plan") as sp:
+                outcome = self._invoke(group, bucket, rung, sxr, sxi,
+                                       degrade)
+                sp.set(variant=outcome.plan_variant,
+                       degraded=outcome.degraded)
+        finally:
+            self.pool.release(sxr, sxi)
+        outcome.size = size
+        metrics.inc("pifft_serve_batches_total", shape=group.label())
+        metrics.inc("pifft_serve_batched_requests_total", value=size,
+                    shape=group.label())
+        metrics.observe("pifft_serve_batch_size", size,
+                        shape=group.label())
+        return outcome
+
+    def _invoke(self, group, bucket, rung, sxr, sxi,
+                degrade) -> BatchOutcome:
+        def attempt(use_rung):
+            if use_rung is None:
+                # injection site: the TUNED serving path only — the
+                # fallback rungs stay clean, mirroring the tube site's
+                # semantics, so an always-on chaos spec degrades the
+                # service instead of killing it
+                maybe_fault("serve")
+            fn, plan = self._callable(group, bucket, use_rung)
+            t0 = clock()
+            yr, yi = fn(sxr, sxi)
+            yr = np.asarray(yr)
+            yi = np.asarray(yi)
+            return BatchOutcome(
+                yr=yr, yi=yi, compute_s=clock() - t0, size=bucket,
+                bucket=bucket,
+                plan_variant=use_rung or plan.variant,
+                degraded=plan.degraded,
+                degrade=degrade + (
+                    [f"plan:{rec['to']}" for rec in plan.demotions]
+                    if plan.degraded else []))
+
+        try:
+            # TRANSIENT faults retry in place on the fast policy — a
+            # serving path cannot afford the bench's relay-scale waits
+            return call_with_retry(attempt, rung, policy=FAST_POLICY,
+                                   label=f"serve {group.label()}")
+        except Exception as e:
+            kind = classify(e)
+            if kind is FaultKind.TRANSIENT:
+                raise  # the retry budget is spent; nothing left to try
+            exc = e
+            start = (SERVE_FALLBACK_RUNGS.index(rung) + 1
+                     if rung in SERVE_FALLBACK_RUNGS else 0)
+            for fb in SERVE_FALLBACK_RUNGS[start:]:
+                try:
+                    out = call_with_retry(attempt, fb, policy=FAST_POLICY,
+                                          label=f"serve fallback {fb}")
+                except Exception as e2:
+                    if classify(e2) is FaultKind.TRANSIENT:
+                        raise
+                    exc = e2
+                    continue
+                tag = f"fault:{kind.value}:{fb}"
+                out.degraded = True
+                out.degrade = degrade + [tag]
+                from ..obs import events
+                from ..plans.core import warn
+
+                metrics.inc("pifft_serve_fallbacks_total", rung=fb)
+                events.emit("serve_degrade",
+                            cell={"n": group.n, "variant": fb},
+                            level=tag, shape=group.label(),
+                            reason=f"{type(e).__name__}: {str(e)[:200]}")
+                warn(f"serve batch {group.label()} DEGRADED to {fb} "
+                     f"({kind.value}: {type(e).__name__}) — results stay "
+                     f"correct; performance does not")
+                return out
+            raise exc

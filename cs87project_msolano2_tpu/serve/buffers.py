@@ -1,0 +1,81 @@
+"""Staging-buffer pool: reuse the padded batch planes across requests.
+
+Every coalesced batch stages its requests' float planes into one
+``(B_pad, n)`` pair of arrays before the kernel invocation.  Allocating
+those per batch at serving rates is pure allocator churn — the arrays
+are the same handful of shapes forever (the padded batch buckets of the
+served shape set) — so this pool keeps released buffers on a per-shape
+free list and hands them back on the next acquire.
+
+The device side of reuse is input donation: the plan executors are
+jitted with ``donate_argnums`` via :meth:`plans.core.Plan.executable`,
+so XLA may reuse the request planes' device buffers for the outputs.
+This pool is the HOST side: the staging arrays a request is copied
+into never hit the allocator twice.
+
+Thread-safe (the dispatcher's executor thread releases while the event
+loop acquires).  Reuse is observable: ``pifft_serve_buffer_reuse_total``
+vs ``pifft_serve_buffer_alloc_total`` counters, and :meth:`stats` for
+in-process assertions.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class BufferPool:
+    """Per-(shape, dtype) free lists of staging arrays.
+
+    ``max_per_key`` bounds each free list so a burst of odd shapes
+    cannot pin memory forever; overflow releases are simply dropped to
+    the allocator.
+    """
+
+    def __init__(self, max_per_key: int = 8):
+        self.max_per_key = max_per_key
+        self._free: dict = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, shape, dtype=np.float32) -> np.ndarray:
+        """A writable array of `shape` — pooled when one is free, fresh
+        otherwise.  Contents are UNDEFINED: the batcher overwrites every
+        row it uses and zeroes the padding rows explicitly."""
+        from ..obs import metrics
+
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                self.hits += 1
+                buf = free.pop()
+            else:
+                self.misses += 1
+                buf = None
+        if buf is not None:
+            metrics.inc("pifft_serve_buffer_reuse_total")
+            return buf
+        metrics.inc("pifft_serve_buffer_alloc_total")
+        return np.empty(shape, dtype)
+
+    def release(self, *arrays) -> None:
+        """Return staging arrays to their free lists (drop when the
+        list is full)."""
+        with self._lock:
+            for arr in arrays:
+                if arr is None:
+                    continue
+                key = (tuple(arr.shape), arr.dtype.str)
+                free = self._free.setdefault(key, [])
+                if len(free) < self.max_per_key:
+                    free.append(arr)
+
+    def stats(self) -> dict:
+        with self._lock:
+            pooled = sum(len(v) for v in self._free.values())
+            return {"hits": self.hits, "misses": self.misses,
+                    "pooled": pooled}

@@ -1,0 +1,209 @@
+"""`pifft serve` — run the serving front door, or its offline smoke.
+
+Server mode binds the length-prefixed JSON socket front
+(:mod:`.protocol`) on ``--host``/``--port``, warms ``--shapes`` at
+startup, and serves until interrupted.
+
+``--smoke`` is the CI gate (``make serve-smoke``): an in-process
+dispatcher on this host's backend (CPU in CI) is hit with k concurrent
+same-shape requests plus mixed-shape traffic, and the run FAILS unless
+
+* coalescing happened: the k same-shape requests were served by
+  strictly fewer kernel invocations than k, read from the
+  ``pifft_serve_*`` obs counters (the counters, not a side channel —
+  so the observability wiring is re-proven too);
+* every response verifies against ``numpy.fft`` (a batched, padded,
+  coalesced path that returns the wrong rows would otherwise pass);
+* every emitted event validates against the obs schema;
+* the per-shape SLO table (p50/p99 queue-wait and compute) is
+  reportable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import numpy as np
+
+from .batcher import GroupKey
+from .dispatcher import Dispatcher, ServeConfig
+from .shapes import ShapeSpec, load_shapes
+from .slo import format_summary
+
+#: the smoke's served set: one coalescing-burst shape + mixed traffic
+#: (a second n and a pi-layout shape, so grouping is exercised)
+SMOKE_SPECS = (ShapeSpec(n=4096), ShapeSpec(n=1024),
+               ShapeSpec(n=2048, layout="pi"))
+
+
+def _build_config(args) -> ServeConfig:
+    cfg = ServeConfig()
+    if args.max_batch is not None:
+        cfg.max_batch = args.max_batch
+    if args.max_wait_ms is not None:
+        cfg.max_wait_ms = args.max_wait_ms
+    if args.queue_depth is not None:
+        cfg.queue_depth = args.queue_depth
+    cfg.strict_shapes = bool(args.strict)
+    return cfg
+
+
+def serve_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu serve",
+        description="async batched FFT-as-a-service: bounded queues, "
+                    "request coalescing, warm plans, graceful "
+                    "degradation (docs/SERVING.md)",
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process CI smoke: concurrent mixed-shape "
+                         "requests, coalescing + schema assertions, "
+                         "per-shape p50/p99 report")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8571)
+    ap.add_argument("--shapes", default=None, metavar="FILE",
+                    help="served shape set (JSONL of {n, batch, "
+                         "precision, layout}); warmed at startup")
+    ap.add_argument("--strict", action="store_true",
+                    help="reject shapes outside the warmed set "
+                         "(shape_not_served) instead of serving cold")
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--queue-depth", type=int, default=None)
+    ap.add_argument("-k", type=int, default=12,
+                    help="smoke: concurrent same-shape requests "
+                         "(default 12)")
+    ap.add_argument("--json", action="store_true",
+                    help="smoke: machine-readable report")
+    args = ap.parse_args(argv)
+
+    cfg = _build_config(args)
+    if args.shapes:
+        try:
+            specs = load_shapes(args.shapes)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    else:
+        specs = list(SMOKE_SPECS) if args.smoke else []
+
+    if args.smoke:
+        return _smoke(cfg, specs, args)
+
+    from .protocol import serve_socket
+
+    dispatcher = Dispatcher(cfg, specs)
+
+    async def main():
+        async with dispatcher:
+            await serve_socket(dispatcher, args.host, args.port)
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        print("# serve: interrupted, shutting down", file=sys.stderr)
+    return 0
+
+
+def _smoke(cfg: ServeConfig, specs, args) -> int:
+    from .. import obs
+    from ..obs import events as obs_events
+    from ..obs import metrics
+    from ..utils import verify
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+
+    # a generous window + the burst being enqueued before the worker
+    # first runs makes coalescing deterministic on any host
+    if args.max_wait_ms is None:
+        cfg.max_wait_ms = 25.0
+    k = max(2, args.k)
+    burst = specs[0]
+    rng = np.random.default_rng(0)
+    inputs = [(rng.standard_normal(burst.n).astype(np.float32),
+               rng.standard_normal(burst.n).astype(np.float32))
+              for _ in range(k)]
+    mixed = [(s, rng.standard_normal(s.n).astype(np.float32),
+              rng.standard_normal(s.n).astype(np.float32))
+             for s in specs[1:] for _ in range(2)]
+
+    async def main():
+        async with Dispatcher(cfg, specs) as d:
+            calls = [d.submit(xr, xi, layout=burst.layout,
+                              precision=burst.precision)
+                     for xr, xi in inputs]
+            calls += [d.submit(xr, xi, layout=s.layout,
+                               precision=s.precision)
+                      for s, xr, xi in mixed]
+            responses = await asyncio.gather(*calls)
+            return d, responses
+
+    d, responses = asyncio.run(main())
+
+    problems = []
+    # every natural-layout response must verify against numpy: a padded
+    # coalesced batch that hands back the wrong rows is the one bug a
+    # latency report would never catch
+    for (xr, xi), resp in zip(inputs, responses[:k]):
+        if burst.layout != "natural":
+            break
+        ref = np.fft.fft(xr.astype(np.complex128)
+                         + 1j * xi.astype(np.complex128))
+        err = verify.rel_err(np.asarray(resp.yr)
+                             + 1j * np.asarray(resp.yi), ref)
+        if err > 1e-4:
+            problems.append(f"response {resp.rid} wrong: rel err "
+                            f"{err:.3e} vs numpy fft")
+            break
+
+    label = GroupKey(n=burst.n, layout=burst.layout,
+                     precision=burst.precision).label()
+    reqs = int(metrics.counter_value("pifft_serve_requests_total",
+                                     shape=label))
+    batches = int(metrics.counter_value("pifft_serve_batches_total",
+                                        shape=label))
+    if not (0 < batches < k):
+        problems.append(
+            f"no coalescing: {reqs} concurrent {label} requests were "
+            f"served by {batches} kernel invocation(s) (want 0 < "
+            f"invocations < {k})")
+
+    bad_events = 0
+    snapshot = obs_events.snapshot()
+    for rec in snapshot:
+        for p in obs_events.validate_event(rec):
+            bad_events += 1
+            problems.append(f"event seq={rec.get('seq')}: {p}")
+
+    summary = d.stats.summary()
+    if owned:
+        obs.disable()
+
+    if args.json:
+        print(json.dumps({
+            "ok": not problems,
+            "same_shape_requests": k,
+            "same_shape_batches": batches,
+            "events": len(snapshot),
+            "schema_invalid_events": bad_events,
+            "stats": summary,
+            "buffers": d.runner.pool.stats(),
+            "problems": problems,
+        }, indent=1, sort_keys=True))
+    else:
+        print(format_summary(summary))
+        print(f"# serve smoke: {k} concurrent {label} requests -> "
+              f"{batches} kernel invocation(s); "
+              f"{len(snapshot)} event(s), {bad_events} schema-invalid; "
+              f"buffers {d.runner.pool.stats()}")
+        for p in problems:
+            print(f"# FAIL: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("# serve smoke ok", file=sys.stderr)
+    return 0

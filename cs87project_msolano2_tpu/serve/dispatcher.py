@@ -1,0 +1,400 @@
+"""The asyncio dispatcher: the serving front door.
+
+Concurrent callers ``await dispatcher.submit(xr, xi)``; the dispatcher
+groups compatible requests (same :class:`~.batcher.GroupKey`) into
+bounded per-group queues, and one worker task per group drains them
+into coalesced, padded kernel invocations through
+:class:`~.batcher.BatchRunner`.  The contract, in order of what a
+production front door owes its callers:
+
+* **Backpressure, never unbounded queues.**  Each group's queue is
+  bounded (``queue_depth``); an admission past the bound raises
+  :class:`QueueFull` — a structured error carrying ``retry_after_ms``
+  (an EMA of this group's per-request service time times the depth
+  ahead) — immediately.  A saturated server answers "try later",
+  it never silently grows a queue or hangs a caller.
+
+* **Coalescing window.**  A worker that finds its queue non-empty
+  drains up to ``max_batch`` requests with no wait at all; otherwise
+  it holds the batch open for ``max_wait_ms`` (the classic
+  latency-for-throughput window).  All serve-side waiting funnels
+  through ONE sanctioned helper (:meth:`Dispatcher._wait_for_request`,
+  built on ``asyncio.wait_for``) — check rule PIF107 bans blocking
+  ``time.sleep``/sync I/O anywhere in serve/ async paths.
+
+* **Admission-time graceful degradation.**  Queue fill decides the
+  mode: past ``pressure_watermark`` the batching window collapses to
+  zero (ship what's here — ``pressure:window``); past
+  ``overload_watermark`` the batch skips the tuned kernel for the
+  cheap ``jnp-fft`` rung (``overload:jnp-fft``).  Every demotion —
+  these, and the fault-driven rungs inside the runner — is tagged on
+  each affected response (``degraded: true`` + the ``degrade`` trail)
+  and mirrored into the event stream, the resilience subsystem's
+  never-silent rule (docs/RESILIENCE.md).
+
+* **Per-request observability.**  Every response carries its
+  queue-wait vs compute split; the same numbers land in
+  ``pifft_serve_*`` metrics, ``serve_request`` events, and the
+  per-shape :class:`~.slo.LatencyStats` the SLO reports roll up
+  (docs/SERVING.md).
+
+Compute runs in a thread-pool executor so the event loop keeps
+admitting (and rejecting) requests mid-kernel — which is what makes
+backpressure testable and the p99 honest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import functools
+import itertools
+from typing import Optional
+
+import numpy as np
+
+from ..obs import events, metrics
+from ..obs.spans import clock
+from ..resilience import classify
+from . import shapes as shapes_mod
+from .batcher import BatchRunner, GroupKey
+from .buffers import BufferPool
+from .slo import LatencyStats
+
+#: worker-queue shutdown sentinel
+_CLOSE = object()
+
+
+class ServeError(Exception):
+    """Base of the structured serving errors: everything a caller (or
+    the wire protocol) needs rides :meth:`to_record`, never a bare
+    message to parse."""
+
+    code = "serve_error"
+
+    def extras(self) -> dict:
+        return {}
+
+    def to_record(self) -> dict:
+        return {"type": self.code, "message": str(self), **self.extras()}
+
+
+class QueueFull(ServeError):
+    """Admission rejected: the group's queue is at depth.  Structured
+    backpressure — carries when to come back, never hangs."""
+
+    code = "queue_full"
+
+    def __init__(self, msg: str, retry_after_ms: float):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+    def extras(self) -> dict:
+        return {"retry_after_ms": self.retry_after_ms}
+
+
+class ShapeNotServed(ServeError):
+    """Strict-shape mode: the request's shape is not in the warmed
+    set."""
+
+    code = "shape_not_served"
+
+
+class DispatcherClosed(ServeError):
+    code = "dispatcher_closed"
+
+
+class RequestFailed(ServeError):
+    """The batch died of a fault no fallback rung could absorb; the
+    classification rides along so the caller's retry policy can
+    decide."""
+
+    code = "request_failed"
+
+    def __init__(self, msg: str, kind: str):
+        super().__init__(msg)
+        self.kind = kind
+
+    def extras(self) -> dict:
+        return {"kind": self.kind}
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Dispatcher knobs (docs/SERVING.md discusses the trade-offs)."""
+
+    max_batch: int = 8           # most requests one invocation carries
+    max_wait_ms: float = 2.0     # batching window under normal load
+    queue_depth: int = 64        # per-group bound; beyond it: QueueFull
+    pressure_watermark: float = 0.5   # fill fraction: window -> 0
+    overload_watermark: float = 0.875  # fill fraction: cheap-rung mode
+    strict_shapes: bool = False  # only serve the warmed shape set
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    group: GroupKey
+    xr: np.ndarray
+    xi: np.ndarray
+    t_submit: float
+    future: asyncio.Future
+
+
+@dataclasses.dataclass
+class Response:
+    """One served transform, with its latency split and degradation
+    trail."""
+
+    rid: int
+    yr: np.ndarray
+    yi: np.ndarray
+    queue_wait_ms: float
+    compute_ms: float
+    batch_size: int
+    plan_variant: str
+    degraded: bool = False
+    degrade: list = dataclasses.field(default_factory=list)
+
+    def to_record(self, arrays: bool = False) -> dict:
+        rec = {
+            "id": self.rid, "ok": True,
+            "queue_wait_ms": round(self.queue_wait_ms, 4),
+            "compute_ms": round(self.compute_ms, 4),
+            "batch_size": self.batch_size,
+            "plan_variant": self.plan_variant,
+            "degraded": self.degraded,
+        }
+        if self.degrade:
+            rec["degrade"] = list(self.degrade)
+        if arrays:
+            rec["yr"] = np.asarray(self.yr, np.float64).tolist()
+            rec["yi"] = np.asarray(self.yi, np.float64).tolist()
+        return rec
+
+
+class Dispatcher:
+    """See the module docstring; use as an async context manager:
+
+        async with Dispatcher(config, specs) as d:
+            resp = await d.submit(xr, xi)
+    """
+
+    def __init__(self, config: Optional[ServeConfig] = None,
+                 shape_specs=None):
+        self.config = config or ServeConfig()
+        self.specs = list(shape_specs or [])
+        self.runner = BatchRunner(BufferPool())
+        self.stats = LatencyStats()
+        self._queues: dict = {}
+        self._workers: dict = {}
+        self._ema_ms: dict = {}
+        self._rid = itertools.count()
+        self._closing = False
+        self._served = {(s.n, s.layout, s.precision) for s in self.specs}
+
+    # ----------------------------------------------------- lifecycle
+
+    def warm(self, force: bool = False) -> list:
+        """Resolve + memoize the plan for every served shape (the
+        ``pifft plan warm --shapes`` path) — a warm dispatcher reaches
+        its first response on a cache hit."""
+        return shapes_mod.warm(self.specs, force=force)
+
+    async def __aenter__(self):
+        if self.specs:
+            # warming may tune (minutes on real hardware): keep the
+            # event loop free while it runs
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.warm)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+        return False
+
+    async def close(self) -> None:
+        """Stop accepting, drain every queue, join the workers.
+        Requests admitted before close are served; later submits raise
+        :class:`DispatcherClosed`."""
+        self._closing = True
+        for q in self._queues.values():
+            q.put_nowait(_CLOSE)
+        if self._workers:
+            await asyncio.gather(*self._workers.values(),
+                                 return_exceptions=True)
+
+    # ----------------------------------------------------- admission
+
+    async def submit(self, xr, xi, layout: str = "natural",
+                     precision: Optional[str] = None,
+                     inverse: bool = False) -> Response:
+        """Serve one n-point transform of float planes ``(n,)``.
+        Raises a :class:`ServeError` subclass — never hangs — when the
+        request cannot be admitted or no rung could serve it."""
+        if self._closing:
+            raise DispatcherClosed("dispatcher is shut down")
+        xr = np.asarray(xr, np.float32)
+        xi = np.asarray(xi, np.float32)
+        if xr.ndim != 1 or xr.shape != xi.shape:
+            raise ServeError(f"request planes must be matching 1-D "
+                             f"arrays, got {xr.shape} / {xi.shape}")
+        n = xr.shape[0]
+        if n < 2 or n & (n - 1):
+            raise ServeError(f"n={n} is not a power of two >= 2")
+        if inverse and layout != "natural":
+            raise ServeError("inverse requires natural layout (the "
+                             "conj-trick contract, plans.core)")
+        group = GroupKey(n=n, layout=layout,
+                         precision=precision or "split3", inverse=inverse)
+        if self.config.strict_shapes and \
+                (n, layout, group.precision) not in self._served:
+            raise ShapeNotServed(
+                f"shape {group.label()} is not in the warmed set "
+                f"({len(self.specs)} shape(s)); add it to the shape "
+                f"file or serve without strict_shapes")
+        q = self._ensure_worker(group)
+        if q.qsize() >= self.config.queue_depth:
+            label = group.label()
+            self.stats.record_rejected(label)
+            metrics.inc("pifft_serve_rejected_total", shape=label)
+            retry_ms = self._retry_after_ms(group, q)
+            events.emit("serve_reject", cell={"n": n}, shape=label,
+                        depth=q.qsize(), retry_after_ms=retry_ms)
+            raise QueueFull(
+                f"queue for {label} is at depth "
+                f"{self.config.queue_depth}; retry in ~{retry_ms} ms",
+                retry_after_ms=retry_ms)
+        req = Request(rid=next(self._rid), group=group, xr=xr, xi=xi,
+                      t_submit=clock(),
+                      future=asyncio.get_running_loop().create_future())
+        metrics.inc("pifft_serve_requests_total", shape=group.label())
+        q.put_nowait(req)
+        return await req.future
+
+    def _ensure_worker(self, group: GroupKey) -> asyncio.Queue:
+        q = self._queues.get(group)
+        if q is None:
+            # unbounded Queue; the depth bound is enforced at admission
+            # so rejection is synchronous (and the shutdown sentinel
+            # can always be delivered)
+            q = self._queues[group] = asyncio.Queue()
+            self._workers[group] = asyncio.get_running_loop() \
+                .create_task(self._worker(group, q))
+        return q
+
+    def _retry_after_ms(self, group: GroupKey, q) -> float:
+        ema = self._ema_ms.get(group, self.config.max_wait_ms)
+        return round(max(1.0, ema * (q.qsize() + 1)), 3)
+
+    def _admission(self, group: GroupKey, q) -> tuple:
+        """(window_s, forced_rung, level_tag) for the batch about to be
+        drained — the admission-time degradation ladder."""
+        fill = q.qsize() / self.config.queue_depth
+        if fill >= self.config.overload_watermark:
+            return 0.0, "jnp-fft", "overload:jnp-fft"
+        if fill >= self.config.pressure_watermark:
+            return 0.0, None, "pressure:window"
+        return self.config.max_wait_ms / 1e3, None, None
+
+    # ------------------------------------------------------- workers
+
+    async def _wait_for_request(self, q, timeout_s: float):
+        """THE sanctioned serve-side wait (check rule PIF107): every
+        hold in serve/ async code funnels through this one
+        asyncio.wait_for — never ``time.sleep``, never sync I/O —
+        returning None when the window closes empty."""
+        try:
+            return await asyncio.wait_for(q.get(), timeout=timeout_s)
+        except asyncio.TimeoutError:
+            return None
+
+    async def _worker(self, group: GroupKey, q) -> None:
+        closing = False
+        while not closing:
+            req = await q.get()
+            if req is _CLOSE:
+                break
+            batch = [req]
+            window_s, rung, level = self._admission(group, q)
+            deadline = clock() + window_s
+            while len(batch) < self.config.max_batch:
+                try:
+                    nxt = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    remaining = deadline - clock()
+                    if remaining <= 0:
+                        break
+                    nxt = await self._wait_for_request(q, remaining)
+                    if nxt is None:
+                        break
+                if nxt is _CLOSE:
+                    closing = True
+                    break
+                batch.append(nxt)
+            if level is not None:
+                metrics.inc("pifft_serve_admission_degrade_total",
+                            level=level)
+                events.emit("serve_degrade", cell={"n": group.n},
+                            shape=group.label(), level=level,
+                            depth=q.qsize())
+            await self._run_batch(group, batch, rung, level)
+
+    async def _run_batch(self, group: GroupKey, batch, rung, level):
+        label = group.label()
+        t_start = clock()
+        try:
+            outcome = await asyncio.get_running_loop().run_in_executor(
+                None,
+                functools.partial(self.runner.run, group,
+                                  [(r.xr, r.xi) for r in batch], rung))
+        except Exception as e:
+            kind = classify(e).value
+            events.emit("serve_error", cell={"n": group.n}, shape=label,
+                        kind=kind, size=len(batch),
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
+            metrics.inc("pifft_serve_errors_total", kind=kind)
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(RequestFailed(
+                        f"serve batch {label} failed beyond every rung "
+                        f"({kind} {type(e).__name__}: {str(e)[:200]})",
+                        kind=kind))
+            return
+        self.stats.record_batch(label)
+        # EMA of per-request service time feeds QueueFull.retry_after
+        batch_ms = (clock() - t_start) * 1e3 / len(batch)
+        prev = self._ema_ms.get(group)
+        self._ema_ms[group] = batch_ms if prev is None \
+            else 0.7 * prev + 0.3 * batch_ms
+        # a forced rung's tag is already in outcome.degrade
+        # ("overload:<rung>", from the runner) — only the window-collapse
+        # level needs adding here
+        tags = ([level] if level and rung is None else []) \
+            + list(outcome.degrade)
+        degraded = outcome.degraded or bool(tags)
+        for i, r in enumerate(batch):
+            queue_s = t_start - r.t_submit
+            resp = Response(
+                rid=r.rid, yr=outcome.yr[i], yi=outcome.yi[i],
+                queue_wait_ms=queue_s * 1e3,
+                compute_ms=outcome.compute_s * 1e3,
+                batch_size=outcome.size,
+                plan_variant=outcome.plan_variant,
+                degraded=degraded, degrade=list(tags))
+            self.stats.record(label, queue_s, outcome.compute_s,
+                              degraded=degraded)
+            metrics.observe("pifft_serve_queue_wait_seconds", queue_s,
+                            shape=label)
+            if degraded:
+                metrics.inc("pifft_serve_degraded_total", shape=label)
+            events.emit("serve_request", cell={"n": group.n},
+                        rid=r.rid, shape=label,
+                        queue_wait_ms=round(queue_s * 1e3, 4),
+                        compute_ms=round(outcome.compute_s * 1e3, 4),
+                        batch_size=outcome.size, degraded=degraded,
+                        **({"degrade": list(tags)} if tags else {}))
+            if not r.future.done():
+                r.future.set_result(resp)
+        metrics.observe("pifft_serve_compute_seconds", outcome.compute_s,
+                        shape=label)

@@ -1,0 +1,95 @@
+"""Open-loop load generator: the SLO measurement side of serving.
+
+Fires requests at a FIXED offered rate (arrivals scheduled at
+``t0 + i/rps`` regardless of completions — open-loop, so a slow server
+cannot flatter itself by slowing the clients down, the classic
+coordinated-omission trap), then reports what the service actually
+achieved: completed throughput, client-observed p50/p99, the
+queue-wait vs compute split from the responses, and how many arrivals
+were rejected (backpressure) or served degraded.
+
+``bench.py --serve-load`` drives this over the served shape set and
+emits the rows in the BENCH round record format.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import numpy as np
+
+from ..obs.spans import clock
+from .dispatcher import Dispatcher, QueueFull, ServeError
+from .slo import percentile
+
+
+async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
+                           duration_s: float, layout: str = "natural",
+                           precision: Optional[str] = None,
+                           seed: int = 0) -> dict:
+    """One (shape, offered-rps) cell: fire ``rps * duration_s``
+    arrivals on the open-loop schedule, await them all, and roll up
+    the SLO row.  Rejections and failures are counted, never raised —
+    a load test's job is to record the service's behavior at
+    saturation, not to die of it."""
+    rng = np.random.default_rng(seed)
+    xr = rng.standard_normal(n).astype(np.float32)
+    xi = rng.standard_normal(n).astype(np.float32)
+
+    ok: list = []          # (client_total_s, response)
+    rejected: list = []    # QueueFull errors (structured backpressure)
+    failed: list = []      # ServeError beyond backpressure
+
+    async def one():
+        t0 = clock()
+        try:
+            resp = await dispatcher.submit(xr, xi, layout=layout,
+                                           precision=precision)
+        except QueueFull as e:
+            rejected.append(e)
+            return
+        except ServeError as e:
+            failed.append(e)
+            return
+        ok.append((clock() - t0, resp))
+
+    total = max(1, int(rps * duration_s))
+    t_start = clock()
+    tasks = []
+    for i in range(total):
+        delay = (t_start + i / rps) - clock()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one()))
+    await asyncio.gather(*tasks)
+    elapsed = max(clock() - t_start, 1e-9)
+
+    row = {
+        "shape": f"n2^{n.bit_length() - 1}:{layout}",
+        "n": n,
+        "offered_rps": round(rps, 1),
+        "duration_s": round(elapsed, 4),
+        "requests": total,
+        "completed": len(ok),
+        "rejected": len(rejected),
+        "failed": len(failed),
+        "achieved_rps": round(len(ok) / elapsed, 1),
+        "degraded": sum(1 for _, r in ok if r.degraded),
+    }
+    if ok:
+        totals = [t for t, _ in ok]
+        queues = [r.queue_wait_ms for _, r in ok]
+        computes = [r.compute_ms for _, r in ok]
+        row.update({
+            "p50_ms": round(percentile(totals, 50) * 1e3, 4),
+            "p99_ms": round(percentile(totals, 99) * 1e3, 4),
+            "queue_p50_ms": round(percentile(queues, 50), 4),
+            "queue_p99_ms": round(percentile(queues, 99), 4),
+            "compute_p50_ms": round(percentile(computes, 50), 4),
+            "compute_p99_ms": round(percentile(computes, 99), 4),
+        })
+    if rejected:
+        row["retry_after_p50_ms"] = round(
+            percentile([e.retry_after_ms for e in rejected], 50), 3)
+    return row

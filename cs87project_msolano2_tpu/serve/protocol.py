@@ -1,0 +1,181 @@
+"""The wire front: length-prefixed JSON frames over a TCP socket.
+
+One frame = a 4-byte big-endian length + a UTF-8 JSON body.  Requests:
+
+    {"op": "fft", "id": 7, "xr": [...], "xi": [...],
+     "layout": "natural", "precision": "split3", "inverse": false}
+    {"op": "stats"}
+    {"op": "ping"}
+
+Responses mirror :meth:`~.dispatcher.Response.to_record` (with the
+result planes as ``yr``/``yi`` float lists) on success, or
+
+    {"id": 7, "ok": false, "error": {"type": "queue_full",
+     "message": "...", "retry_after_ms": 12.5}}
+
+on a structured :class:`~.dispatcher.ServeError` — backpressure and
+degradation travel the wire, they are never flattened into a generic
+500.  The server is asyncio end to end (``asyncio.start_server``
+streams; all awaited — check rule PIF107 keeps blocking socket I/O out
+of these paths), with one dispatcher shared by every connection: the
+coalescer sees ALL concurrent clients, which is the whole point.
+
+JSON float lists are a deliberately simple encoding — this front is
+the protocol seam, not a throughput record; a binary frame body can
+replace the JSON without touching the dispatcher.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Optional
+
+import numpy as np
+
+from .dispatcher import Dispatcher, ServeError
+
+#: frame length prefix: 4-byte big-endian unsigned
+_LEN = struct.Struct(">I")
+
+#: refuse absurd frames before allocating for them (a 2^27-point
+#: request in JSON floats is ~2 GiB of text; cap generously above any
+#: sane served shape)
+MAX_FRAME_BYTES = 1 << 28
+
+
+def encode_frame(obj) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame body {len(body)} bytes exceeds the "
+                         f"{MAX_FRAME_BYTES}-byte cap")
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader) -> Optional[dict]:
+    """The next decoded frame, or None on clean EOF."""
+    try:
+        head = await reader.readexactly(_LEN.size)
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None  # clean EOF between frames
+        raise ValueError(f"truncated frame header "
+                         f"({len(e.partial)}/{_LEN.size} bytes)") from e
+    (length,) = _LEN.unpack(head)
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"frame length {length} exceeds the "
+                         f"{MAX_FRAME_BYTES}-byte cap")
+    body = await reader.readexactly(length)
+    return json.loads(body.decode("utf-8"))
+
+
+async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
+    rid = msg.get("id")
+    op = msg.get("op")
+    if op == "ping":
+        return {"id": rid, "ok": True, "pong": True}
+    if op == "stats":
+        return {"id": rid, "ok": True,
+                "stats": dispatcher.stats.summary(),
+                "buffers": dispatcher.runner.pool.stats()}
+    if op != "fft":
+        return {"id": rid, "ok": False,
+                "error": {"type": "bad_request",
+                          "message": f"unknown op {op!r}"}}
+    try:
+        resp = await dispatcher.submit(
+            np.asarray(msg.get("xr", ()), np.float32),
+            np.asarray(msg.get("xi", ()), np.float32),
+            layout=msg.get("layout", "natural"),
+            precision=msg.get("precision"),
+            inverse=bool(msg.get("inverse", False)))
+    except ServeError as e:
+        return {"id": rid, "ok": False, "error": e.to_record()}
+    rec = resp.to_record(arrays=True)
+    rec["id"] = rid if rid is not None else rec["id"]
+    return rec
+
+
+async def handle_connection(dispatcher: Dispatcher, reader,
+                            writer) -> None:
+    """One client connection: frames in, frames out, until EOF.
+    Requests on one connection are served CONCURRENTLY (a queue-full
+    rejection must not wait behind a coalescing window), with writes
+    serialized through a lock."""
+    write_lock = asyncio.Lock()
+    pending = set()
+
+    async def serve_one(msg):
+        try:
+            reply = await _handle_one(dispatcher, msg)
+        except Exception as e:  # a reply is owed even for the unforeseen
+            from ..resilience import classify
+
+            reply = {"id": msg.get("id"), "ok": False,
+                     "error": {"type": "internal",
+                               "kind": classify(e).value,
+                               "message":
+                                   f"{type(e).__name__}: {str(e)[:200]}"}}
+        async with write_lock:
+            writer.write(encode_frame(reply))
+            await writer.drain()
+
+    try:
+        while True:
+            try:
+                msg = await read_frame(reader)
+            except (ValueError, json.JSONDecodeError) as e:
+                async with write_lock:
+                    writer.write(encode_frame(
+                        {"ok": False,
+                         "error": {"type": "bad_frame",
+                                   "message": str(e)[:200]}}))
+                    await writer.drain()
+                break  # framing is lost; the connection cannot recover
+            if msg is None:
+                break
+            task = asyncio.ensure_future(serve_one(msg))
+            pending.add(task)
+            task.add_done_callback(pending.discard)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+    finally:
+        writer.close()
+
+
+async def serve_socket(dispatcher: Dispatcher, host: str = "127.0.0.1",
+                       port: int = 8571):
+    """Run the socket front until cancelled.  Returns the
+    ``asyncio.Server`` via context management inside."""
+    server = await asyncio.start_server(
+        lambda r, w: handle_connection(dispatcher, r, w), host, port)
+    addrs = ", ".join(str(s.getsockname()) for s in server.sockets)
+    from ..plans.core import warn
+
+    warn(f"pifft serve listening on {addrs}")
+    async with server:
+        await server.serve_forever()
+
+
+async def request_over_socket(host: str, port: int, xr, xi,
+                              layout: str = "natural",
+                              precision: Optional[str] = None,
+                              inverse: bool = False) -> dict:
+    """Client helper: one fft request over a fresh connection (tests
+    and the CLI demo; a real client keeps the connection open)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(encode_frame({
+            "op": "fft", "id": 0,
+            "xr": np.asarray(xr, np.float64).tolist(),
+            "xi": np.asarray(xi, np.float64).tolist(),
+            "layout": layout, "precision": precision,
+            "inverse": inverse}))
+        await writer.drain()
+        reply = await read_frame(reader)
+        if reply is None:
+            raise ConnectionError("server closed before replying")
+        return reply
+    finally:
+        writer.close()

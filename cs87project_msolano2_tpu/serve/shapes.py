@@ -1,0 +1,114 @@
+"""The served shape set: which transform shapes this process answers
+for, and the warm startup path that pre-resolves their plans.
+
+A serving session must reach its first response on a warm plan-cache
+hit — tuning (or even static-default resolution + first trace) inside
+a request's latency budget is exactly the cold-start spike every
+inference stack's warmup pass exists to avoid.  The shape set is a
+JSONL file, one shape per line:
+
+    {"n": 1048576, "batch": [], "layout": "pi", "precision": "split3"}
+    {"n": 4096}                        # defaults: batch=(), natural, split3
+
+``pifft plan warm --shapes FILE`` warms the whole set in one call
+(instead of one ``plan warm`` invocation per shape), and
+``Dispatcher.warm()`` runs the same function at serve startup.  The
+policy is :func:`plans.tune_or_static`: tune where the hardware can
+answer, serve the measured-good static default otherwise — an offline
+(CPU) serving session never dies for lack of a tuner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from .. import plans
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeSpec:
+    """One served transform shape: everything needed to build its
+    PlanKey except the device kind (resolved at warm time, so one
+    shape file serves every host)."""
+
+    n: int
+    batch: tuple = ()
+    layout: str = "natural"
+    precision: str = "split3"
+
+    def __post_init__(self):
+        if self.n < 2 or self.n & (self.n - 1):
+            raise ValueError(f"served n={self.n} must be a power of two "
+                             f">= 2 (the plan ladder's domain)")
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "ShapeSpec":
+        if not isinstance(rec, dict) or "n" not in rec:
+            raise ValueError(f"shape record needs at least an 'n' field, "
+                             f"got {rec!r}")
+        return cls(
+            n=int(rec["n"]),
+            batch=tuple(int(b) for b in rec.get("batch") or ()),
+            layout=rec.get("layout", "natural"),
+            precision=rec.get("precision") or "split3",
+        )
+
+    def to_record(self) -> dict:
+        return {"n": self.n, "batch": list(self.batch),
+                "layout": self.layout, "precision": self.precision}
+
+    def key(self) -> plans.PlanKey:
+        """The PlanKey this shape resolves to on the current device."""
+        return plans.make_key(self.n, self.batch, layout=self.layout,
+                              precision=self.precision)
+
+    def label(self) -> str:
+        """Stable human/metric label (the per-shape SLO row key)."""
+        b = "x".join(str(d) for d in self.batch) + "x" if self.batch else ""
+        return f"{b}{self.n}:{self.layout}:{self.precision}"
+
+
+def load_shapes(path: str) -> list:
+    """Parse a shape-set JSONL file.  Blank lines and ``#`` comment
+    lines are skipped; a malformed line is an error naming its line
+    number (a silently dropped shape would serve cold later — the
+    failure mode warming exists to prevent)."""
+    specs, seen = [], set()
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                spec = ShapeSpec.from_record(json.loads(line))
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                raise ValueError(
+                    f"{path}:{lineno}: bad shape record: {e}") from e
+            if spec in seen:
+                continue  # duplicates warm once
+            seen.add(spec)
+            specs.append(spec)
+    if not specs:
+        raise ValueError(f"{path}: no shapes (every line blank/comment)")
+    return specs
+
+
+def warm(specs, force: bool = False, verbose: bool = False) -> list:
+    """Resolve (tune where possible, static default otherwise) and
+    memoize the plan for every spec — the one-call warm path behind
+    ``pifft plan warm --shapes`` and serve startup.  Returns the plans
+    in spec order.  Warming also primes each plan's executor, so the
+    first real request pays dispatch, not trace."""
+    out = []
+    for spec in specs:
+        plan = plans.tune_or_static(spec.key(), force=force,
+                                    verbose=verbose)
+        plan.fn  # build (and cache) the executor now, not per-request
+        from ..obs import events
+
+        events.emit("serve_warm", cell={"n": spec.n,
+                                        "variant": plan.variant},
+                    shape=spec.label(), source=plan.source)
+        out.append(plan)
+    return out

@@ -1,0 +1,112 @@
+"""SLO accounting: per-shape latency percentiles with the queue-wait /
+compute split.
+
+A served request's latency is two different stories glued together:
+time spent WAITING (admission + batching window + queue depth — the
+dispatcher's doing) and time spent COMPUTING (the kernel invocation its
+batch rode — the plan's doing).  Reporting only the total hides which
+knob to turn, so every record keeps the split, and the summary reports
+p50/p99 of each per shape label — the row format ``pifft serve
+--smoke`` prints and ``bench.py --serve-load`` emits in the BENCH
+round record.
+
+Percentiles use the nearest-rank method on the recorded population —
+no interpolation, so a p99 is always a latency that actually happened.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) of a non-empty
+    sequence."""
+    if not values:
+        raise ValueError("percentile of an empty population")
+    ordered = sorted(values)
+    rank = max(1, -(-len(ordered) * q // 100))  # ceil without floats
+    return ordered[int(min(rank, len(ordered))) - 1]
+
+
+class LatencyStats:
+    """Per-label accumulation of (queue_wait_s, compute_s, total_s)
+    samples plus degradation/batching tallies.  Thread-safe: the
+    dispatcher records from executor threads while summaries read from
+    the event loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: dict = {}    # label -> list of sample dicts
+        self._counts: dict = {}     # label -> {"requests", "batches",
+        #                                       "degraded", "rejected"}
+
+    def _bucket(self, label: str) -> dict:
+        c = self._counts.get(label)
+        if c is None:
+            c = self._counts[label] = {"requests": 0, "batches": 0,
+                                       "degraded": 0, "rejected": 0}
+            self._samples[label] = []
+        return c
+
+    def record(self, label: str, queue_wait_s: float, compute_s: float,
+               degraded: bool = False) -> None:
+        with self._lock:
+            c = self._bucket(label)
+            c["requests"] += 1
+            if degraded:
+                c["degraded"] += 1
+            self._samples[label].append(
+                {"queue": queue_wait_s, "compute": compute_s,
+                 "total": queue_wait_s + compute_s})
+
+    def record_batch(self, label: str) -> None:
+        with self._lock:
+            self._bucket(label)["batches"] += 1
+
+    def record_rejected(self, label: str) -> None:
+        with self._lock:
+            self._bucket(label)["rejected"] += 1
+
+    def summary(self) -> dict:
+        """label -> row dict with counts and p50/p99 of queue, compute
+        and total (ms).  Labels with zero completed samples report
+        counts only."""
+        out = {}
+        with self._lock:
+            for label, counts in self._counts.items():
+                row = dict(counts)
+                samples = self._samples[label]
+                if samples:
+                    for part in ("queue", "compute", "total"):
+                        vals = [s[part] for s in samples]
+                        row[f"{part}_p50_ms"] = round(
+                            percentile(vals, 50) * 1e3, 4)
+                        row[f"{part}_p99_ms"] = round(
+                            percentile(vals, 99) * 1e3, 4)
+                out[label] = row
+        return out
+
+
+def format_summary(summary: dict) -> str:
+    """The human table ``pifft serve --smoke`` prints."""
+    if not summary:
+        return "serve: no requests recorded"
+    cols = ("reqs", "batches", "rej", "degr", "q_p50", "q_p99",
+            "c_p50", "c_p99", "tot_p99")
+    lines = ["shape".ljust(28) + "  " + "  ".join(c.rjust(8) for c in cols)]
+    for label in sorted(summary):
+        row = summary[label]
+
+        def ms(key):
+            v = row.get(key)
+            return f"{v:.3f}" if v is not None else "-"
+
+        vals = (str(row["requests"]), str(row["batches"]),
+                str(row["rejected"]), str(row["degraded"]),
+                ms("queue_p50_ms"), ms("queue_p99_ms"),
+                ms("compute_p50_ms"), ms("compute_p99_ms"),
+                ms("total_p99_ms"))
+        lines.append(label.ljust(28) + "  "
+                     + "  ".join(v.rjust(8) for v in vals))
+    return "\n".join(lines)

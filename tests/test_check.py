@@ -491,6 +491,111 @@ def test_pif106_noqa_escape():
     assert run(code, "PIF106") == []
 
 
+# ----------------------------- PIF107 blocking call in async serve path
+
+
+SERVE_PATH = os.path.join(PKG, "serve", "snippet.py")
+
+
+def test_pif107_flags_sleep_and_open_in_async_serve_code():
+    code = """
+        import time
+
+        async def worker(q):
+            time.sleep(0.01)
+            with open("shapes.jsonl") as fh:
+                return fh.read()
+    """
+    findings = run(code, "PIF107", path=SERVE_PATH)
+    assert rule_ids(findings) == ["PIF107", "PIF107"]
+    assert any("time.sleep" in f.message for f in findings)
+    assert any("`open`" in f.message for f in findings)
+
+
+def test_pif107_import_alias_and_socket_methods_flag():
+    code = """
+        from time import sleep as snooze
+
+        async def pump(sock):
+            snooze(1)
+            return sock.recv(4096)
+    """
+    findings = run(code, "PIF107", path=SERVE_PATH)
+    assert rule_ids(findings) == ["PIF107", "PIF107"]
+    assert any(".recv()" in f.message for f in findings)
+
+
+def test_pif107_outside_serve_and_sync_code_pass():
+    code = """
+        import time
+
+        async def worker(q):
+            time.sleep(0.01)
+    """
+    # the same async blocking call OUTSIDE serve/ is not this rule's
+    # business (PIF101/102 own the general timing discipline)
+    assert run(code, "PIF107", path="snippet.py") == []
+    # the include glob is anchored on a path SEGMENT: a checkout whose
+    # directory merely ends in "serve" must not drag its tree in
+    assert run(code, "PIF107",
+               path="/home/ci/fft-serve/pkg/mod.py") == []
+    # sync startup code in serve/ may do file I/O (shape-set loading)
+    sync = """
+        def load(path):
+            with open(path) as fh:
+                return fh.read()
+    """
+    assert run(sync, "PIF107", path=SERVE_PATH) == []
+
+
+def test_pif107_asyncio_waits_are_sanctioned():
+    code = """
+        import asyncio
+
+        async def _wait_for_request(q, timeout_s):
+            try:
+                return await asyncio.wait_for(q.get(), timeout=timeout_s)
+            except asyncio.TimeoutError:
+                return None
+
+        async def pace():
+            await asyncio.sleep(0.01)
+    """
+    assert run(code, "PIF107", path=SERVE_PATH) == []
+
+
+def test_pif107_nested_sync_def_is_executor_territory():
+    code = """
+        import time
+
+        async def run_batch(loop, planes):
+            def staged():
+                time.sleep(0.001)  # runs in the executor thread
+                return planes
+            return await loop.run_in_executor(None, staged)
+    """
+    assert run(code, "PIF107", path=SERVE_PATH) == []
+
+
+def test_pif107_noqa_escape():
+    code = """
+        import time
+
+        async def worker():
+            time.sleep(0.01)  # pifft: noqa[PIF107]
+    """
+    assert run(code, "PIF107", path=SERVE_PATH) == []
+
+
+def test_pif107_serve_package_is_clean():
+    """The shipped serve/ package must satisfy its own rule with no
+    suppressions needed (the check-baseline stays empty)."""
+    serve_dir = os.path.join(PKG, "serve")
+    findings = [f for f in engine.check_paths([serve_dir],
+                                              rules=["PIF107"])]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 

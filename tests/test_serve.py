@@ -1,0 +1,516 @@
+"""Tests for the serve/ subsystem (docs/SERVING.md): shape-set parsing
+and warm, buffer pooling, coalescing (k concurrent requests -> fewer
+kernel invocations, every row still correct), bounded-queue
+backpressure (structured QueueFull, never a hang), the admission-time
+and fault-driven degradation ladders (every demotion tagged
+``degraded: true`` on the response and mirrored in the event stream —
+the chaos satellite), the wire protocol, the open-loop load generator,
+and the ``pifft serve --smoke`` / ``bench.py --serve-load`` entry
+points end to end on CPU."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs, resilience
+from cs87project_msolano2_tpu.serve import (
+    BufferPool,
+    Dispatcher,
+    DispatcherClosed,
+    QueueFull,
+    ServeConfig,
+    ServeError,
+    ShapeNotServed,
+    ShapeSpec,
+    batch_bucket,
+    load_shapes,
+    percentile,
+)
+from cs87project_msolano2_tpu.utils.verify import (
+    pi_layout_to_natural,
+    rel_err,
+)
+
+N = 256
+
+
+def planes(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal(n).astype(np.float32))
+
+
+def ref_fft(xr, xi):
+    return np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128))
+
+
+def run_async(coro, timeout_s=120.0):
+    """Every async test runs under a hard deadline: a serving-path bug
+    must FAIL, never hang the suite."""
+
+    async def bounded():
+        return await asyncio.wait_for(coro, timeout=timeout_s)
+
+    return asyncio.run(bounded())
+
+
+@pytest.fixture
+def obs_run():
+    obs.enable()
+    yield obs
+    obs.disable()
+
+
+# ------------------------------------------------------------- shapes
+
+
+def test_shape_spec_parsing_and_labels(tmp_path):
+    p = tmp_path / "shapes.jsonl"
+    p.write_text('{"n": 1024}\n'
+                 "# a comment line\n"
+                 "\n"
+                 '{"n": 2048, "layout": "pi", "precision": "fp32"}\n'
+                 '{"n": 1024}\n'  # duplicate: warmed once
+                 '{"n": 512, "batch": [4]}\n')
+    specs = load_shapes(str(p))
+    assert [s.n for s in specs] == [1024, 2048, 512]
+    assert specs[1].layout == "pi" and specs[1].precision == "fp32"
+    assert specs[2].batch == (4,)
+    assert specs[2].label() == "4x512:natural:split3"
+    assert specs[0].key().n == 1024
+
+
+def test_load_shapes_rejects_bad_records(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"n": 1024}\n{"batch": [2]}\n')
+    with pytest.raises(ValueError, match="line 2|bad.jsonl:2"):
+        load_shapes(str(p))
+    p.write_text("# only comments\n")
+    with pytest.raises(ValueError, match="no shapes"):
+        load_shapes(str(p))
+    with pytest.raises(ValueError, match="power of two"):
+        ShapeSpec(n=1000)
+
+
+def test_dispatcher_warm_memoizes_plans():
+    from cs87project_msolano2_tpu import plans
+
+    spec = ShapeSpec(n=N)
+    d = Dispatcher(ServeConfig(), [spec])
+    warmed = d.warm()
+    assert len(warmed) == 1
+    hit = plans.cache.lookup(spec.key())
+    assert hit is not None and hit.variant == warmed[0].variant
+
+
+# ------------------------------------------------- buffers and buckets
+
+
+def test_batch_bucket_powers_of_two():
+    assert [batch_bucket(s) for s in (1, 2, 3, 5, 8, 9)] == \
+        [1, 2, 4, 8, 8, 16]
+
+
+def test_buffer_pool_reuses_staging_planes():
+    pool = BufferPool(max_per_key=2)
+    a = pool.acquire((4, 64))
+    b = pool.acquire((4, 64))
+    pool.release(a, b)
+    c = pool.acquire((4, 64))
+    assert c is a or c is b
+    stats = pool.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 2
+    # a different shape never aliases
+    d = pool.acquire((2, 64))
+    assert d.shape == (2, 64)
+
+
+def test_percentile_nearest_rank():
+    vals = [4.0, 1.0, 3.0, 2.0]
+    assert percentile(vals, 50) == 2.0
+    assert percentile(vals, 99) == 4.0
+    assert percentile([7.0], 99) == 7.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+# -------------------------------------------------- correctness paths
+
+
+def test_single_request_matches_numpy():
+    xr, xi = planes(seed=1)
+
+    async def main():
+        async with Dispatcher() as d:
+            return await d.submit(xr, xi)
+
+    resp = run_async(main())
+    assert not resp.degraded
+    assert resp.queue_wait_ms >= 0 and resp.compute_ms > 0
+    assert rel_err(np.asarray(resp.yr) + 1j * np.asarray(resp.yi),
+                   ref_fft(xr, xi)) < 1e-4
+
+
+def test_inverse_and_pi_layout_requests():
+    xr, xi = planes(seed=2)
+    ref = ref_fft(xr, xi)
+
+    async def main():
+        async with Dispatcher() as d:
+            fwd_pi = await d.submit(xr, xi, layout="pi")
+            inv = await d.submit(
+                np.real(ref).astype(np.float32),
+                np.imag(ref).astype(np.float32), inverse=True)
+            return fwd_pi, inv
+
+    fwd_pi, inv = run_async(main())
+    nat = pi_layout_to_natural(np.asarray(fwd_pi.yr)
+                               + 1j * np.asarray(fwd_pi.yi))
+    assert rel_err(nat, ref) < 1e-4
+    back = np.asarray(inv.yr) + 1j * np.asarray(inv.yi)
+    assert rel_err(back, (xr + 1j * xi).astype(np.complex128)) < 1e-4
+
+
+def test_submit_validates_requests():
+    async def main():
+        async with Dispatcher() as d:
+            with pytest.raises(ServeError, match="power of two"):
+                await d.submit(np.zeros(100, np.float32),
+                               np.zeros(100, np.float32))
+            with pytest.raises(ServeError, match="1-D"):
+                await d.submit(np.zeros((2, 64), np.float32),
+                               np.zeros((2, 64), np.float32))
+            with pytest.raises(ServeError, match="natural"):
+                await d.submit(*planes(), layout="pi", inverse=True)
+
+    run_async(main())
+
+
+def test_strict_shapes_rejects_unwarmed():
+    async def main():
+        cfg = ServeConfig(strict_shapes=True)
+        async with Dispatcher(cfg, [ShapeSpec(n=N)]) as d:
+            await d.submit(*planes())  # served
+            with pytest.raises(ShapeNotServed):
+                await d.submit(*planes(n=2 * N))
+
+    run_async(main())
+
+
+def test_submit_after_close_raises():
+    async def main():
+        d = Dispatcher()
+        async with d:
+            await d.submit(*planes())
+        with pytest.raises(DispatcherClosed):
+            await d.submit(*planes())
+
+    run_async(main())
+
+
+# ---------------------------------------------------------- coalescing
+
+
+def test_concurrent_requests_coalesce_and_rows_stay_per_request():
+    """The tentpole acceptance shape: k concurrent same-shape requests
+    are served by strictly fewer kernel invocations than k, and every
+    response carries ITS OWN transform (a padded coalesced batch that
+    hands back the wrong rows would pass any latency assertion)."""
+    k = 9
+    inputs = [planes(seed=10 + i) for i in range(k)]
+
+    async def main():
+        cfg = ServeConfig(max_batch=8, max_wait_ms=50.0)
+        async with Dispatcher(cfg) as d:
+            resps = await asyncio.gather(
+                *(d.submit(xr, xi) for xr, xi in inputs))
+            return d, resps
+
+    d, resps = run_async(main())
+    label = f"{N}:natural:split3"
+    row = d.stats.summary()[label]
+    assert row["requests"] == k
+    assert 0 < row["batches"] < k, row  # coalescing happened
+    assert {r.batch_size for r in resps} <= {1, 2, 4, 8}
+    for (xr, xi), resp in zip(inputs, resps):
+        assert rel_err(np.asarray(resp.yr) + 1j * np.asarray(resp.yi),
+                       ref_fft(xr, xi)) < 1e-4
+    assert row["queue_p99_ms"] >= row["queue_p50_ms"] >= 0
+    assert row["compute_p99_ms"] > 0
+
+
+def test_mixed_shapes_group_separately():
+    async def main():
+        cfg = ServeConfig(max_wait_ms=25.0)
+        async with Dispatcher(cfg) as d:
+            a = planes(n=N, seed=3)
+            b = planes(n=2 * N, seed=4)
+            ra, rb = await asyncio.gather(d.submit(*a), d.submit(*b))
+            return d, (a, ra), (b, rb)
+
+    d, (a, ra), (b, rb) = run_async(main())
+    assert rel_err(np.asarray(ra.yr) + 1j * np.asarray(ra.yi),
+                   ref_fft(*a)) < 1e-4
+    assert rel_err(np.asarray(rb.yr) + 1j * np.asarray(rb.yi),
+                   ref_fft(*b)) < 1e-4
+    summary = d.stats.summary()
+    assert summary[f"{N}:natural:split3"]["requests"] == 1
+    assert summary[f"{2 * N}:natural:split3"]["requests"] == 1
+
+
+# ------------------------------------------------- backpressure / chaos
+
+
+def test_saturated_queue_returns_structured_backpressure():
+    """Past queue_depth admissions fail IMMEDIATELY with QueueFull
+    carrying retry_after_ms — bounded queues reject, they never grow
+    or hang (the whole run is under a hard deadline via run_async)."""
+    k, depth = 12, 4
+
+    async def main():
+        cfg = ServeConfig(queue_depth=depth, max_batch=2,
+                          max_wait_ms=5.0)
+        async with Dispatcher(cfg) as d:
+            return await asyncio.gather(
+                *(d.submit(*planes(seed=i)) for i in range(k)),
+                return_exceptions=True)
+
+    results = run_async(main())
+    rejected = [r for r in results if isinstance(r, QueueFull)]
+    served = [r for r in results if not isinstance(r, Exception)]
+    assert len(served) + len(rejected) == k
+    assert served and rejected  # both outcomes occurred
+    rec = rejected[0].to_record()
+    assert rec["type"] == "queue_full"
+    assert rec["retry_after_ms"] >= 1.0
+
+
+def test_chaos_injected_fault_degrades_and_tags_every_response(obs_run):
+    """The chaos satellite: under PIFFT_FAULT=serve:capacity the tuned
+    path dies, the batch falls to the jnp-fft rung, every response is
+    tagged degraded:true with the demotion trail, the event stream
+    carries serve_degrade, and results stay correct."""
+    from cs87project_msolano2_tpu.obs import events as obs_events
+
+    inputs = [planes(seed=20 + i) for i in range(4)]
+
+    async def main():
+        with resilience.inject("serve", "capacity"):
+            async with Dispatcher(ServeConfig(max_wait_ms=25.0)) as d:
+                return await asyncio.gather(
+                    *(d.submit(xr, xi) for xr, xi in inputs))
+
+    resps = run_async(main())
+    for (xr, xi), r in zip(inputs, resps):
+        assert r.degraded is True
+        assert any(tag.startswith("fault:capacity:") for tag in r.degrade)
+        assert rel_err(np.asarray(r.yr) + 1j * np.asarray(r.yi),
+                       ref_fft(xr, xi)) < 1e-4
+    recs = obs_events.snapshot()
+    kinds = {r["kind"] for r in recs}
+    assert "serve_degrade" in kinds and "serve_request" in kinds
+    req_events = [r for r in recs if r["kind"] == "serve_request"]
+    assert all(r["payload"]["degraded"] for r in req_events)
+    assert all(not obs_events.validate_event(r) for r in recs)
+
+
+def test_chaos_saturation_under_injection_never_hangs(obs_run):
+    """Saturation AND injected faults together: every admission still
+    resolves — served (degraded) or rejected (structured QueueFull) —
+    within the deadline.  No future is left pending."""
+    k, depth = 10, 3
+
+    async def main():
+        cfg = ServeConfig(queue_depth=depth, max_batch=2,
+                          max_wait_ms=2.0)
+        with resilience.inject("serve", "capacity"):
+            async with Dispatcher(cfg) as d:
+                return await asyncio.gather(
+                    *(d.submit(*planes(seed=30 + i)) for i in range(k)),
+                    return_exceptions=True)
+
+    results = run_async(main(), timeout_s=90.0)
+    assert len(results) == k
+    for r in results:
+        assert isinstance(r, QueueFull) or not isinstance(r, Exception)
+    served = [r for r in results if not isinstance(r, Exception)]
+    assert served and all(r.degraded for r in served)
+
+
+def test_degraded_rungs_preserve_inverse_direction():
+    """Regression: an inverse group served through a degradation rung
+    (overload mode or a fault fallback) must still compute the
+    INVERSE — a fallback that quietly returned the forward transform
+    would be a wrong answer tagged merely degraded."""
+    from cs87project_msolano2_tpu.serve.batcher import BatchRunner, GroupKey
+
+    xr, xi = planes(seed=50)
+    ref = np.fft.ifft(xr.astype(np.complex128)
+                      + 1j * xi.astype(np.complex128))
+    # the dispatcher's forced-rung (overload) path, via the runner
+    out = BatchRunner().run(GroupKey(n=N, inverse=True), [(xr, xi)],
+                            rung="jnp-fft")
+    assert rel_err(out.yr[0] + 1j * out.yi[0], ref) < 1e-4
+    # the fault-fallback path, end to end
+    async def main():
+        with resilience.inject("serve", "capacity"):
+            async with Dispatcher() as d:
+                return await d.submit(xr, xi, inverse=True)
+
+    r = run_async(main())
+    assert r.degraded
+    assert rel_err(np.asarray(r.yr) + 1j * np.asarray(r.yi), ref) < 1e-4
+
+
+def test_transient_injection_is_retried_not_degraded():
+    xr, xi = planes(seed=5)
+
+    async def main():
+        with resilience.inject("serve", "transient", count=1) as spec:
+            async with Dispatcher() as d:
+                r = await d.submit(xr, xi)
+            return spec.fired, r
+
+    fired, resp = run_async(main())
+    assert fired == 1
+    assert resp.degraded is False and resp.degrade == []
+    assert rel_err(np.asarray(resp.yr) + 1j * np.asarray(resp.yi),
+                   ref_fft(xr, xi)) < 1e-4
+
+
+def test_admission_overload_serves_cheap_rung_tagged():
+    """A near-full queue flips the worker into overload mode: the
+    batch skips the tuned kernel for the jnp-fft rung and every
+    response says so (admission-time graceful degradation)."""
+    depth = 8
+
+    async def main():
+        cfg = ServeConfig(queue_depth=depth, max_batch=depth,
+                          max_wait_ms=5.0,
+                          overload_watermark=0.8)
+        async with Dispatcher(cfg) as d:
+            return await asyncio.gather(
+                *(d.submit(*planes(seed=40 + i)) for i in range(depth)))
+
+    resps = run_async(main())
+    # all enqueued before the worker first drained: fill was (depth-1)/
+    # depth >= the watermark, so the FIRST batch ran overloaded
+    overloaded = [r for r in resps
+                  if any(t.startswith("overload:") for t in r.degrade)]
+    assert overloaded and all(r.degraded for r in overloaded)
+
+
+# ----------------------------------------------------------- protocol
+
+
+def test_protocol_frame_roundtrip_and_socket_server():
+    from cs87project_msolano2_tpu.serve import protocol
+
+    obj = {"op": "fft", "id": 3, "xr": [0.0, 1.0]}
+    frame = protocol.encode_frame(obj)
+    assert frame[:4] == len(frame[4:]).to_bytes(4, "big")
+    assert json.loads(frame[4:].decode()) == obj
+
+    xr, xi = planes(seed=6)
+
+    async def main():
+        async with Dispatcher(ServeConfig(max_wait_ms=5.0)) as d:
+            server = await asyncio.start_server(
+                lambda r, w: protocol.handle_connection(d, r, w),
+                "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            async with server:
+                reply = await protocol.request_over_socket(
+                    "127.0.0.1", port, xr, xi)
+                # unknown ops answer structured errors, same connection
+                # discipline
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(protocol.encode_frame({"op": "nope",
+                                                    "id": 9}))
+                await writer.drain()
+                bad = await protocol.read_frame(reader)
+                writer.close()
+            return reply, bad
+
+    reply, bad = run_async(main())
+    assert reply["ok"] is True and reply["degraded"] is False
+    got = np.asarray(reply["yr"]) + 1j * np.asarray(reply["yi"])
+    assert rel_err(got, ref_fft(xr, xi)) < 1e-4
+    assert reply["batch_size"] >= 1 and reply["compute_ms"] > 0
+    assert bad["ok"] is False and bad["error"]["type"] == "bad_request"
+    assert bad["id"] == 9
+
+
+# ------------------------------------------------------------ loadgen
+
+
+def test_loadgen_row_shape_and_accounting():
+    from cs87project_msolano2_tpu.serve.loadgen import run_offered_load
+
+    async def main():
+        async with Dispatcher(ServeConfig(max_wait_ms=1.0)) as d:
+            return await run_offered_load(d, N, rps=40.0,
+                                          duration_s=0.2)
+
+    row = run_async(main())
+    assert row["requests"] == row["completed"] + row["rejected"] \
+        + row["failed"]
+    assert row["completed"] > 0 and row["offered_rps"] == 40.0
+    assert row["p99_ms"] >= row["p50_ms"] > 0
+    assert row["queue_p99_ms"] >= 0 and row["compute_p99_ms"] > 0
+    assert row["shape"] == "n2^8:natural"
+
+
+# ------------------------------------------------------- entry points
+
+
+def test_serve_smoke_cli_end_to_end(capsys):
+    """The `make serve-smoke` gate, in-process: coalescing asserted
+    via obs counters, responses verified, zero schema-invalid
+    events."""
+    from cs87project_msolano2_tpu.serve.cli import serve_main
+
+    rc = serve_main(["--smoke", "-k", "8", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["problems"]
+    assert out["ok"] is True
+    assert 0 < out["same_shape_batches"] < out["same_shape_requests"]
+    assert out["schema_invalid_events"] == 0
+    assert out["events"] > 0
+
+
+def test_bench_serve_load_smoke_emits_slo_rows(capsys):
+    """`bench.py --serve-load --smoke` must emit the SLO row set in
+    the BENCH round format and exit 0 even when cells saturate."""
+    import bench
+
+    rc = bench.main(["--serve-load", "--smoke",
+                     "--load-rps", "60", "--load-duration", "0.2"])
+    assert rc == 0
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["metric"] == "serve_slo_p99_ms"
+    assert record["unit"] == "ms" and record["smoke"] is True
+    rows = record["serve_load"]
+    assert rows and all(
+        {"offered_rps", "achieved_rps", "requests"} <= set(r)
+        for r in rows)
+    completed = [r for r in rows if "p99_ms" in r]
+    assert completed and record["value"] == max(r["p99_ms"]
+                                                for r in completed)
+
+
+def test_bench_serve_load_chaos_completes_tagged(capsys):
+    """Injected serve chaos during the load run: rc stays 0 and the
+    record tags degraded (the resilience acceptance)."""
+    import bench
+
+    with resilience.inject("serve", "capacity"):
+        rc = bench.main(["--serve-load", "--smoke",
+                         "--load-rps", "40", "--load-duration", "0.15"])
+    assert rc == 0
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record.get("degraded") is True
+    assert any(r["degraded"] for r in record["serve_load"])
