@@ -44,9 +44,20 @@ run-experiments-and-analyze-results: run-experiments analyze
 bench: all
 	python3 bench.py
 
-# the CI rot check: whole reporting pipeline at toy sizes, offline
+# the CI rot check: whole reporting pipeline at toy sizes, offline —
+# including one interpret-safe cell through the hierarchical sixstep
+# kernel (docs/KERNELS.md), asserted tagged with its plan and its
+# carry-pass-aware roofline ceiling (~0.33: two HBM carries)
 bench-smoke:
-	PIFFT_PLAN_CACHE=off python3 bench.py --smoke
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 bench.py --smoke \
+	  | tee /tmp/pifft-bench-smoke.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-bench-smoke.json')); \
+	  assert r['sixstep_smoke_plan']['variant'] == 'sixstep', r; \
+	  assert abs(r['sixstep_smoke_roofline_ceiling'] - 1/3.0) < 1e-2, r; \
+	  assert r['n2^13_roofline_ceiling'] == 1.0, r; \
+	  print('# bench smoke ok: sixstep cell %s ms, ceiling %s' \
+	        % (r['sixstep_smoke_ms'], r['sixstep_smoke_roofline_ceiling']))"
 
 # the CI observability check (docs/OBSERVABILITY.md): the same smoke
 # run with the event stream armed — every emitted event must validate
@@ -69,8 +80,9 @@ bench-smoke-obs:
 	assert act > 0, c; \
 	rec = json.load(open('/tmp/pifft-bench-obs.json')); \
 	assert rec.get('run') in s['runs'], (rec.get('run'), s['runs']); \
+	assert rec['sixstep_smoke_plan']['variant'] == 'sixstep', rec; \
 	json.load(open('/tmp/pifft-obs-trace.json')); \
-	print('# obs smoke ok: %d events, plan-cache activity %g, run %s' \
+	print('# obs smoke ok: %d events, plan-cache activity %g, run %s, sixstep cell tagged' \
 	      % (s['event_count'], act, rec['run']))"
 
 # the CI chaos check (docs/RESILIENCE.md): with every kernel entry

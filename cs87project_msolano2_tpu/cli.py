@@ -101,7 +101,7 @@ def _parse_n(s: str) -> int:
 def plan_main(argv) -> int:
     """`plan {show|warm|clear|sweep}` — manage the persistent FFT plan
     cache (`sweep` tunes a whole large-n trajectory and reports the
-    measured fourstep crossover — docs/KERNELS.md)."""
+    measured fourstep AND sixstep crossovers — docs/KERNELS.md)."""
     ap = argparse.ArgumentParser(
         prog="cs87project_msolano2_tpu plan",
         description="show / warm / clear / sweep the FFT plan cache "
@@ -116,9 +116,10 @@ def plan_main(argv) -> int:
     ap.add_argument("-n", type=_parse_n, default=1 << 20,
                     help="transform length for warm (int or 2^k)")
     ap.add_argument("--ns", type=_parse_n, nargs="*",
-                    default=[1 << 20, 1 << 22, 1 << 24],
+                    default=[1 << 20, 1 << 22, 1 << 24, 1 << 25, 1 << 26],
                     help="sweep: transform lengths to tune "
-                         "(default: the bench trajectory)")
+                         "(default: the bench trajectory through the "
+                         "fourstep AND sixstep crossovers)")
     ap.add_argument("--batch", type=int, nargs="*", default=[],
                     help="leading batch dims for warm (default: none)")
     ap.add_argument("--layout", choices=("natural", "pi"), default="pi",
@@ -174,6 +175,9 @@ def plan_main(argv) -> int:
             print(f"  n={p.key.n}: {p.variant} {p.params}{ms}")
         print(f"measured fourstep crossover: "
               f"{cross if cross is not None else 'none (never won)'}")
+        cross6 = plans.sixstep_crossover(tuned)
+        print(f"measured sixstep crossover: "
+              f"{cross6 if cross6 is not None else 'none (never won)'}")
         return 0
 
     # warm

@@ -149,8 +149,9 @@ def tube_planned(sr, si, n, p, plan=None, precision=None):
     A segment's tube IS a standalone s-point pi-layout transform: the
     n-plan levels k.. coincide exactly with a fresh s-plan's levels 0..
     (W_{n>>(k+l)} = W_{s>>l} — see ``tube``), so the per-shard-shape
-    plan applies, including the large-n fourstep kernel family at
-    s > 2^20 where the unrolled jnp tube costs minutes of compile.
+    plan applies, including the large-n carry kernels (fourstep at
+    s > 2^20, the hierarchical sixstep at s >= 2^25 — docs/KERNELS.md)
+    where the unrolled jnp tube costs minutes of compile.
     Falls back to the jnp ``tube`` whenever :func:`resolve_tube_plan`
     serves no kernel plan."""
     plan = resolve_tube_plan(sr.shape, plan, precision)

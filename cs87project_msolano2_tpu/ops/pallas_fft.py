@@ -955,6 +955,49 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
     return out[0].reshape(n), out[1].reshape(n)
 
 
+def _lr_stages(xr, xi, levels, R, tw_for):
+    """The long-range DIF stage loop on in-VMEM (R, *rest) planes —
+    shared by every carry-kernel column phase (fourstep phase A, sixstep
+    phases A and B1).  `tw_for(l, half)` returns the level-l bottom-half
+    twiddle planes broadcastable against (half, *rest): the separable
+    closures rebuild them from factored A/B refs, the dense closures
+    slice per-level table blocks."""
+    rest = xr.shape[1:]
+    for l in range(levels):
+        half = R >> (l + 1)
+        wr, wi = tw_for(l, half)
+        xr4 = xr.reshape(-1, 2, half, *rest)
+        xi4 = xi.reshape(-1, 2, half, *rest)
+        ar, br = xr4[:, 0], xr4[:, 1]
+        ai, bi = xi4[:, 0], xi4[:, 1]
+        tr, ti = ar + br, ai + bi
+        dr, di = ar - br, ai - bi
+        ur = dr * wr - di * wi
+        ui = dr * wi + di * wr
+        xr = jnp.stack((tr, ur), axis=1).reshape(R, *rest)
+        xi = jnp.stack((ti, ui), axis=1).reshape(R, *rest)
+    return xr, xi
+
+
+def _sep_tw_for(R, ar_ref, ai_ref, br_ref, bi_ref, nrest):
+    """Separable-twiddle closure for _lr_stages: rebuilds level-l
+    twiddles as the outer product of the per-row factor slice (see
+    _long_range_factors) and the per-level column factor row."""
+    ones = (1,) * nrest
+
+    def tw_for(l, half):
+        o = R - (R >> l)
+        a_r = ar_ref[...][o:o + half].reshape(half, *ones)
+        a_i = ai_ref[...][o:o + half].reshape(half, *ones)
+        b_r = br_ref[...][l:l + 1].reshape(1, *br_ref.shape[-nrest:])
+        b_i = bi_ref[...][l:l + 1].reshape(1, *bi_ref.shape[-nrest:])
+        wr = a_r * b_r - a_i * b_i
+        wi = a_r * b_i + a_i * b_r
+        return wr, wi
+
+    return tw_for
+
+
 def _fourstep_kernel(levels, R, QB, qb, steps, precision, separable, *refs):
     """Single-pass four-step whole-FFT kernel body (Bailey's four-step
     out-of-core formulation, restated for VMEM): ONE pallas_call whose
@@ -1024,32 +1067,12 @@ def _fourstep_kernel(levels, R, QB, qb, steps, precision, separable, *refs):
 
     @pl.when(i < QB)
     def _phase_a():
-        xr = xr_ref[...]
-        xi = xi_ref[...]
-        rest = xr.shape[1:]  # (qb, LANE)
-        for l in range(levels):
-            half = R >> (l + 1)
-            if separable:
-                o = R - (R >> l)
-                a_r = ar_ref[...][o:o + half].reshape(half, 1, 1)
-                a_i = ai_ref[...][o:o + half].reshape(half, 1, 1)
-                b_r = br_ref[...][l:l + 1]
-                b_i = bi_ref[...][l:l + 1]
-                wr = a_r * b_r - a_i * b_i
-                wi = a_r * b_i + a_i * b_r
-            else:
-                wr = lr_tw[2 * l][...]
-                wi = lr_tw[2 * l + 1][...]
-            xr4 = xr.reshape(-1, 2, half, *rest)
-            xi4 = xi.reshape(-1, 2, half, *rest)
-            ar, br = xr4[:, 0], xr4[:, 1]
-            ai, bi = xi4[:, 0], xi4[:, 1]
-            tr, ti = ar + br, ai + bi
-            dr, di = ar - br, ai - bi
-            ur = dr * wr - di * wi
-            ui = dr * wi + di * wr
-            xr = jnp.stack((tr, ur), axis=1).reshape(R, *rest)
-            xi = jnp.stack((ti, ui), axis=1).reshape(R, *rest)
+        if separable:
+            tw_for = _sep_tw_for(R, ar_ref, ai_ref, br_ref, bi_ref, 2)
+        else:
+            def tw_for(l, half):
+                return lr_tw[2 * l][...], lr_tw[2 * l + 1][...]
+        xr, xi = _lr_stages(xr_ref[...], xi_ref[...], levels, R, tw_for)
 
         s = i % 2
 
@@ -1270,6 +1293,492 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x3r, x3i, *operands, *tables, btr, bti)
+    return out[0].reshape(n), out[1].reshape(n)
+
+
+def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
+                    precision, separable, *refs):
+    """Single-pass hierarchical six-step whole-FFT kernel body: the
+    recursive four-step with an HBM carry whose long-range (column)
+    phase is ITSELF blocked through the carry — the n = R1·R2·tile
+    transform streams through VMEM in three phases, every carry
+    transfer a manual double-buffered ``make_async_copy``:
+
+      steps 0..QB1-1      (phase A, outer long-range): one
+                          (R1, 1, qb1, LANE) column block of the
+                          (R1, m = R2·tile) view per step (read via the
+                          normal BlockSpec pipeline), log2(R1) DIF
+                          levels + separable twiddles, result staged and
+                          DMA'd to the HBM carry while the next block
+                          computes — exactly the fourstep phase A with
+                          (R, tile) -> (R1, m).
+      then per outer row j = 0..R1-1, a NESTED four-step of the m-point
+      sub-transform living in carry group j:
+        QB2 steps         (phase B1, inner long-range): one
+                          (R2, qb2, LANE) column block of the group,
+                          read from the carry by DMA (block i+1 in
+                          flight under block i's compute), log2(R2)
+                          levels + separable twiddles of the m-point
+                          plan, written back IN PLACE to the carry —
+                          the sub-carry; blocks are disjoint, so the
+                          write of block i never races the read of
+                          block i+1.
+        R2 steps          (phase B2, tile rows): row r2's carry DMA
+                          waited while row r2+1's is issued, tile-point
+                          DIF (VPU stages + MXU tail), output block
+                          leaves via the BlockSpec pipeline.
+
+    The carry is declared (R1, R2, Q, LANE) so all three phases address
+    it without a retiling: phase A writes [:, r2, q-slice, :], phase B1
+    reads/writes [j, :, q-slice, :], phase B2 reads [j, r2].  DMA
+    discipline follows the fourstep kernel: every start is waited
+    exactly once; write slot s is re-waited before reuse two steps
+    later; each phase boundary drains its outstanding writes before the
+    first dependent read, and the LAST B2 step of group j prefetches
+    group j+1's first B1 block so the memory system never idles across
+    group boundaries.  The grid is carry-ordered — a megacore split
+    would race the carry — hence dimension_semantics=("arbitrary",).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ntab = sum(6 if k in ("r8", "r4") else 2 for k, _ in steps)
+    xr_ref, xi_ref = refs[0], refs[1]
+    pos = 2
+    if separable:
+        a1r, a1i, b1r, b1i = refs[pos:pos + 4]
+        pos += 4
+        lrA = ()
+    else:
+        lrA = refs[pos:pos + 2 * levels1]
+        pos += 2 * levels1
+    if separable:
+        a2r, a2i, b2r, b2i = refs[pos:pos + 4]
+        pos += 4
+        lrB = ()
+    else:
+        lrB = refs[pos:pos + 2 * levels2]
+        pos += 2 * levels2
+    tw = refs[pos:pos + ntab]
+    btr_ref, bti_ref = refs[pos + ntab], refs[pos + ntab + 1]
+    or_ref, oi_ref = refs[pos + ntab + 2], refs[pos + ntab + 3]
+    (hr, hi, sAr, sAi, r1r, r1i, s1r, s1i, r2r, r2i,
+     wsemA, rsem1, wsem1, rsem2) = refs[pos + ntab + 4:]
+
+    i = pl.program_id(0)
+    QB1 = R2 * NQ1
+    P = QB2 + R2
+    k = jnp.maximum(i - QB1, 0)
+    j = k // P
+    sub = k - j * P
+
+    def a_write_dma(slot, blk, plane):
+        """Outer carry write: phase-A staging slot -> the block's
+        column slice of carry group-column (r2 = blk // NQ1)."""
+        stage = (sAr, sAi)[plane]
+        hbm = (hr, hi)[plane]
+        return pltpu.make_async_copy(
+            stage.at[slot],
+            hbm.at[:, blk // NQ1, pl.dslice((blk % NQ1) * qb1, qb1), :],
+            wsemA.at[slot, plane])
+
+    def b1_read_dma(slot, jj, blk, plane):
+        """Sub-carry read: carry group jj, inner column block `blk`
+        (R2 strided (qb2, LANE) chunks) -> VMEM block slot."""
+        buf = (r1r, r1i)[plane]
+        hbm = (hr, hi)[plane]
+        return pltpu.make_async_copy(
+            hbm.at[jj, :, pl.dslice(blk * qb2, qb2), :],
+            buf.at[slot], rsem1.at[slot, plane])
+
+    def b1_write_dma(slot, jj, blk, plane):
+        """Sub-carry write: B1 staging slot -> the SAME carry slice its
+        read came from (in place; blocks are touched exactly once)."""
+        stage = (s1r, s1i)[plane]
+        hbm = (hr, hi)[plane]
+        return pltpu.make_async_copy(
+            stage.at[slot],
+            hbm.at[jj, :, pl.dslice(blk * qb2, qb2), :],
+            wsem1.at[slot, plane])
+
+    def b2_read_dma(slot, jj, row, plane):
+        """Tile-row read: carry row (jj, row) — one contiguous tile —
+        -> VMEM row slot."""
+        buf = (r2r, r2i)[plane]
+        hbm = (hr, hi)[plane]
+        return pltpu.make_async_copy(
+            hbm.at[jj, row], buf.at[slot], rsem2.at[slot, plane])
+
+    @pl.when(i < QB1)
+    def _phase_a():
+        if separable:
+            tw_for = _sep_tw_for(R1, a1r, a1i, b1r, b1i, 2)
+        else:
+            def tw_for(l, half):
+                return (lrA[2 * l][...].reshape(half, qb1, LANE),
+                        lrA[2 * l + 1][...].reshape(half, qb1, LANE))
+        xr = xr_ref[...].reshape(R1, qb1, LANE)
+        xi = xi_ref[...].reshape(R1, qb1, LANE)
+        xr, xi = _lr_stages(xr, xi, levels1, R1, tw_for)
+
+        s = i % 2
+
+        @pl.when(i >= 2)
+        def _retire_a_write():
+            # block i-2 DMA'd out of this staging slot; it must land
+            # before the slot is overwritten
+            for plane in (0, 1):
+                a_write_dma(s, i - 2, plane).wait()
+
+        sAr[s] = xr
+        sAi[s] = xi
+        for plane in (0, 1):
+            a_write_dma(s, i, plane).start()
+
+        @pl.when(i == QB1 - 1)
+        def _boundary_a():
+            # every carry group spans all outer column blocks: drain
+            # the (at most two) outstanding writes, then prefetch group
+            # 0's first inner block so B1 starts with its read in flight
+            for blk in ([QB1 - 2, QB1 - 1] if QB1 >= 2 else [QB1 - 1]):
+                for plane in (0, 1):
+                    a_write_dma(blk % 2, blk, plane).wait()
+            for plane in (0, 1):
+                b1_read_dma(0, 0, 0, plane).start()
+
+    @pl.when((i >= QB1) & (sub < QB2))
+    def _phase_b1():
+        @pl.when(sub + 1 < QB2)
+        def _prefetch_b1():
+            # slot (sub+1)%2 held block sub-1, consumed one step ago
+            for plane in (0, 1):
+                b1_read_dma((sub + 1) % 2, j, sub + 1, plane).start()
+
+        s = sub % 2
+        for plane in (0, 1):
+            b1_read_dma(s, j, sub, plane).wait()
+        if separable:
+            tw_for = _sep_tw_for(R2, a2r, a2i, b2r, b2i, 2)
+        else:
+            def tw_for(l, half):
+                return lrB[2 * l][...], lrB[2 * l + 1][...]
+        zr, zi = _lr_stages(r1r[s], r1i[s], levels2, R2, tw_for)
+
+        @pl.when(sub >= 2)
+        def _retire_b1_write():
+            # this group's block sub-2 used this staging slot (group
+            # j-1's writes were all drained at its own boundary)
+            for plane in (0, 1):
+                b1_write_dma(s, j, sub - 2, plane).wait()
+
+        s1r[s] = zr
+        s1i[s] = zi
+        for plane in (0, 1):
+            b1_write_dma(s, j, sub, plane).start()
+
+        @pl.when(sub == QB2 - 1)
+        def _boundary_b1():
+            # every tile row of group j spans all inner column blocks:
+            # drain the outstanding sub-carry writes, then prefetch the
+            # group's first tile row
+            for blk in ([QB2 - 2, QB2 - 1] if QB2 >= 2 else [QB2 - 1]):
+                for plane in (0, 1):
+                    b1_write_dma(blk % 2, j, blk, plane).wait()
+            for plane in (0, 1):
+                b2_read_dma(0, j, 0, plane).start()
+
+    @pl.when((i >= QB1) & (sub >= QB2))
+    def _phase_b2():
+        r2_ = sub - QB2
+
+        @pl.when(r2_ + 1 < R2)
+        def _prefetch_row():
+            # slot (r2_+1)%2 held row r2_-1, consumed one step ago
+            for plane in (0, 1):
+                b2_read_dma((r2_ + 1) % 2, j, r2_ + 1, plane).start()
+
+        @pl.when((r2_ == R2 - 1) & (j < R1 - 1))
+        def _prefetch_next_group():
+            # group j+1's carry blocks were written in phase A (drained
+            # long ago) and B1 slot 0 was consumed this group — issue
+            # its first inner read now so the B1 pipeline never stalls
+            # at a group boundary
+            for plane in (0, 1):
+                b1_read_dma(0, j + 1, 0, plane).start()
+
+        s = r2_ % 2
+        for plane in (0, 1):
+            b2_read_dma(s, j, r2_, plane).wait()
+        yr, yi = _tile_fft_compute(
+            r2r[s], r2i[s], steps, tw,
+            btr_ref[:, :], bti_ref[:, :], precision,
+        )
+        or_ref[...] = yr.reshape(or_ref.shape)
+        oi_ref[...] = yi.reshape(oi_ref.shape)
+
+
+def sixstep_vmem_bytes(R1: int, cb1: int, R2: int, cb2: int, tile: int,
+                       tail: int = 256, separable: bool = True) -> int:
+    """Scoped-VMEM footprint estimate of one sixstep-kernel program —
+    the fourstep model with the column side split in two (all three
+    phases' buffers coexist for the kernel's lifetime):
+
+    * outer column side (phase A): double-buffered input blocks (4
+      planes of R1*cb1 float32), two staging slots (4 planes), ~2
+      planes of stack temps; dense mode adds its double-buffered table
+      blocks (~4 planes).
+    * inner column side (phase B1): two read slots + two staging slots
+      (8 planes of R2*cb2) + ~2 temps (the blocked B-factor streams are
+      folded in — levels2*cb2 is noise); dense adds ~4 planes.
+    * row side (phase B2) and the shared tables: identical to
+      fourstep_vmem_bytes (read slots + out blocks + tile-FFT temps,
+      tail matrices, mixed-radix twiddles).
+    """
+    col1 = (4 + 4 + 2) * R1 * cb1 * 4
+    if not separable:
+        col1 += 4 * R1 * cb1 * 4
+    col2 = (4 + 4 + 2) * R2 * cb2 * 4
+    if not separable:
+        col2 += 4 * R2 * cb2 * 4
+    row = (4 + 4 + 4) * tile * 4
+    tables = 2 * tail * tail * 4 + int(2.5 * tile) * 4
+    return col1 + col2 + row + tables
+
+
+def sixstep_auto_split(n: int, tile: int) -> tuple[int, int]:
+    """The balanced (R1, R2) outer/inner radix split for an
+    n = R1*R2*tile sixstep transform: R1 >= R2, both >= 2.  Raises when
+    R = n/tile < 4 — there is nothing to hierarchize; fourstep owns
+    that regime."""
+    R = n // tile
+    lv = ilog2(R)
+    if lv < 2:
+        raise ValueError(
+            f"sixstep needs R = n/tile >= 4 (two nontrivial radices), "
+            f"got R={R} at n={n} tile={tile} — use the fourstep kernel")
+    l2 = lv // 2
+    return 1 << (lv - l2), 1 << l2
+
+
+def sixstep_auto_cbs(n: int, tile: int, r2: int | None = None,
+                     tail: int = 256, separable: bool = True,
+                     interpret: bool = False) -> tuple[int, int]:
+    """The widest Mosaic-legal (cb1, cb2) column-block pair the VMEM
+    budget admits for an n = R1*R2*tile sixstep transform (qb a
+    multiple of 8 dividing Q, or the whole Q), preferring >= 25%
+    headroom under the scoped-VMEM ceiling — the fourstep chooser's
+    policy applied to the joint two-axis budget (cb2 is chosen first:
+    the inner pipeline runs R1 times per transform, so its blocks get
+    first claim on the headroom).  Raises when even the smallest legal
+    pair cannot fit, naming the limiting (R, cb) pairs."""
+    R = n // tile
+    if r2 is None:
+        R1, R2 = sixstep_auto_split(n, tile)
+    else:
+        R1, R2 = R // r2, r2
+    Q = tile // LANE
+    legal = [q for q in (1 << k for k in range(3, Q.bit_length()))
+             if q < Q and Q % q == 0] + [Q]
+    lo = legal[0] * LANE
+
+    def bytes_at(c1, c2):
+        return sixstep_vmem_bytes(R1, c1, R2, c2, tile, tail, separable)
+
+    if interpret:  # no scoped-VMEM ceiling in interpret mode
+        return lo, lo
+    if bytes_at(lo, lo) > VMEM_LIMIT_BYTES:
+        raise ValueError(
+            f"sixstep R1={R1} x cb1={lo} / R2={R2} x cb2={lo} is "
+            f"infeasible at n={n} (tile={tile}): the smallest lowerable "
+            f"column blocks need ~{bytes_at(lo, lo) >> 20} MB scoped "
+            f"VMEM (limit {VMEM_LIMIT_BYTES >> 20} MB) — use a larger "
+            f"tile or a different R1/R2 split")
+    budget = VMEM_LIMIT_BYTES * 3 // 4
+    if bytes_at(lo, lo) > budget:
+        budget = VMEM_LIMIT_BYTES  # merely-fitting fallback
+    cb2 = max((q * LANE for q in legal
+               if bytes_at(lo, q * LANE) <= budget), default=lo)
+    cb1 = max((q * LANE for q in legal
+               if bytes_at(q * LANE, cb2) <= budget), default=lo)
+    return cb1, cb2
+
+
+def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
+                                 r2: int | None = None,
+                                 cb1: int | None = None,
+                                 cb2: int | None = None, tail: int = 256,
+                                 precision=None, separable: bool = True,
+                                 interpret=None):
+    """Whole-FFT in ONE pallas_call at any HBM-resident n: the
+    hierarchical six-step (recursive four-step) pipeline with a
+    RECURSIVE HBM carry (see _sixstep_kernel).
+
+    Where the fourstep kernel tops out (n >= 2^25 at tile=2^16: even
+    its smallest legal column block — all R rows tall — misses the
+    scoped-VMEM budget), this factors the long-range phase itself:
+    n = R1 * R2 * tile, the outer log2(R1) DIF levels run on
+    (R1, qb1)-shaped blocks of the (R1, m = R2*tile) view, and each of
+    the R1 carry groups then runs a NESTED four-step of its m-point
+    sub-transform — inner long-range on (R2, qb2) blocks updating the
+    carry in place, tile FFTs streaming out.  Every phase's VMEM
+    footprint scales with max(R1, R2)*cb instead of R*cb, so any
+    transform that fits HBM lowers; every carry transfer is manual
+    double-buffered DMA, so no phase pays an un-overlapped round trip.
+
+    `r2` picks the inner radix (None = balanced split, R1 >= R2);
+    `cb1`/`cb2` the outer/inner column-block widths (None = the widest
+    VMEM-legal pair); `separable` the twiddle mode of both long-range
+    phases (dense tables cost ~2n extra table floats at the outer
+    level — only affordable at small n).  Requires R = n/tile >= 4;
+    the plan ladder serves fourstep/fused below that."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ..obs.spans import span as _obs_span
+
+    maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
+    if interpret is None:
+        interpret = _use_interpret()
+    if precision is None:
+        precision = SPLIT3
+    n = xr.shape[-1]
+    if tile is None:
+        tile = min(n, MAX_ROW_TILE)
+    _check_tail(tail, tile)
+    R = n // tile
+    if r2 is None:
+        R1, R2 = sixstep_auto_split(n, tile)
+    else:
+        if r2 < 2 or r2 & (r2 - 1) or R % r2 or R // r2 < 2:
+            raise ValueError(
+                f"r2={r2} must be a power of two with 2 <= r2 <= R/2 "
+                f"dividing R={R} (n={n}, tile={tile})")
+        R1, R2 = R // r2, r2
+    m = R2 * tile
+    Q = tile // LANE
+    levels1, levels2 = ilog2(R1), ilog2(R2)
+    if cb1 is None or cb2 is None:
+        auto1, auto2 = sixstep_auto_cbs(n, tile, R2, tail, separable,
+                                        interpret)
+        cb1 = auto1 if cb1 is None else cb1
+        cb2 = auto2 if cb2 is None else cb2
+    for name, cb in (("cb1", cb1), ("cb2", cb2)):
+        if cb % LANE or tile % cb:
+            raise ValueError(f"{name}={cb} must divide tile={tile} and "
+                             f"be a multiple of {LANE}")
+        qb = cb // LANE
+        if qb % 8 and qb != Q:
+            raise ValueError(
+                f"{name}={cb} gives {qb}-row column blocks; Mosaic's "
+                f"sublane rule needs block rows divisible by 8 or "
+                f"covering the whole tile — use {name} >= {8 * LANE}")
+    if not interpret and \
+            sixstep_vmem_bytes(R1, cb1, R2, cb2, tile, tail, separable) \
+            > VMEM_LIMIT_BYTES:
+        raise ValueError(
+            f"sixstep blocks R1={R1} x cb1={cb1} / R2={R2} x cb2={cb2} "
+            f"(tile={tile}) need ~"
+            f"{sixstep_vmem_bytes(R1, cb1, R2, cb2, tile, tail, separable) >> 20} "
+            f"MB scoped VMEM (limit {VMEM_LIMIT_BYTES >> 20} MB) — "
+            f"reduce cb1/cb2 or pass them as None")
+    qb1, qb2 = cb1 // LANE, cb2 // LANE
+    NQ1 = Q // qb1
+    QB1 = R2 * NQ1
+    QB2 = Q // qb2
+    P = QB2 + R2
+
+    steps, np_tables = _tile_plan(tile, tail)
+    tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr)
+    btr, bti = _pvary_like(
+        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], xr)
+    x4r = xr.reshape(R1, R2, Q, LANE)
+    x4i = xi.reshape(R1, R2, Q, LANE)
+
+    def in_a(i):
+        ia = jnp.minimum(i, QB1 - 1)
+        return (0, ia // NQ1, ia % NQ1, 0)
+
+    def in_b1fac(i):
+        kk = jnp.maximum(i - QB1, 0)
+        return (0, jnp.clip(kk % P, 0, QB2 - 1), 0)
+
+    in_specs = [pl.BlockSpec((R1, 1, qb1, LANE), in_a)] * 2
+    operands = []
+    if separable:
+        a1, a1i_, b1, b1i_ = _pvary_like(
+            [jnp.asarray(t) for t in _long_range_factors(R1, m)], xr)
+        operands += [a1.reshape(R1 - 1, 1, 1), a1i_.reshape(R1 - 1, 1, 1),
+                     b1.reshape(levels1, R2, Q, LANE),
+                     b1i_.reshape(levels1, R2, Q, LANE)]
+        in_specs += [pl.BlockSpec((R1 - 1, 1, 1), lambda i: (0, 0, 0))] * 2
+        in_specs += [pl.BlockSpec((levels1, 1, qb1, LANE), in_a)] * 2
+    else:
+        lr = []
+        for l, (wr, wi) in enumerate(twiddle_tables(n)[:levels1]):
+            half = R1 >> (l + 1)
+            lr.append(jnp.asarray(wr.reshape(half, R2, Q, LANE)))
+            lr.append(jnp.asarray(wi.reshape(half, R2, Q, LANE)))
+        operands += list(_pvary_like(lr, xr))
+        in_specs += [pl.BlockSpec((t.shape[0], 1, qb1, LANE), in_a)
+                     for t in operands[-2 * levels1:]]
+    if separable:
+        a2, a2i_, b2, b2i_ = _pvary_like(
+            [jnp.asarray(t) for t in _long_range_factors(R2, tile)], xr)
+        operands += [a2.reshape(R2 - 1, 1, 1), a2i_.reshape(R2 - 1, 1, 1),
+                     b2.reshape(levels2, Q, LANE),
+                     b2i_.reshape(levels2, Q, LANE)]
+        in_specs += [pl.BlockSpec((R2 - 1, 1, 1), lambda i: (0, 0, 0))] * 2
+        in_specs += [pl.BlockSpec((levels2, qb2, LANE), in_b1fac)] * 2
+    else:
+        lr = []
+        for l, (wr, wi) in enumerate(twiddle_tables(m)[:levels2]):
+            half = R2 >> (l + 1)
+            lr.append(jnp.asarray(wr.reshape(half, Q, LANE)))
+            lr.append(jnp.asarray(wi.reshape(half, Q, LANE)))
+        operands += list(_pvary_like(lr, xr))
+        in_specs += [pl.BlockSpec((t.shape[0], qb2, LANE), in_b1fac)
+                     for t in operands[-2 * levels2:]]
+    in_specs += [pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables]
+    in_specs += [pl.BlockSpec((tail, tail), lambda i: (0, 0))] * 2
+
+    def out_row(i):
+        kk = jnp.maximum(i - QB1, 0)
+        return (kk // P, jnp.clip(kk % P - QB2, 0, R2 - 1), 0, 0)
+
+    with _obs_span("sixstep", cell={"n": n, "r1": R1, "r2": R2},
+                   tile=tile, cb1=cb1, cb2=cb2, annotate=True):
+        out = pl.pallas_call(
+            partial(_sixstep_kernel, levels1, levels2, R1, R2, NQ1, QB2,
+                    qb1, qb2, steps, precision, separable),
+            grid=(QB1 + R1 * P,),
+            in_specs=in_specs,
+            out_specs=[pl.BlockSpec((1, 1, Q, LANE), out_row)] * 2,
+            out_shape=[
+                _out_struct((R1, R2, Q, LANE), xr),
+                _out_struct((R1, R2, Q, LANE), xi),
+            ],
+            scratch_shapes=[
+                pltpu.ANY((R1, R2, Q, LANE), jnp.float32),  # carry (re)
+                pltpu.ANY((R1, R2, Q, LANE), jnp.float32),  # carry (im)
+                pltpu.VMEM((2, R1, qb1, LANE), jnp.float32),  # A staging
+                pltpu.VMEM((2, R1, qb1, LANE), jnp.float32),
+                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),  # B1 read
+                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),
+                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),  # B1 staging
+                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),
+                pltpu.VMEM((2, Q, LANE), jnp.float32),        # B2 rows
+                pltpu.VMEM((2, Q, LANE), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, 2)),  # A write [slot, plane]
+                pltpu.SemaphoreType.DMA((2, 2)),  # B1 read
+                pltpu.SemaphoreType.DMA((2, 2)),  # B1 write
+                pltpu.SemaphoreType.DMA((2, 2)),  # B2 read
+            ],
+            # a carry-ordered three-phase pipeline: a megacore splitting
+            # the grid across cores would race both carries
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(x4r, x4i, *operands, *tables, btr, bti)
     return out[0].reshape(n), out[1].reshape(n)
 
 

@@ -39,6 +39,7 @@ from .autotune import (  # noqa: F401
     TuningError,
     TuningUnavailable,
     fourstep_crossover,
+    sixstep_crossover,
     tune,
     tune_sweep,
 )

@@ -170,6 +170,18 @@ def fourstep_crossover(plans: list) -> Optional[int]:
     return wins[0] if wins else None
 
 
+def sixstep_crossover(plans: list) -> Optional[int]:
+    """The measured fourstep→sixstep boundary from a list of tuned
+    plans: the smallest n whose winner is a sixstep variant, None when
+    sixstep never won.  The ladder's static expectation is
+    ``ladder.SIXSTEP_MIN_N`` (where fourstep's smallest legal column
+    block stops fitting VMEM); below it sixstep rides at the end of the
+    fourstep races, so a second-carry-pass win at a smaller n — drift —
+    is measured, not assumed."""
+    wins = sorted(p.key.n for p in plans if p.variant == "sixstep")
+    return wins[0] if wins else None
+
+
 def tune_sweep(ns, *, layout: str = "pi", precision: Optional[str] = None,
                force: bool = False, timer: Optional[Callable] = None,
                verbose: bool = True, allow_offline: bool = False,
@@ -198,4 +210,7 @@ def tune_sweep(ns, *, layout: str = "pi", precision: Optional[str] = None,
     cross = fourstep_crossover(out)
     _log(verbose, f"# plan sweep: measured fourstep crossover = "
                   f"{cross if cross is not None else 'none (never won)'}")
+    cross6 = sixstep_crossover(out)
+    _log(verbose, f"# plan sweep: measured sixstep crossover = "
+                  f"{cross6 if cross6 is not None else 'none (never won)'}")
     return out, cross

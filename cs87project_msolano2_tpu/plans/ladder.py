@@ -18,6 +18,13 @@ Every entry is (variant, params).  Variants:
                    through VMEM once per phase (docs/KERNELS.md).  The
                    static large-n choice above FOURSTEP_MIN_N, where the
                    fused VMEM carry no longer fits.
+* ``sixstep``    — the hierarchical six-step (recursive four-step)
+                   pipeline: the long-range phase itself blocked through
+                   a second HBM carry pass, so VMEM feasibility scales
+                   with max(R1, R2)·cb instead of R·cb.  The static
+                   choice at and above SIXSTEP_MIN_N, where even the
+                   smallest fourstep column block misses the VMEM
+                   budget (docs/KERNELS.md).
 * ``two-kernel`` — the original long-range + tile grid pair.
 * ``mf``         — the matmul-funnel path (correct and supported, not in
                    the flagship ladder — see bench history in ops).
@@ -49,6 +56,14 @@ FUSED_MAX_N = 1 << 20   # n-point re+im VMEM scratch feasibility bound
 # single-pass fourstep DMA pipeline is the static choice
 # (docs/KERNELS.md has the budget math behind both bounds).
 FOURSTEP_MIN_N = FUSED_MAX_N << 1
+
+# The documented sixstep crossover: at and above this even the smallest
+# Mosaic-legal fourstep column block (qb=8 at tile=2^16, i.e. R >= 512)
+# misses the scoped-VMEM budget, and the hierarchical sixstep pipeline
+# is the static choice — below it fourstep stays fastest (one carry
+# pass instead of two; docs/KERNELS.md has the budget math and the
+# carry-pass roofline ceilings behind both bounds).
+SIXSTEP_MIN_N = 1 << 25
 
 # dense-twiddle fourstep entries are only raced while the per-level
 # dense tables stay affordable to build and stream (~2n table floats)
@@ -96,6 +111,52 @@ def _fourstep_feasible(n: int) -> bool:
     return True
 
 
+def _sixstep_feasible(n: int) -> bool:
+    """Can the sixstep kernel lower an n-point transform at the flagship
+    tile?  Needs R = n/tile >= 4 (two nontrivial radices) and a VMEM-
+    legal (cb1, cb2) pair — explicit Python, so the static default never
+    serves a plan that raises on first execute."""
+    from ..ops.pallas_fft import sixstep_auto_cbs
+
+    try:
+        sixstep_auto_cbs(n, MAX_ROW_TILE, None, 256, True)
+    except ValueError:
+        return False
+    return True
+
+
+def sixstep_candidates(n: int) -> list:
+    """The sixstep race entries for an n-point 1-D key, spanning the
+    tunable axes: outer/inner column blocks cb1/cb2 (the VMEM-auto pair
+    plus one explicit halving each), the R1/R2 split (balanced auto plus
+    one rebalance toward a deeper inner radix), tile (2^16 flagship +
+    2^15 doubling R), tail, and the separable-twiddle mode (dense only
+    while its ~2n table floats stay affordable)."""
+    auto = {"tile": MAX_ROW_TILE, "r2": None, "cb1": None, "cb2": None,
+            "tail": 256, "separable": True}
+    ents = [("sixstep", dict(auto))]
+    from ..ops.pallas_fft import sixstep_auto_cbs, sixstep_auto_split
+
+    try:
+        r1, r2 = sixstep_auto_split(n, MAX_ROW_TILE)
+        cb1, cb2 = sixstep_auto_cbs(n, MAX_ROW_TILE, r2, 256, True)
+    except ValueError:
+        r1 = r2 = cb1 = cb2 = None
+    if cb1 is not None and cb1 // 2 >= 8 * LANE:
+        ents.append(("sixstep", dict(auto, cb1=cb1 // 2)))
+    if cb2 is not None and cb2 // 2 >= 8 * LANE:
+        ents.append(("sixstep", dict(auto, cb2=cb2 // 2)))
+    if r1 is not None and r1 // 2 >= 2:
+        # rebalanced split: a deeper inner radix shrinks the outer
+        # phase's R1·cb1 footprint at the cost of more sub-carry passes
+        ents.append(("sixstep", dict(auto, r2=r2 * 2)))
+    ents.append(("sixstep", dict(auto, tail=128)))
+    ents.append(("sixstep", dict(auto, tile=1 << 15)))
+    if n <= FOURSTEP_DENSE_MAX_N:
+        ents.append(("sixstep", dict(auto, separable=False)))
+    return ents
+
+
 def fourstep_candidates(n: int) -> list:
     """The fourstep race entries for an n-point 1-D key, spanning the
     tunable axes: tile (2^16 flagship + 2^15 doubling R), cb (the
@@ -127,10 +188,12 @@ def fourstep_candidates(n: int) -> list:
 def candidates(key: PlanKey) -> list:
     """The ordered (variant, params) race for `key`.  Empty when nothing
     is tunable (the static default may still serve a jnp fallback).
-    Large-n ordering encodes the per-n crossover expectation: below
+    Large-n ordering encodes the per-n crossover expectations: below
     FOURSTEP_MIN_N the fused VMEM-carry entries lead and fourstep rides
-    at the end (so a surprise win is still caught); at and above it the
-    fourstep entries lead and the fused ones (infeasible there) drop
+    at the end (so a surprise win is still caught); between the
+    crossovers the fourstep entries lead and sixstep rides at the end
+    the same way; at and above SIXSTEP_MIN_N the sixstep entries lead
+    and both the fused and fourstep entries (infeasible there) drop
     out."""
     if key.precision == "fp32":
         return []  # fp32 forces the jnp path; nothing to race
@@ -144,8 +207,12 @@ def candidates(key: PlanKey) -> list:
     elif key.batch == () and _pow2(key.n) and key.n > MAX_ROW_TILE:
         if key.n < FOURSTEP_MIN_N:
             cands = [(v, dict(p)) for v, p in FLAGSHIP_LADDER]
-        else:
+        elif key.n < SIXSTEP_MIN_N:
             cands = fourstep_candidates(key.n)
+            cands += [(v, dict(p)) for v, p in FLAGSHIP_LADDER
+                      if not v.startswith("fused")]
+        else:
+            cands = sixstep_candidates(key.n)
             cands += [(v, dict(p)) for v, p in FLAGSHIP_LADDER
                       if not v.startswith("fused")]
         # the VMEM-aware auto-cb rql shape: at large n the fixed-cb
@@ -156,6 +223,11 @@ def candidates(key: PlanKey) -> list:
             # below the crossover fourstep is the expected loser — raced
             # last so the record still shows the margin per n
             cands += fourstep_candidates(key.n)
+        elif key.n < SIXSTEP_MIN_N and _sixstep_feasible(key.n):
+            # likewise sixstep below ITS crossover: the second carry
+            # pass should lose to fourstep's one, but the margin per n
+            # is worth recording (and a drifted crossover is caught)
+            cands += sixstep_candidates(key.n)
     return cands
 
 
@@ -183,12 +255,20 @@ def static_default(key: PlanKey):
         # kernels at these sizes cost minutes for nothing), but pi
         # layout has no jnp equivalent, so it gets the interpret plan.
         if not (offline_kind(key.device_kind) and natural):
-            if key.n >= FOURSTEP_MIN_N and _fourstep_feasible(key.n):
+            if key.n >= SIXSTEP_MIN_N and _sixstep_feasible(key.n):
+                # past fourstep's feasibility bound the hierarchical
+                # sixstep pipeline is the static choice — the silent
+                # rql fallback (an un-overlapped round trip) is gone
+                return "sixstep", {"tile": MAX_ROW_TILE, "r2": None,
+                                   "cb1": None, "cb2": None, "tail": 256,
+                                   "separable": True}
+            if key.n >= FOURSTEP_MIN_N and key.n < SIXSTEP_MIN_N and \
+                    _fourstep_feasible(key.n):
                 return "fourstep", {"tile": MAX_ROW_TILE, "cb": None,
                                     "tail": 256, "separable": True}
-            # below the crossover — or where fourstep's smallest legal
-            # column block cannot fit VMEM (R >= 512 at tile=2^16,
-            # i.e. n >= 2^25) — the always-lowerable auto-cb rql plan
+            # below the crossover — or where neither carry kernel's
+            # smallest legal column block can fit VMEM — the
+            # always-lowerable auto-cb rql plan
             return "rql", {"tile": 1 << 16, "cb": None, "tail": 256}
     if not natural:
         raise ValueError(
@@ -264,6 +344,13 @@ def build_executor(key: PlanKey, variant: str, params: dict):
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas_fourstep(
                 xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
+                tail=_p.get("tail", 256), precision=prec,
+                separable=_p.get("separable", True))
+    elif variant == "sixstep":
+        def core(xr, xi, _p=dict(params)):
+            return pf.fft_pi_layout_pallas_sixstep(
+                xr, xi, tile=_p.get("tile"), r2=_p.get("r2"),
+                cb1=_p.get("cb1"), cb2=_p.get("cb2"),
                 tail=_p.get("tail", 256), precision=prec,
                 separable=_p.get("separable", True))
     elif variant == "rql":

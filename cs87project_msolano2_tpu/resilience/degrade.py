@@ -2,14 +2,20 @@
 PERMANENT fault, demote it down Bailey's constraint ladder instead of
 killing the run —
 
-    fourstep / fused / rows  ->  two-trip rql  ->  jnp.fft.fft
-                                              ->  numpy reference
+    sixstep  ->  fourstep  ->  two-trip rql  ->  jnp.fft.fft
+                                             ->  numpy reference
+    (fused / rows enter at the fourstep->rql edge: fourstep is a
+    sibling single-pass design, not a weaker one, so they skip it)
 
-The order is the four-step constraint order: the single-pass designs
-need the most VMEM/DMA machinery, the two-trip rql only scoped column
+The order is the recursive four-step constraint order: the hierarchical
+sixstep pipeline needs the most machinery (two HBM carries, four DMA
+streams), the fourstep one carry, the two-trip rql only scoped column
 blocks, ``jnp.fft.fft`` only XLA, and the numpy reference (via
 ``jax.pure_callback``) only a host — each rung strictly weaker in what
-it demands of the backend, strictly equal in what it computes.  Every
+it demands of the backend, strictly equal in what it computes.  A rung
+that cannot even serve the key statically (fourstep past its VMEM
+feasibility bound, where sixstep exists precisely because fourstep
+cannot lower) counts as the rung failing and the walk continues.  Every
 demotion is recorded on the plan (``plan.degraded`` /
 ``plan.demotions``), pushed back through the plan cache, and announced
 through ``plans.warn``, so a degraded run is never mistaken for a
@@ -35,23 +41,33 @@ from typing import Callable
 from .taxonomy import FaultKind, classify
 
 #: the demotion ladder, weakest-demand last (docs/RESILIENCE.md)
-DEGRADE_CHAIN = ("rql", "jnp-fft", "numpy-ref")
+DEGRADE_CHAIN = ("fourstep", "rql", "jnp-fft", "numpy-ref")
 
 #: parameters for the rql rung: auto tile/cb (always lowerable at any
 #: feasible n) and the short-tile-safe tail
 _RQL_PARAMS = {"tile": None, "cb": None, "tail": 128}
 
+#: parameters for the fourstep rung (sixstep's first demotion): the
+#: static-default shape — auto cb, so the rung either lowers or raises
+#: the explicit feasibility ValueError and the walk continues
+_FOURSTEP_PARAMS = {"tile": None, "cb": None, "tail": 256,
+                    "separable": True}
+
 
 def _rungs_after(variant: str) -> tuple:
     """The chain below `variant` — a ladder variant OR an
-    already-landed chain rung (a plan never demotes sideways or up)."""
+    already-landed chain rung.  A plan never demotes sideways or up:
+    only sixstep enters at the fourstep rung (the fused/rows designs
+    are fourstep's siblings, not its betters — they join at rql)."""
     if variant in DEGRADE_CHAIN:
         return DEGRADE_CHAIN[DEGRADE_CHAIN.index(variant) + 1:]
+    if variant == "sixstep":
+        return DEGRADE_CHAIN
     if variant == "two-kernel":
-        return DEGRADE_CHAIN[1:]
-    if variant == "jnp":
         return DEGRADE_CHAIN[2:]
-    return DEGRADE_CHAIN
+    if variant == "jnp":
+        return DEGRADE_CHAIN[3:]
+    return DEGRADE_CHAIN[1:]
 
 
 def _pi_take(key):
@@ -69,6 +85,22 @@ def build_rung(key, rung: str) -> Callable:
     """The executable for one chain rung at `key`'s shape/layout.
     Raises (statically) when the rung cannot serve the key — the chain
     walker treats that exactly like the rung failing and moves on."""
+    if rung == "fourstep":
+        from ..plans import ladder
+
+        if key.batch != ():
+            raise ValueError("fourstep rung is a 1-D whole-transform "
+                             "path")
+        # build AND probe feasibility statically: past fourstep's VMEM
+        # bound (n >= 2^25 — sixstep's whole reason to exist) the
+        # auto-cb chooser raises here and the walk moves on to rql
+        from ..ops.pallas_fft import MAX_ROW_TILE, fourstep_auto_cb
+
+        if key.n > MAX_ROW_TILE:
+            fourstep_auto_cb(key.n, MAX_ROW_TILE, 256, True)
+        return ladder.build_executor(key, "fourstep",
+                                     dict(_FOURSTEP_PARAMS))
+
     if rung == "rql":
         from ..plans import ladder
 

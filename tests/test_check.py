@@ -300,6 +300,27 @@ def test_pif104_single_trip_and_unmatched_names_pass():
     assert run(code, "PIF104") == []
 
 
+def test_pif104_kernel_module_is_clean():
+    """The shipped kernel module must satisfy PIF104 as committed: the
+    single-pass entry points (fused, fourstep, and the hierarchical
+    sixstep — one pallas_call each, nested DMA helpers and all) pass
+    with NO suppression, and only the documented two-trip fallbacks
+    carry a reasoned noqa (check-baseline.json stays empty)."""
+    import re
+
+    kernel_py = os.path.join(PKG, "ops", "pallas_fft.py")
+    findings = [f for f in engine.check_paths([kernel_py],
+                                              rules=["PIF104"])]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+    src = open(kernel_py).read()
+    # the single-pass family is clean on its own merits, not via noqa:
+    # no PIF104 suppression may appear inside these function bodies
+    for entry in ("fft_pi_layout_pallas_sixstep",):
+        body = src.split(f"def {entry}")[1].split("\ndef ")[0]
+        assert "noqa[PIF104]" not in body, entry
+        assert len(re.findall(r"pl\.pallas_call", body)) == 1, entry
+
+
 def test_pif104_noqa_with_justification():
     code = """
         from jax.experimental import pallas as pl

@@ -203,10 +203,11 @@ def test_static_default_selects_fourstep_only_above_crossover():
     assert variant(1 << 22, kind="cpu-interpret") == "fourstep"
     assert ladder.FOURSTEP_MIN_N == 1 << 21
     # past fourstep's own feasibility bound (R >= 512 at tile=2^16 —
-    # no legal column block fits VMEM) the static default must serve
-    # the always-lowerable rql plan, never one that raises on execute
-    assert variant(1 << 25) == "rql"
-    assert variant(1 << 26) == "rql"
+    # no legal column block fits VMEM) the static default serves the
+    # hierarchical sixstep pipeline (tests/test_sixstep.py), never a
+    # plan that raises on execute and no longer the silent rql fallback
+    assert variant(1 << 25) == "sixstep"
+    assert variant(1 << 26) == "sixstep"
 
 
 def test_ladder_orders_fourstep_by_crossover():
@@ -375,3 +376,14 @@ def test_bench_smoke_pipeline(capsys):
     tag = f"n2^{bench.SMOKE_LARGE_LOGNS[0]}"
     assert f"{tag}_ms" in rec and f"{tag}_gflops" in rec
     assert f"{tag}_vs_xla" in rec  # per-row xla comparison (satellite)
+    # carry-pass-aware roofline fields ride on every row (the ceiling
+    # is plan-declared, so it exists even offline where util does not)
+    assert rec[f"{tag}_roofline_ceiling"] == 1.0  # rows path: carry-free
+    assert rec[f"{tag}_carry_passes"] == 0
+    # the interpret-safe sixstep cell (tests/test_sixstep.py has the
+    # kernel itself; this asserts the bench wiring end to end)
+    assert rec["sixstep_smoke_plan"]["variant"] == "sixstep"
+    assert rec["sixstep_smoke_roofline_ceiling"] == pytest.approx(
+        1 / 3, abs=1e-3)
+    assert "sixstep_smoke_ms" in rec
+    assert "degraded" not in rec

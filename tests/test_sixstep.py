@@ -1,0 +1,427 @@
+"""Hierarchical six-step single-pass path: kernel parity (vs numpy AND
+vs the fourstep pipeline, inverse included), VMEM budget validation
+naming the limiting shapes, plan-ladder crossover selection, the
+sixstep→fourstep degradation rung, carry-pass-aware roofline
+accounting, and the obs span on the new entry point (interpret mode on
+the CPU backend; the same code compiles for TPU — bench.py exercises
+that on hardware)."""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.ops.bits import bit_reverse_indices
+from cs87project_msolano2_tpu.ops.pallas_fft import (
+    VMEM_LIMIT_BYTES,
+    fft_pi_layout_pallas_fourstep,
+    fft_pi_layout_pallas_sixstep,
+    sixstep_auto_cbs,
+    sixstep_auto_split,
+    sixstep_vmem_bytes,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan_cache():
+    """The demotion tests memoize degraded plans into the process-wide
+    LRU; never let one leak into another test's get_plan (the same
+    hygiene test_resilience.py keeps)."""
+    from cs87project_msolano2_tpu import plans
+
+    plans.cache.clear(memory=True)
+    yield
+    plans.cache.clear(memory=True)
+
+
+def rand_planes(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.standard_normal(n).astype(np.float32),
+        rng.standard_normal(n).astype(np.float32),
+    )
+
+
+def to_complex(yr, yi):
+    return np.asarray(yr).astype(np.complex128) + 1j * np.asarray(yi)
+
+
+def np_pi_layout(x, n):
+    return np.fft.fft(x.astype(np.complex128))[bit_reverse_indices(n)]
+
+
+# ------------------------------------------------------- kernel parity
+
+
+@pytest.mark.parametrize("n,tile,r2,cb1,cb2,tail,separable", [
+    (1 << 13, 1 << 11, None, None, None, 128, True),   # R=4: minimal split
+    (1 << 14, 1 << 11, None, None, None, 128, True),   # R=8: R1=4 x R2=2
+    (1 << 14, 1 << 11, 4, None, None, 128, True),      # non-square R1=2 x R2=4
+    (1 << 15, 1 << 12, None, 1024, 1024, 256, True),   # explicit multi-block cbs
+    (1 << 15, 1 << 12, None, None, None, 256, False),  # dense twiddles, both phases
+    (1 << 16, 1 << 12, None, None, None, 256, True),   # R=16: deeper pipelines
+])
+def test_sixstep_vs_numpy(n, tile, r2, cb1, cb2, tail, separable):
+    xr, xi = rand_planes(n, seed=41)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_sixstep(
+        xr, xi, tile=tile, r2=r2, cb1=cb1, cb2=cb2, tail=tail,
+        separable=separable)
+    ref = np_pi_layout(x, n)
+    err = np.max(np.abs(to_complex(yr, yi) - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5, (n, tile, r2, cb1, cb2, tail, separable, err)
+
+
+def test_sixstep_matches_fourstep_path():
+    """Three-way parity on a non-square R·C split: the recursive-carry
+    sixstep pipeline, the single-carry fourstep pipeline, and numpy
+    must agree on the same input — hierarchizing the long-range phase
+    may not change a single value."""
+    n, tile = 1 << 14, 1 << 11  # R=8 -> R1=4, R2=2 (non-square)
+    xr, xi = rand_planes(n, seed=42)
+    x = xr.astype(np.complex128) + 1j * xi
+    sr, si = fft_pi_layout_pallas_sixstep(xr, xi, tile=tile, tail=128)
+    fr, fi = fft_pi_layout_pallas_fourstep(xr, xi, tile=tile, tail=128)
+    ref = np_pi_layout(x, n)
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(to_complex(sr, si) - ref)) / scale < 1e-5
+    assert np.max(np.abs(to_complex(fr, fi) - ref)) / scale < 1e-5
+    # sixstep vs fourstep directly: identical stage math, tighter bound
+    assert np.max(np.abs(to_complex(sr, si) - to_complex(fr, fi))) / \
+        scale < 1e-5
+
+
+def test_sixstep_inverse_via_plan():
+    """Inverse parity through the plan layer's conj trick: a
+    natural-layout sixstep Plan must round back to numpy's ifft."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans.core import Plan
+
+    n = 1 << 13
+    key = plans.make_key(n, layout="natural")
+    plan = Plan(key=key, variant="sixstep",
+                params={"tile": 1 << 11, "tail": 128}, source="static")
+    xr, xi = rand_planes(n, seed=43)
+    yr, yi = plan.execute_inverse(xr, xi)
+    ref = np.fft.ifft(xr.astype(np.complex128) + 1j * xi)
+    err = np.max(np.abs(to_complex(yr, yi) - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5
+    # and the forward natural-layout executor agrees with numpy's fft
+    fr, fi = plan.execute(xr, xi)
+    fref = np.fft.fft(xr.astype(np.complex128) + 1j * xi)
+    assert np.max(np.abs(to_complex(fr, fi) - fref)) / \
+        np.max(np.abs(fref)) < 1e-5
+
+
+@pytest.mark.slow
+def test_sixstep_large_n_2_22():
+    """Large-n reach: 2^22 (R=64 -> R1=R2=8 at tile=2^16) through the
+    exact static-default parameter shape the plan layer serves."""
+    n = 1 << 22
+    xr, xi = rand_planes(n, seed=44)
+    x = xr.astype(np.complex128) + 1j * xi
+    yr, yi = fft_pi_layout_pallas_sixstep(xr, xi, tile=1 << 16, tail=256)
+    ref = np_pi_layout(x, n)
+    err = np.max(np.abs(to_complex(yr, yi) - ref)) / np.max(np.abs(ref))
+    assert err < 1e-5
+
+
+def test_sixstep_requires_two_radices():
+    """R = n/tile < 4 has nothing to hierarchize: the entry must say so
+    (the ladder serves fourstep/fused there), and a bad explicit r2
+    must be rejected up front."""
+    xr, xi = rand_planes(1 << 13, seed=45)
+    with pytest.raises(ValueError, match="fourstep"):
+        fft_pi_layout_pallas_sixstep(xr, xi, tile=1 << 12)  # R=2
+    with pytest.raises(ValueError, match="r2"):
+        fft_pi_layout_pallas_sixstep(xr, xi, tile=1 << 11, r2=3)
+    with pytest.raises(ValueError, match="r2"):
+        fft_pi_layout_pallas_sixstep(xr, xi, tile=1 << 11, r2=4)  # R1 < 2
+
+
+# --------------------------------------------------- budget validation
+
+
+def test_sixstep_vmem_budget_error_names_shapes():
+    """An explicit (cb1, cb2) pair past the scoped-VMEM ceiling must
+    fail with BOTH limiting (R, cb) pairs named, before any lowering is
+    attempted."""
+    n, tile = 1 << 22, 1 << 14  # R = 256 -> R1 = R2 = 16
+    xr, xi = rand_planes(n, seed=46)
+    assert sixstep_vmem_bytes(16, 1 << 14, 16, 1 << 14, tile) > \
+        VMEM_LIMIT_BYTES
+    with pytest.raises(ValueError,
+                       match=r"R1=16 x cb1=16384 / R2=16 x cb2=16384"):
+        fft_pi_layout_pallas_sixstep(xr, xi, tile=tile, cb1=1 << 14,
+                                     cb2=1 << 14, interpret=False)
+    # sublane-rule violations still raise their own error first
+    with pytest.raises(ValueError, match="sublane"):
+        fft_pi_layout_pallas_sixstep(xr, xi, tile=tile, cb1=512,
+                                     interpret=False)
+
+
+def test_sixstep_auto_cbs_budget():
+    """The auto chooser must produce lowerable block pairs through the
+    acceptance range (2^25..2^27 at tile=2^16) and raise clearly —
+    naming the limiting pairs — when no legal pair can fit."""
+    for logn in (25, 26, 27):
+        n = 1 << logn
+        R1, R2 = sixstep_auto_split(n, 1 << 16)
+        assert R1 * R2 == n >> 16 and R1 >= R2 >= 2
+        cb1, cb2 = sixstep_auto_cbs(n, 1 << 16)
+        for cb in (cb1, cb2):
+            assert cb % 128 == 0 and ((cb // 128) % 8 == 0
+                                      or cb == 1 << 16)
+        assert sixstep_vmem_bytes(R1, cb1, R2, cb2, 1 << 16) <= \
+            VMEM_LIMIT_BYTES
+    with pytest.raises(ValueError, match=r"R1=\d+ x cb1=\d+ / R2="):
+        sixstep_auto_cbs(1 << 26, 1 << 10)  # R1 = R2 = 256: nothing fits
+    with pytest.raises(ValueError, match="fourstep"):
+        sixstep_auto_split(1 << 17, 1 << 16)  # R=2: nothing to split
+
+
+def test_fourstep_wall_is_where_sixstep_starts():
+    """The documented boundary: fourstep's smallest legal column block
+    stops fitting VMEM exactly where the ladder's SIXSTEP_MIN_N sits,
+    and sixstep is feasible there."""
+    from cs87project_msolano2_tpu.ops.pallas_fft import fourstep_auto_cb
+    from cs87project_msolano2_tpu.plans import ladder
+
+    assert ladder.SIXSTEP_MIN_N == 1 << 25
+    fourstep_auto_cb(1 << 24, 1 << 16)  # last feasible fourstep n
+    with pytest.raises(ValueError, match="infeasible"):
+        fourstep_auto_cb(1 << 25, 1 << 16)
+    assert ladder._sixstep_feasible(1 << 25)
+    assert ladder._sixstep_feasible(1 << 27)
+
+
+# ----------------------------------------------- ladder and crossover
+
+
+def test_static_default_serves_sixstep_above_the_wall():
+    """n >= 2^25 keys must statically serve sixstep — never the silent
+    rql fallback the wall used to force — on hardware kinds AND for
+    offline pi-layout keys (which have no jnp equivalent)."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans import ladder
+
+    def variant(n, kind="TPU v5e", layout="pi"):
+        return ladder.static_default(
+            plans.make_key(n, layout=layout, device_kind=kind))[0]
+
+    assert variant(1 << 24) == "fourstep"  # below the wall: unchanged
+    for logn in (25, 26, 27):
+        assert variant(1 << logn) == "sixstep"
+    assert variant(1 << 26, kind="cpu-interpret") == "sixstep"
+    # offline natural keeps the jnp path, as at every other large n
+    assert variant(1 << 26, kind="cpu-interpret",
+                   layout="natural") == "jnp"
+
+
+def test_ladder_orders_sixstep_by_crossover():
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans import ladder
+
+    below = ladder.candidates(
+        plans.make_key(1 << 22, layout="pi", device_kind="TPU v5e"))
+    above = ladder.candidates(
+        plans.make_key(1 << 25, layout="pi", device_kind="TPU v5e"))
+    assert below[0][0] == "fourstep"     # fourstep leads below
+    assert above[0][0] == "sixstep"      # sixstep leads above
+    # sixstep is still raced below the crossover (a surprise win must
+    # be observable); neither fused nor fourstep appears above it
+    assert any(v == "sixstep" for v, _ in below)
+    assert not any(v.startswith("fused") or v == "fourstep"
+                   for v, _ in above)
+    # every sixstep entry builds an executor (params are coherent)
+    key25 = plans.make_key(1 << 25, layout="pi", device_kind="TPU v5e")
+    for v, p in above:
+        if v == "sixstep":
+            assert p["tile"] in (1 << 15, 1 << 16) and "separable" in p
+            ladder.build_executor(key25, v, p)
+
+
+def test_tune_sweep_reports_sixstep_crossover():
+    """Per-n crossover selection across BOTH boundaries: with an
+    injected timer making the first candidate win at every n, the
+    sweep's winners flip fused -> fourstep -> sixstep at the static
+    boundaries and both measured crossovers report accordingly."""
+    import itertools
+
+    from cs87project_msolano2_tpu import plans
+
+    cnt = itertools.count()
+    out, cross = plans.tune_sweep(
+        [1 << 20, 1 << 22, 1 << 25],
+        timer=lambda fn, key: 1.0 + next(cnt) * 1e-3,
+        allow_offline=True, persist=False, verbose=False)
+    assert [p.variant for p in out] == ["fused", "fourstep", "sixstep"]
+    assert cross == 1 << 22
+    assert plans.fourstep_crossover(out) == 1 << 22
+    assert plans.sixstep_crossover(out) == 1 << 25
+    assert plans.sixstep_crossover(out[:2]) is None
+
+
+def test_cli_sweep_reports_both_crossovers(monkeypatch, capsys):
+    """`pifft plan sweep` must surface the measured fourstep AND
+    sixstep crossovers (the sweep itself is monkeypatched: tuning is
+    refused offline by design)."""
+    from cs87project_msolano2_tpu import cli, plans
+    from cs87project_msolano2_tpu.plans.core import Plan
+
+    def fake_sweep(ns, **kw):
+        out = [Plan(key=plans.make_key(int(n), layout="pi"),
+                    variant=("sixstep" if n >= 1 << 25 else "fourstep"),
+                    params={}, source="tuned", ms=1.0) for n in ns]
+        return out, plans.fourstep_crossover(out)
+
+    monkeypatch.setattr(plans, "tune_sweep", fake_sweep)
+    rc = cli.plan_main(["sweep", "--ns", "2^22", "2^25"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "measured fourstep crossover: 4194304" in out
+    assert "measured sixstep crossover: 33554432" in out
+
+
+# ------------------------------------------------- degradation rung
+
+
+def test_degrade_chain_has_fourstep_rung():
+    from cs87project_msolano2_tpu.resilience.degrade import (
+        DEGRADE_CHAIN,
+        _rungs_after,
+    )
+
+    assert DEGRADE_CHAIN == ("fourstep", "rql", "jnp-fft", "numpy-ref")
+    assert _rungs_after("sixstep") == DEGRADE_CHAIN
+    # siblings do NOT demote sideways into fourstep
+    assert _rungs_after("fused") == ("rql", "jnp-fft", "numpy-ref")
+    assert _rungs_after("fourstep") == ("rql", "jnp-fft", "numpy-ref")
+    assert _rungs_after("two-kernel") == ("jnp-fft", "numpy-ref")
+
+
+def test_sixstep_demotes_to_fourstep_with_parity():
+    """A sixstep plan dying of a CAPACITY fault must land on the
+    fourstep rung with the demotion recorded — and keep computing the
+    right answer."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.plans.core import Plan
+    from cs87project_msolano2_tpu.resilience.degrade import (
+        resilient_executor,
+    )
+
+    n = 1 << 13
+    key = plans.make_key(n, layout="pi")
+    plan = Plan(key=key, variant="sixstep", params={"tile": 1 << 11},
+                source="static")
+
+    def dead(xr, xi):
+        raise RuntimeError("RESOURCE_EXHAUSTED: scoped vmem")
+
+    run = resilient_executor(plan, dead)
+    xr, xi = rand_planes(n, seed=47)
+    yr, yi = run(xr, xi)
+    assert plan.degraded and plan.demotions[-1]["to"] == "fourstep"
+    ref = np_pi_layout(xr.astype(np.complex128) + 1j * xi, n)
+    assert np.max(np.abs(to_complex(yr, yi) - ref)) / \
+        np.max(np.abs(ref)) < 1e-5
+
+
+def test_fourstep_rung_walks_past_the_wall():
+    """At n >= 2^25 the fourstep rung itself is infeasible (the whole
+    reason sixstep exists): build_rung must raise the explicit
+    feasibility error so the chain walker continues to rql — never an
+    opaque lowering failure."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience.degrade import build_rung
+
+    key = plans.make_key(1 << 25, layout="pi", device_kind="TPU v5e")
+    with pytest.raises(ValueError, match="infeasible"):
+        build_rung(key, "fourstep")
+    build_rung(key, "rql")  # the next rung down still builds
+
+
+# ------------------------------------------- roofline carry accounting
+
+
+def test_roofline_carry_pass_model():
+    from cs87project_msolano2_tpu.utils.roofline import (
+        fft_hbm_bytes,
+        fft_min_hbm_bytes,
+        plan_carry_passes,
+        roofline_ceiling,
+        roofline_utilization,
+    )
+
+    assert fft_min_hbm_bytes(1 << 20) == 16 << 20
+    assert fft_hbm_bytes(1 << 20, 0) == 16 << 20
+    assert fft_hbm_bytes(1 << 20, 1) == 32 << 20   # fourstep carry
+    assert fft_hbm_bytes(1 << 20, 2) == 48 << 20   # sixstep's two
+    assert plan_carry_passes("fused") == 0
+    assert plan_carry_passes("rows") == 0
+    assert plan_carry_passes("fourstep") == 1
+    assert plan_carry_passes("rql") == 1
+    assert plan_carry_passes("sixstep") == 2
+    assert plan_carry_passes("jnp-fft") is None  # unmodeled fallback
+    assert roofline_ceiling(0) == 1.0
+    assert roofline_ceiling(1) == pytest.approx(0.5)
+    assert roofline_ceiling(2) == pytest.approx(1 / 3)
+    assert roofline_ceiling(None) is None
+    # utilization stays on the min-traffic convention (comparable
+    # across rounds); carry passes move the CEILING, not the figure
+    u1 = roofline_utilization(1 << 24, 1.0, "TPU v5e")
+    u2 = roofline_utilization(1 << 24, 1.0, "TPU v5e", carry_passes=2)
+    assert u1 == u2 == pytest.approx((16 * (1 << 24)) / 1e-3 / 819e9)
+
+
+def test_roofline_bytes_meter_charges_carries(obs_run_metrics):
+    """The bytes-moved meter must charge the plan-declared traffic —
+    floor + carry round trips — not the bare floor."""
+    from cs87project_msolano2_tpu.obs import metrics
+    from cs87project_msolano2_tpu.utils.roofline import (
+        roofline_utilization,
+    )
+
+    roofline_utilization(1 << 10, 1.0, "TPU v5e", carry_passes=2)
+    snap = metrics.snapshot()["counters"]
+    tot = sum(v for k, v in snap.items()
+              if k.startswith("pifft_hbm_bytes_total"))
+    floor = sum(v for k, v in snap.items()
+                if k.startswith("pifft_hbm_min_bytes_total"))
+    assert floor == 16 * (1 << 10)
+    assert tot == 3 * floor
+
+
+@pytest.fixture
+def obs_run_metrics():
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.obs import metrics
+
+    obs.enable()
+    metrics.reset()
+    yield
+    obs.disable()
+    metrics.reset()
+
+
+# ------------------------------------------------------ obs span
+
+
+def test_sixstep_emits_phase_span():
+    """The sixstep entry runs under a named obs span carrying the
+    split/block metadata (a no-op while obs is disabled — covered by
+    the disabled-path tests in test_obs)."""
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.obs import events, metrics
+
+    obs.enable()
+    try:
+        n = 1 << 13
+        xr, xi = rand_planes(n, seed=48)
+        fft_pi_layout_pallas_sixstep(xr, xi, tile=1 << 11, tail=128)
+        recs = [r for r in events.span_snapshot()
+                if r["name"] == "sixstep"]
+        assert recs, events.span_snapshot()
+        cell = recs[-1]["cell"]
+        assert cell["n"] == n and cell["r1"] == 2 and cell["r2"] == 2
+    finally:
+        obs.disable()
+        metrics.reset()
