@@ -7,7 +7,7 @@
 SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
-        bench-chaos serve-smoke serve-slo replicate \
+        bench-chaos serve-smoke serve-slo multichip-smoke replicate \
         run-experiments run-experiments-and-analyze-results analyze \
         analyze-datasets check lint
 
@@ -113,6 +113,19 @@ serve-smoke:
 # smoke-sized here — drop --smoke for the real tier on hardware
 serve-slo:
 	PIFFT_PLAN_CACHE=off python3 bench.py --serve-load --smoke
+
+# the CI multichip check (docs/MULTICHIP.md): the four sharding
+# dryruns on a forced 8-device CPU host platform (incl. the asserted
+# collective_recovered window), then the injected-stall recovery loop —
+# a stalled supervised all_to_all must abort, reach fallback consensus,
+# escape to the communication-free pi-path, and produce a result
+# bit-identical to the healthy run, with every event schema-valid
+multichip-smoke:
+	JAX_PLATFORMS=cpu PIFFT_PLAN_CACHE=off \
+	  python3 -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	JAX_PLATFORMS=cpu PIFFT_PLAN_CACHE=off \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  python3 -m cs87project_msolano2_tpu.cli multichip smoke
 
 # project static analysis (check/ subsystem, docs/CHECKS.md): the
 # timing/retrace/Mosaic/plan-key invariants as AST rules, gated on the

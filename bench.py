@@ -532,20 +532,16 @@ def main(argv=None) -> int:
             journal.reset()
         # cells are keyed by name ("flagship", ...), so the journal
         # carries its run configuration and --resume refuses a
-        # mismatch: resuming a full-N bench from a smoke journal would
+        # mismatch (Journal.guard_config, shared with the harness
+        # sweeps): resuming a full-N bench from a smoke journal would
         # splice toy numbers into the headline record
-        config = {"n": n, "logns": list(logns), "smoke": bool(args.smoke)}
-        prior = journal.get("config")
-        if prior is not None:
-            prior = {k: prior.get(k) for k in config}
-            if prior != config:
-                print(f"error: journal {journal.path} was written by a "
-                      f"different bench configuration ({prior} != "
-                      f"{config}); use a fresh --journal or delete it",
-                      file=sys.stderr)
-                return 2
-        else:
-            journal.record("config", config)
+        try:
+            journal.guard_config(
+                {"n": n, "logns": list(logns), "smoke": bool(args.smoke)},
+                label="bench")
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     def cell(name, compute, probe_n=None):
         """compute() -> JSON-safe payload dict, checkpointed per cell.

@@ -531,6 +531,58 @@ class BlockingCallInAsyncServePath(Rule):
         return None
 
 
+@register
+class BareCollectiveCall(Rule):
+    id = "PIF108"
+    name = "bare-collective-call"
+    summary = ("collective dispatch in parallel/ goes through the "
+               "sanctioned parallel.collectives layer — a bare jax.lax "
+               "collective is a call site supervision cannot see")
+    invariant = ("MULTICHIP_r05 hung an 8-device all_to_all rendezvous "
+                 "with only a buried C++ log line as evidence; the "
+                 "supervision/escape discipline (docs/MULTICHIP.md) "
+                 "attaches at the parallel.collectives funnel point, "
+                 "so a collective called bare from jax.lax is "
+                 "invisible to the supervisor, missing from the "
+                 "communication-free escape's re-planning, and "
+                 "unaccounted in the recovered-stall events — the "
+                 "exact un-debuggable wedge the supervisor exists to "
+                 "end")
+    default_config = {
+        # an INCLUDE list like PIF107's: the collective funnel is the
+        # parallel package's discipline (kernel/model code never
+        # dispatches collectives; if it starts to, widening this list
+        # is the fix, not silence)
+        "paths": ("*/parallel/*",),
+        # the funnel itself is the one sanctioned call site
+        "exempt": ("*parallel/collectives.py",),
+        "collectives": ("jax.lax.all_to_all", "jax.lax.psum",
+                        "jax.lax.all_gather", "jax.lax.ppermute",
+                        "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+                        "jax.lax.psum_scatter", "jax.lax.pshuffle"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+        import os
+
+        norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+        if not any(fnmatch.fnmatch(norm, pat)
+                   for pat in config["paths"]):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve_call(node)
+            if target in config["collectives"]:
+                yield self.finding(
+                    ctx, node,
+                    f"bare `{target}` — route it through "
+                    f"parallel.collectives (the supervised funnel "
+                    f"point, docs/MULTICHIP.md) or justify with "
+                    f"# pifft: noqa[PIF108]")
+
+
 def _is_broad_handler(type_node, broad) -> bool:
     """Shared broad-handler predicate (PIF105 and PIF501)."""
     if type_node is None:

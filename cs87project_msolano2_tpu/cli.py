@@ -11,6 +11,8 @@
                                          [--events FILE] [--format F]
     python -m cs87project_msolano2_tpu serve [--smoke | --host H --port P]
                                          [--shapes FILE] [...]
+    python -m cs87project_msolano2_tpu multichip smoke [-n N]
+                                         [--deadline S] [--stall S]
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
@@ -49,6 +51,13 @@ batched kernel invocations over bounded backpressured queues, warmed
 from a served shape set (`--shapes`, the same JSONL `plan warm
 --shapes` takes) — a socket front by default, `--smoke` for the
 in-process CI gate (`make serve-smoke`).
+
+The `multichip` subcommand fronts the self-healing multichip layer
+(docs/MULTICHIP.md): `smoke` injects a stall into a supervised
+all_to_all on a simulated 8-device mesh and asserts the whole recovery
+loop — supervised abort, fallback consensus, the communication-free
+escape, a bit-identical result, schema-valid events — the second half
+of the `make multichip-smoke` CI gate.
 """
 
 from __future__ import annotations
@@ -384,6 +393,111 @@ def obs_main(argv) -> int:
     return 0
 
 
+def multichip_main(argv) -> int:
+    """`multichip smoke` — the one-command proof the self-healing
+    multichip loop works on THIS machine (docs/MULTICHIP.md): an
+    injected stall wedges the supervised all_to_all 2-D FFT, the
+    supervisor aborts it, all hosts agree on the fallback epoch, the
+    communication-free escape completes the run, and the result is
+    bit-identical to the healthy path — asserted, with the obs events
+    schema-validated.  The CI `make multichip-smoke` gate runs this
+    after the four dryruns."""
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu multichip",
+        description="exercise the collective supervision -> consensus "
+                    "-> communication-free escape recovery loop on a "
+                    "simulated 8-device mesh",
+    )
+    ap.add_argument("action", choices=("smoke",))
+    ap.add_argument("-n", type=int, default=64,
+                    help="2-D transform side (n x n)")
+    ap.add_argument("--deadline", type=float, default=0.2, metavar="S",
+                    help="supervision deadline for the stalled run")
+    ap.add_argument("--stall", type=float, default=1.0, metavar="S",
+                    help="injected stall duration")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from . import obs
+    from .obs.events import validate_event
+    from .parallel import fft2_sharded_resilient, make_mesh
+    from .resilience import inject
+
+    if len(jax.devices()) < 8:
+        print("error: multichip smoke needs >= 8 devices; on a CPU "
+              "host set XLA_FLAGS=--xla_force_host_platform_device_"
+              "count=8 and JAX_PLATFORMS=cpu (the make multichip-smoke "
+              "target does)", file=sys.stderr)
+        return 2
+    if not obs.enabled():
+        obs.enable()  # in-process buffer; the event asserts below
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((args.n, args.n))
+         + 1j * rng.standard_normal((args.n, args.n))
+         ).astype(np.complex64)
+
+    y_ok, rep_ok = fft2_sharded_resilient(x, mesh)
+    if rep_ok.escaped:
+        print("error: healthy run escaped — the mesh itself is wedged",
+              file=sys.stderr)
+        return 1
+    print(f"# healthy supervised all_to_all ok "
+          f"(waits={rep_ok.waits})")
+
+    with inject("collective", "stall", stall_s=args.stall):
+        y_esc, rep = fft2_sharded_resilient(
+            x, mesh, deadline_s=args.deadline, abort_waits=2)
+    ok = True
+    if not rep.escaped or not rep.degraded:
+        print(f"error: injected stall did not escape "
+              f"(escaped={rep.escaped})", file=sys.stderr)
+        ok = False
+    rungs = [t.get("to") for t in rep.trail]
+    if "collective_free" not in rungs:
+        print(f"error: degrade trail lacks the collective_free rung "
+              f"({rep.trail})", file=sys.stderr)
+        ok = False
+    if not np.array_equal(np.asarray(y_ok), np.asarray(y_esc)):
+        print("error: escaped result differs from the healthy path",
+              file=sys.stderr)
+        ok = False
+    ref = np.fft.fft2(x.astype(np.complex128))
+    err = float(np.max(np.abs(np.asarray(y_esc) - ref))
+                / np.max(np.abs(ref)))
+    if err > 1e-5:
+        print(f"error: escaped result wrong vs numpy (rel err "
+              f"{err:.2e})", file=sys.stderr)
+        ok = False
+    events = obs.snapshot()
+    kinds = {r.get("kind") for r in events}
+    for wanted in ("collective_heartbeat", "collective_abandoned",
+                   "fallback_consensus", "demotion",
+                   "collective_escape_completed"):
+        if wanted not in kinds:
+            print(f"error: event stream lacks {wanted!r}",
+                  file=sys.stderr)
+            ok = False
+    invalid = [p for r in events for p in validate_event(r)]
+    if invalid:
+        print(f"error: {len(invalid)} schema problem(s) in the event "
+              f"stream: {invalid[:3]}", file=sys.stderr)
+        ok = False
+    if not ok:
+        return 1
+    epochs = [r["payload"]["epoch"] for r in events
+              if r.get("kind") == "fallback_consensus"]
+    print(f"# injected stall ({args.stall:.1f}s vs {args.deadline:.1f}s "
+          f"deadline) -> supervised abort after {rep.waits} wait(s) -> "
+          f"consensus epoch {epochs[-1]} -> collective_free escape: "
+          f"result bit-identical, rel err vs numpy {err:.1e}")
+    print(f"# multichip smoke ok: degrade trail "
+          f"{[t['from'] + '->' + t['to'] for t in rep.trail]}, "
+          f"{len(events)} schema-valid events")
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -391,6 +505,8 @@ def main(argv=None) -> int:
         return plan_main(argv[1:])
     if argv and argv[0] == "faults":
         return faults_main(argv[1:])
+    if argv and argv[0] == "multichip":
+        return multichip_main(argv[1:])
     if argv and argv[0] == "obs":
         return obs_main(argv[1:])
     if argv and argv[0] == "serve":

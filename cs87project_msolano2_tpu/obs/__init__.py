@@ -44,6 +44,7 @@ from .events import (  # noqa: F401
     enabled,
     flush,
     run_id,
+    snapshot,
     validate_event,
 )
 from .profiler import trace  # noqa: F401

@@ -266,10 +266,18 @@ _REQUIRED = (("v", int), ("run", str), ("seq", int), ("kind", str),
              ("t", (int, float)))
 
 #: per-kind required payload fields (the generic envelope is enough for
-#: every other kind)
+#: every other kind).  The collective-supervision kinds
+#: (docs/MULTICHIP.md) are schema'd so the multichip-smoke gate can
+#: assert their shape, not just their presence: a recovered stall MUST
+#: carry its deadline-wait count, an abandonment its wait total, a
+#: consensus its epoch and verdict.
 _KIND_PAYLOAD = {
     "span": ("name", "ts_s", "dur_s", "tid"),
     "metrics": ("snapshot",),
+    "collective_recovered": ("label", "waits", "deadline_s"),
+    "collective_heartbeat": ("label", "waits", "deadline_s"),
+    "collective_abandoned": ("label", "waits", "deadline_s"),
+    "fallback_consensus": ("label", "epoch", "agreed"),
 }
 
 

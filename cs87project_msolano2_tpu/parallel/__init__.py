@@ -1,10 +1,25 @@
 """Multi-chip layer: meshes, the zero-collective sharded pi-FFT, DP-batched
-FFT, and the all_to_all 2-D FFT / 3-D Poisson configs."""
+FFT, the all_to_all 2-D FFT / 3-D Poisson configs — and their
+self-healing entries (collective supervision + the communication-free
+escape path + multihost fallback consensus, docs/MULTICHIP.md)."""
 
 from .mesh import how_many_devices, make_mesh, make_mesh2d  # noqa: F401
 from .pi_shard import pi_fft_sharded, pi_fft_sharded_batched  # noqa: F401
 from .batched import fft_batched_sharded  # noqa: F401
-from .fft2d import fft2_sharded  # noqa: F401
-from .poisson3d import poisson_solve_sharded  # noqa: F401
+from .fft2d import fft2_sharded, fft2_sharded_resilient  # noqa: F401
+from .poisson3d import (  # noqa: F401
+    poisson_solve_sharded,
+    poisson_solve_sharded_resilient,
+)
 from .batched import fft_batched_planes  # noqa: F401
 from .fft2d import fft2_sharded_planes  # noqa: F401
+from .escape import (  # noqa: F401
+    ShardedRunReport,
+    clear_unhealthy,
+    fft2_collective_free,
+    fft2_collective_free_planes,
+    poisson_solve_collective_free,
+    report_unhealthy,
+    run_with_escape,
+)
+from .multihost import agree_on_fallback  # noqa: F401

@@ -1,0 +1,29 @@
+"""The sanctioned collective-call layer (check rule PIF108).
+
+Every inter-chip collective this package dispatches goes through this
+module — the ONE funnel point where the supervision discipline
+(docs/MULTICHIP.md) attaches.  MULTICHIP_r05 hung an 8-device
+``all_to_all`` rendezvous with only a buried C++ log line as evidence;
+a collective call site scattered somewhere in parallel/ is a call site
+the supervisor cannot see, the escape path cannot re-plan around, and
+check rule PIF108 now flags.  Entry points that dispatch a collective
+arm supervision OUTSIDE jit (``resilience.supervise_collective`` /
+``collective_watchdog``) around the jitted call; the helpers here are
+the in-jit dispatch they guard.
+
+This module deliberately contains NO policy: tiled-transpose semantics
+only, so the escape path (parallel/escape.py) can reproduce the exact
+dataflow without the collective.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def all_to_all(v, axis: str, split_axis: int, concat_axis: int):
+    """Tiled ``all_to_all`` transpose over a named mesh axis — the
+    2-D FFT / Poisson slab transpose primitive (the collective the
+    r05 hang wedged)."""
+    return jax.lax.all_to_all(v, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)

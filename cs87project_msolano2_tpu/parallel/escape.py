@@ -1,0 +1,335 @@
+"""The communication-free escape path (docs/MULTICHIP.md): when a
+supervised collective wedges, re-plan the sharded 2-D FFT / Poisson
+dataflow onto the paper's pi-layout decomposition — funnel-style
+replicated input, per-chip local work, one final host-side reorder —
+and complete the run instead of hanging it.
+
+The escape reproduces the all_to_all paths' arithmetic EXACTLY, it only
+re-plans the data movement: every 1-D transform runs through the same
+per-shard-shape plan on the same values (the all_to_all path's
+per-device blocks become per-chip loop iterations over the replicated
+input — the paper's redundant-compute-instead-of-communication trade,
+…cuda.cu's broadcast-into-every-scratchpad made literal), so results
+are BIT-IDENTICAL to the primary path (asserted by
+tests/test_multichip_recovery.py) and the compiled HLO contains zero
+collective ops (same machine check as the sharded pi-FFT's
+collective-free test).  What is spent is p-fold redundant compute on
+the phases that previously communicated — the escape completes a run,
+it does not win a benchmark, and every escape is recorded as a
+``collective_free`` demotion in the degrade trail
+(resilience.degrade.note_collective_escape).
+
+Recovery loop (the resilient entry points in fft2d.py / poisson3d.py):
+
+1. the primary all_to_all path runs under
+   ``resilience.supervise_collective`` — heartbeats per deadline, abort
+   past the wait budget;
+2. on :class:`CollectiveAborted` / :class:`CollectiveTimeout` (or when
+   a device has been reported unhealthy, which skips the doomed attempt
+   entirely) all hosts agree on the fallback epoch first
+   (``multihost.agree_on_fallback`` — one host's escape must not strand
+   the others in the next rendezvous), then
+3. the escape body runs, the demotion is recorded, and the caller gets
+   the same values the primary path would have produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .. import plans
+from ..resilience import (
+    CollectiveAborted,
+    CollectiveTimeout,
+    FaultKind,
+    classify,
+    supervise_collective,
+)
+from ..resilience.degrade import note_collective_escape
+from ..utils.compat import shard_map
+
+# ------------------------------------------------------ device health
+#
+# "a device is reported unhealthy" is the OTHER trigger for the escape
+# (ISSUE: a stall is detected in-band; an unhealthy device is reported
+# out-of-band — by the operator, a prior aborted region, or platform
+# health checks).  The registry is process-local; the consensus step
+# keeps hosts from acting on it unilaterally.
+
+_UNHEALTHY: dict = {}
+
+
+def report_unhealthy(device, reason: str) -> None:
+    """Report a device unhealthy: subsequent resilient sharded calls on
+    a mesh containing it skip the doomed collective attempt and take
+    the escape path directly."""
+    _UNHEALTHY[str(device)] = str(reason)
+    from ..obs import events
+    from ..plans.core import warn
+
+    events.emit("device_unhealthy", device=str(device),
+                reason=str(reason)[:200])
+    warn(f"device {device} reported unhealthy ({reason}); resilient "
+         f"sharded paths will escape to collective_free")
+
+
+def clear_unhealthy() -> None:
+    _UNHEALTHY.clear()
+
+
+def unhealthy_in(mesh) -> dict:
+    """The unhealthy-device reports that apply to `mesh`."""
+    devs = {str(d) for d in np.asarray(mesh.devices).ravel()}
+    return {d: r for d, r in _UNHEALTHY.items() if d in devs}
+
+
+# ------------------------------------------------------- escape bodies
+
+
+def _fft2_escape_fn(mesh, axis: str, inverse: bool, R: int, C: int):
+    """The escape's sharded body for an (R, C) transform — exposed so
+    tests can lower it and machine-check the compiled HLO is
+    collective-free (the same check the sharded pi-FFT carries)."""
+    p = mesh.shape[axis]
+    row_plan = plans.plan_for((R // p, C))
+    col_plan = plans.plan_for((C // p, R))
+
+    def run(plan, br, bi):
+        if inverse:
+            return plan.execute_inverse(br, bi)
+        return plan.execute(br, bi)
+
+    def device_fn(br, bi):  # (R, C) planes, replicated
+        # row pass: per row-block j, EXACTLY the primary path's
+        # per-device row transform (same plan, same block) — the
+        # redundancy buys zero communication
+        rp = R // p
+        rows = [run(row_plan, br[j * rp:(j + 1) * rp],
+                    bi[j * rp:(j + 1) * rp]) for j in range(p)]
+        yr = jnp.concatenate([r[0] for r in rows], axis=0)
+        yi = jnp.concatenate([r[1] for r in rows], axis=0)
+        # this chip's column block (a local dynamic slice of the
+        # replicated intermediate — the transpose that used to be an
+        # all_to_all rendezvous)
+        i = jax.lax.axis_index(axis)
+        cp = C // p
+        yr = jax.lax.dynamic_slice_in_dim(yr, i * cp, cp, axis=1)
+        yi = jax.lax.dynamic_slice_in_dim(yi, i * cp, cp, axis=1)
+        cr, ci = run(col_plan, jnp.swapaxes(yr, 0, 1),
+                     jnp.swapaxes(yi, 0, 1))
+        return jnp.swapaxes(cr, 0, 1), jnp.swapaxes(ci, 0, 1)
+
+    return shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(P(None, None), P(None, None)),
+        out_specs=(P(None, axis), P(None, axis)),
+        # check=False: same Pallas-HLO-interpreter workaround as the
+        # primary path (parallel/fft2d.py)
+        check=False,
+    )
+
+
+def fft2_collective_free_planes(xr, xi, mesh, axis: str = "p",
+                                inverse: bool = False):
+    """2-D FFT on (R, C) re/im planes with ZERO collectives — the
+    escape body for ``fft2_sharded_planes``.
+
+    Dataflow: the input is staged to the host and fed back replicated
+    (the funnel trade: every chip holds the whole problem).  Each chip
+    runs the row pass for ALL p row blocks through the SAME per-shard
+    row plan the primary path uses (p-fold redundant, bit-identical
+    values), transposes locally, slices ITS column block, and runs the
+    same per-shard column plan.  One final host-side reorder lands the
+    result in the primary path's row-sharded contract.  R and C must
+    be divisible by the axis size."""
+    xr = np.asarray(xr, dtype=np.float32)  # host staging (no collective)
+    xi = np.asarray(xi, dtype=np.float32)
+    R, C = xr.shape
+    fn = _fft2_escape_fn(mesh, axis, inverse, R, C)
+    # under jit, like the primary path: XLA compiles the shared
+    # per-block stage arithmetic bit-identically across programs ONLY
+    # jit-to-jit (eager dispatch rounds differently) — and bit-parity
+    # with the primary path is this module's contract
+    yr, yi = jax.jit(fn)(xr, xi)
+    # the one final host-side reorder: land in the primary path's
+    # row-sharded contract without any device collective
+    out = NamedSharding(mesh, P(axis, None))
+    return (jax.device_put(np.asarray(yr), out),
+            jax.device_put(np.asarray(yi), out))
+
+
+def fft2_collective_free(x, mesh, axis: str = "p",
+                         inverse: bool = False):
+    """Complex-API wrapper over :func:`fft2_collective_free_planes`."""
+    from ..models.fft import jax_complex
+
+    x = jnp.asarray(x)
+    yr, yi = fft2_collective_free_planes(
+        jnp.real(x).astype(jnp.float32), jnp.imag(x).astype(jnp.float32),
+        mesh, axis, inverse,
+    )
+    return jax_complex(yr, yi)
+
+
+def _poisson_escape_fn(mesh, axis: str, n1: int, n2: int, n3: int):
+    """The Poisson escape's sharded body — exposed for the compiled-HLO
+    collective-free machine check (see :func:`_fft2_escape_fn`)."""
+    from .poisson3d import _fft_axis, _wavenumbers
+
+    p = mesh.shape[axis]
+    k1 = _wavenumbers(n1)
+    k2 = _wavenumbers(n2)
+    k3 = _wavenumbers(n3)
+    s1, s2 = n1 // p, n2 // p
+
+    def device_fn(fb):  # (n1, n2, n3) real, replicated
+        # phase 1, per slab j: the primary path's per-device forward
+        # FFTs over axes 1-2 (identical plan keys and values)
+        blocks = []
+        for j in range(p):
+            gr = fb[j * s1:(j + 1) * s1]
+            gi = jnp.zeros_like(gr)
+            gr, gi = _fft_axis(gr, gi, 2, False)
+            gr, gi = _fft_axis(gr, gi, 1, False)
+            blocks.append((gr, gi))
+        gr = jnp.concatenate([b[0] for b in blocks], axis=0)
+        gi = jnp.concatenate([b[1] for b in blocks], axis=0)
+        # phase 2, per n2-block j: the primary path's post-transpose
+        # axis-0 transform + spectral multiplier + inverse (the
+        # multiplier slice is block j's — the same values the a2a path
+        # computes on device j)
+        cols = []
+        for j in range(p):
+            hr = gr[:, j * s2:(j + 1) * s2]
+            hi = gi[:, j * s2:(j + 1) * s2]
+            hr, hi = _fft_axis(hr, hi, 0, False)
+            k2_loc = jnp.asarray(k2)[j * s2:(j + 1) * s2]
+            ksq = (
+                jnp.asarray(k1)[:, None, None] ** 2
+                + k2_loc[None, :, None] ** 2
+                + jnp.asarray(k3)[None, None, :] ** 2
+            )
+            inv = jnp.where(ksq > 0, -1.0 / jnp.maximum(ksq, 1e-30), 0.0)
+            hr, hi = hr * inv, hi * inv
+            hr, hi = _fft_axis(hr, hi, 0, True)
+            cols.append((hr, hi))
+        gr = jnp.concatenate([c[0] for c in cols], axis=1)
+        gi = jnp.concatenate([c[1] for c in cols], axis=1)
+        # phase 3: THIS chip's slab only — the output is slab-sharded
+        # exactly like the primary path's
+        i = jax.lax.axis_index(axis)
+        gr = jax.lax.dynamic_slice_in_dim(gr, i * s1, s1, axis=0)
+        gi = jax.lax.dynamic_slice_in_dim(gi, i * s1, s1, axis=0)
+        gr, gi = _fft_axis(gr, gi, 1, True)
+        gr, gi = _fft_axis(gr, gi, 2, True)
+        return gr
+
+    return shard_map(
+        device_fn, mesh=mesh, in_specs=(P(None, None, None),),
+        out_specs=P(axis, None, None),
+        check=False,  # see fft2_collective_free_planes
+    )
+
+
+def poisson_solve_collective_free(f, mesh, axis: str = "p"):
+    """Slab Poisson solve with ZERO collectives — the escape body for
+    ``poisson_solve_sharded``.
+
+    Every phase of the primary path's per-device pipeline is replayed
+    as a loop over the corresponding blocks of the replicated input
+    (same plan keys, same multiplier slices — bit-identical values);
+    each chip then keeps only ITS slab for the final inverse passes, so
+    the output lands directly in the primary path's slab-sharded
+    contract."""
+    f = np.asarray(f, dtype=np.float32)  # host staging (no collective)
+    n1, n2, n3 = f.shape
+    fn = _poisson_escape_fn(mesh, axis, n1, n2, n3)
+    # jit for bit-parity with the jitted primary (see the 2-D path)
+    return jax.jit(fn)(f)
+
+
+# --------------------------------------------------- the recovery loop
+
+
+@dataclasses.dataclass
+class ShardedRunReport:
+    """What the resilient sharded entry points did: whether the run
+    ``escaped`` to the collective-free path (``degraded`` mirrors it —
+    the performance contract changed, the values did not), the
+    supervisor's deadline-wait count, the consensus ``epoch`` (None
+    when no escape happened), and the demotion ``trail``."""
+
+    label: str
+    escaped: bool = False
+    degraded: bool = False
+    waits: int = 0
+    epoch: Optional[int] = None
+    trail: list = dataclasses.field(default_factory=list)
+
+    def to_record(self) -> dict:
+        return {"label": self.label, "escaped": self.escaped,
+                "degraded": self.degraded, "waits": self.waits,
+                "epoch": self.epoch, "trail": list(self.trail)}
+
+
+def run_with_escape(primary: Callable, escape: Callable, label: str,
+                    mesh, tagged_plans=(),
+                    deadline_s: float | None = None,
+                    abort_waits: Optional[int] = None,
+                    supervise: bool = True):
+    """THE recovery loop (module docstring): supervise `primary`; on a
+    wedged or doomed collective, reach consensus, record the
+    ``collective_free`` demotion (tagging `tagged_plans` like any other
+    demotion), and run `escape`.  Returns ``(value,
+    ShardedRunReport)``.
+
+    Faults that are NOT collective stalls propagate unchanged — a
+    capacity fault inside the primary body belongs to the plan
+    degradation chain, not to the transport escape."""
+    from .multihost import agree_on_fallback
+
+    report = ShardedRunReport(label)
+    unhealthy = unhealthy_in(mesh)
+    if unhealthy:
+        exc: BaseException = CollectiveTimeout(
+            f"{label}: device(s) reported unhealthy before dispatch: "
+            + "; ".join(f"{d} ({r})" for d, r in unhealthy.items()))
+    else:
+        if not supervise:
+            return primary(), report
+        try:
+            value, sup = supervise_collective(
+                primary, label, deadline_s=deadline_s,
+                abort_waits=abort_waits)
+            report.waits = sup.fired
+            return value, report
+        except (CollectiveAborted, CollectiveTimeout) as e:
+            exc = e
+            sup = getattr(e, "report", None)
+            if sup is not None:
+                report.waits = sup.fired
+    # all hosts agree on the fallback epoch BEFORE anyone switches —
+    # one host escaping alone would strand the rest in the next
+    # rendezvous (docs/MULTICHIP.md, consensus protocol)
+    report.epoch = agree_on_fallback(label, reason=str(exc)[:200],
+                                     deadline_s=deadline_s)
+    kind = classify(exc)
+    if kind is None:  # pragma: no cover — classify always returns
+        kind = FaultKind.TRANSIENT
+    report.trail.append(
+        note_collective_escape(label, exc, kind, plans=tagged_plans))
+    report.escaped = True
+    report.degraded = True
+    value = escape()
+    from ..obs import events
+
+    events.emit("collective_escape_completed", label=label,
+                epoch=report.epoch, waits=report.waits)
+    return value, report

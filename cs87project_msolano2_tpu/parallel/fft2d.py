@@ -5,7 +5,12 @@ This is the one place the framework genuinely needs communication — the
 2-D transform's data dependencies span both axes — and per SURVEY.md §2.3
 it uses the XLA collective over ICI (tiled all_to_all), not a
 point-to-point port of anything in the reference (which has no multi-node
-path at all).
+path at all).  The collective is dispatched through the sanctioned
+``parallel.collectives`` funnel (check rule PIF108), and
+:func:`fft2_sharded_resilient` wraps the whole path in the self-healing
+loop — collective supervision, fallback consensus, and the
+communication-free escape (docs/MULTICHIP.md) — so the MULTICHIP_r05
+wedge completes instead of hanging.
 
 Internals run on split re/im float32 planes (the TPU-native
 representation; also required because the axon relay cannot lower
@@ -26,11 +31,7 @@ from jax.sharding import PartitionSpec as P
 from .. import plans
 from ..models.fft import jax_complex
 from ..utils.compat import shard_map
-
-
-def _a2a(v, axis, split_axis, concat_axis):
-    return jax.lax.all_to_all(v, axis, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=True)
+from .collectives import all_to_all as _a2a
 
 
 def fft2_sharded_planes(xr, xi, mesh, axis: str = "p",
@@ -84,3 +85,46 @@ def fft2_sharded(x, mesh, axis: str = "p", inverse: bool = False):
         mesh, axis, inverse,
     )
     return jax_complex(yr, yi)
+
+
+def fft2_sharded_resilient(x, mesh, axis: str = "p",
+                           inverse: bool = False,
+                           deadline_s: float | None = None,
+                           abort_waits: int | None = None):
+    """Self-healing 2-D FFT: the all_to_all path under collective
+    supervision, escaping to the communication-free pi-path when the
+    transpose wedges or a mesh device is unhealthy
+    (docs/MULTICHIP.md).  Returns ``(y, ShardedRunReport)`` — `y` is
+    bit-identical either way; the report says whether the run escaped
+    (``degraded`` / a ``collective_free`` rung in ``trail``)."""
+    from .escape import fft2_collective_free_planes, run_with_escape
+
+    x = jnp.asarray(x)
+    xr = jnp.real(x).astype(jnp.float32)
+    xi = jnp.imag(x).astype(jnp.float32)
+    p = mesh.shape[axis]
+    R, C = xr.shape
+    # the plans the escape tags with the demotion (the same objects the
+    # primary and escape bodies resolve: plan_for memoizes per key)
+    tagged = (plans.plan_for((R // p, C)), plans.plan_for((C // p, R)))
+
+    def primary():
+        from ..utils.timing import block
+
+        # jitted like the escape body: XLA's per-block arithmetic is
+        # bit-stable jit-to-jit, which is what makes the escape's
+        # bit-parity contract hold (parallel/escape.py).  block():
+        # the supervised region must contain the collective's
+        # completion, not just its dispatch.
+        return block(jax.jit(
+            lambda a, b: fft2_sharded_planes(a, b, mesh, axis, inverse)
+        )(xr, xi))
+
+    def escape():
+        return fft2_collective_free_planes(xr, xi, mesh, axis, inverse)
+
+    (yr, yi), report = run_with_escape(
+        primary, escape, f"fft2d all_to_all ({R}x{C}, p={p})", mesh,
+        tagged_plans=tagged, deadline_s=deadline_s,
+        abort_waits=abort_waits)
+    return jax_complex(yr, yi), report

@@ -25,7 +25,9 @@ re-exported here so parallel callers need only this module.
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -33,10 +35,12 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..resilience import (  # noqa: F401  (re-exports: rendezvous discipline)
+    CollectiveAborted,
     CollectiveTimeout,
     HostDesyncError,
     collective_watchdog,
     rendezvous_deadline_s,
+    supervise_collective,
 )
 
 
@@ -107,3 +111,88 @@ def init_distributed(coordinator: Optional[str] = None,
 def global_mesh(axis: str = "p") -> Mesh:
     """1-D mesh over every device in the (possibly multi-host) job."""
     return Mesh(np.array(jax.devices()), (axis,))
+
+
+# ------------------------------------------------- fallback consensus
+#
+# Degradation must be MULTIHOST-CONSISTENT: if one host escapes to the
+# collective-free path while another retries the all_to_all, the
+# retrying hosts enter the next rendezvous with a participant that
+# will never arrive — the escape itself would manufacture the exact
+# r05 wedge it exists to cure.  So before ANY host switches, all hosts
+# agree on the fallback epoch through the coordination service's KV
+# store + barrier, with its own bounded timeout: either everyone
+# switches, or the consensus failure surfaces as a classified
+# HostDesyncError (PERMANENT — no local retry can reconcile a split
+# brain) instead of a silent split.
+
+_EPOCH_COUNTER = itertools.count(1)
+_EPOCH_LOCK = threading.Lock()
+
+
+def _distributed_client():
+    """The process's coordination-service client, or None outside a
+    multi-process job.  jax's internal location has been stable across
+    the supported releases; treat any import/attr drift as
+    single-process (the consensus then short-circuits locally, which
+    is correct there)."""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client
+    except Exception:  # pragma: no cover - import drift  # pifft: noqa[PIF501]
+        return None
+
+
+def agree_on_fallback(label: str, reason: str = "",
+                      deadline_s: Optional[float] = None,
+                      client=None, processes: Optional[int] = None) -> int:
+    """All-hosts agreement on the next fallback epoch; returns the
+    agreed epoch.
+
+    Single-process jobs (and the virtual-mesh test path) agree
+    trivially.  In a multi-process job every host publishes its intent
+    under ``pifft/fallback/<epoch>/<pid>`` and waits at the
+    ``pifft-fallback-<epoch>`` barrier with a bounded timeout (the
+    rendezvous deadline): hosts that went through the same sequence of
+    escapes hold the same epoch counter, so a barrier that forms means
+    every host is switching together — and one that does not raises
+    :class:`HostDesyncError` within the deadline instead of stranding
+    the fast host.  `client`/`processes` are injectable for tests."""
+    from ..obs import events, spans
+
+    with _EPOCH_LOCK:
+        epoch = next(_EPOCH_COUNTER)
+    deadline = float(deadline_s if deadline_s is not None
+                     else rendezvous_deadline_s())
+    if client is None:
+        client = _distributed_client()
+    if processes is None:
+        processes = jax.process_count() if client is not None else 1
+    with spans.span("collective:fallback_consensus", epoch=epoch,
+                    deadline_s=deadline):
+        if client is None or processes <= 1:
+            events.emit("fallback_consensus", label=label, epoch=epoch,
+                        agreed=True, processes=1,
+                        reason=str(reason)[:200])
+            return epoch
+        try:
+            client.key_value_set(
+                f"pifft/fallback/{epoch}/{jax.process_index()}",
+                f"{label}: {reason}"[:512])
+            client.wait_at_barrier(f"pifft-fallback-{epoch}",
+                                   timeout_in_ms=max(
+                                       int(deadline * 1000), 1))
+        except Exception as e:
+            events.emit("fallback_consensus", label=label, epoch=epoch,
+                        agreed=False, processes=processes,
+                        error=f"{type(e).__name__}: {str(e)[:200]}")
+            raise HostDesyncError(
+                f"fallback consensus for epoch {epoch} at {label} did "
+                f"not form within {deadline:.0f}s — hosts may be split "
+                f"between the all_to_all and collective_free paths "
+                f"({type(e).__name__}: {str(e)[:200]})") from e
+        events.emit("fallback_consensus", label=label, epoch=epoch,
+                    agreed=True, processes=processes,
+                    reason=str(reason)[:200])
+        return epoch

@@ -4,7 +4,10 @@ config 5): solve lap(u) = f on a periodic [0, 2*pi)^3 grid.
 Slabs are sharded along axis 0.  Per slab: local FFT over axes 1-2, one
 all_to_all transpose to localize axis 0, FFT over axis 0, multiply by
 -1/|k|^2 (zero mode -> 0: the mean-free solution), then invert the
-pipeline.  Two ICI transposes per solve — the textbook slab pattern.
+pipeline.  Two ICI transposes per solve — the textbook slab pattern —
+both dispatched through the sanctioned ``parallel.collectives`` funnel
+(PIF108); :func:`poisson_solve_sharded_resilient` adds the
+supervision/consensus/escape recovery loop (docs/MULTICHIP.md).
 
 All spectral arithmetic runs on split re/im float32 planes: the
 multiplier is real, so the whole pipeline is float ops — TPU-native and
@@ -25,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import plans
 from ..utils.compat import shard_map
+from .collectives import all_to_all as _a2a
 
 
 def _wavenumbers(m: int) -> np.ndarray:
@@ -58,8 +62,7 @@ def poisson_solve_sharded(f, mesh, axis: str = "p"):
     k3 = _wavenumbers(n3)
 
     def a2a(v, split_axis, concat_axis):
-        return jax.lax.all_to_all(v, axis, split_axis=split_axis,
-                                  concat_axis=concat_axis, tiled=True)
+        return _a2a(v, axis, split_axis, concat_axis)
 
     def device_fn(fb):  # (n1/p, n2, n3) real
         gr, gi = fb, jnp.zeros_like(fb)
@@ -103,3 +106,35 @@ def poisson_solve_sharded(f, mesh, axis: str = "p"):
         check=False,
     )
     return fn(f)
+
+
+def poisson_solve_sharded_resilient(f, mesh, axis: str = "p",
+                                    deadline_s: float | None = None,
+                                    abort_waits: int | None = None):
+    """Self-healing slab Poisson solve: the two-transpose all_to_all
+    pipeline under collective supervision, escaping to the
+    communication-free pi-path when a transpose wedges or a mesh
+    device is unhealthy (docs/MULTICHIP.md).  Returns ``(u,
+    ShardedRunReport)`` — `u` is bit-identical either way."""
+    from .escape import poisson_solve_collective_free, run_with_escape
+
+    f = jnp.asarray(f)
+    n1, n2, n3 = f.shape
+    p = mesh.shape[axis]
+
+    def primary():
+        from ..utils.timing import block
+
+        # jitted like the escape body (bit-parity: parallel/escape.py);
+        # block(): the supervised region must contain the transposes'
+        # completion, not just their dispatch
+        return block(
+            jax.jit(lambda v: poisson_solve_sharded(v, mesh, axis))(f))
+
+    def escape():
+        return poisson_solve_collective_free(f, mesh, axis)
+
+    return run_with_escape(
+        primary, escape,
+        f"poisson3d all_to_all ({n1}x{n2}x{n3}, p={p})", mesh,
+        deadline_s=deadline_s, abort_waits=abort_waits)

@@ -26,10 +26,15 @@ place that discipline lives:
                  env or the :func:`inject` context manager) with sites
                  in ops/plans/parallel/bench, so every policy above is
                  testable on CPU in tier-1.
-* ``watchdog`` — :func:`collective_watchdog`: a configurable rendezvous
-                 deadline surfaced as a structured
-                 :class:`CollectiveTimeout` diagnostic instead of a
-                 buried C++ log line.
+* ``watchdog`` — collective supervision (docs/MULTICHIP.md):
+                 :func:`collective_watchdog` (warn-only deadline with
+                 ``collective_recovered`` accounting) and
+                 :func:`supervise_collective` (per-collective
+                 heartbeats, straggler notes, and a supervised abort
+                 via :class:`CancellationToken` /
+                 :class:`CollectiveAborted` that the sharded paths
+                 catch to escape onto the communication-free
+                 pi-path — the ``collective_free`` degrade rung).
 * ``journal``  — atomic per-cell JSONL checkpointing behind
                  ``bench.py --resume`` and the harness sweeps.
 
@@ -38,7 +43,12 @@ See docs/RESILIENCE.md for the full ladder and the chaos-smoke CI gate.
 
 from __future__ import annotations
 
-from .degrade import DEGRADE_CHAIN, resilient_executor  # noqa: F401
+from .degrade import (  # noqa: F401
+    COLLECTIVE_FREE_RUNG,
+    DEGRADE_CHAIN,
+    note_collective_escape,
+    resilient_executor,
+)
 from .inject import (  # noqa: F401
     KINDS,
     KNOWN_SITES,
@@ -57,6 +67,7 @@ from .retry import (  # noqa: F401
 )
 from .taxonomy import (  # noqa: F401
     CapacityError,
+    CollectiveAborted,
     CollectiveTimeout,
     FaultKind,
     HostDesyncError,
@@ -66,4 +77,11 @@ from .taxonomy import (  # noqa: F401
     classify,
     wrap,
 )
-from .watchdog import collective_watchdog, rendezvous_deadline_s  # noqa: F401
+from .watchdog import (  # noqa: F401
+    CancellationToken,
+    SupervisionReport,
+    WatchdogReport,
+    collective_watchdog,
+    rendezvous_deadline_s,
+    supervise_collective,
+)

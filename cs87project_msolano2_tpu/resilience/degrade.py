@@ -43,6 +43,17 @@ from .taxonomy import FaultKind, classify
 #: the demotion ladder, weakest-demand last (docs/RESILIENCE.md)
 DEGRADE_CHAIN = ("fourstep", "rql", "jnp-fft", "numpy-ref")
 
+#: the TRANSPORT demotion rung (docs/MULTICHIP.md): not a kernel in the
+#: 1-D chain above but the sharded paths' escape — when a supervised
+#: collective is aborted (or a device is reported unhealthy), the
+#: all_to_all 2-D FFT / Poisson dataflow re-plans onto the pi-layout
+#: funnel-replicated/tube-local decomposition (per-chip local work, one
+#: final host-side reorder; parallel/escape.py).  Recorded through
+#: :func:`note_collective_escape` with the same record shape, events,
+#: and plan tagging as every kernel demotion, so a ``collective_free``
+#: rung shows up in the degrade trail exactly like ``rql`` would.
+COLLECTIVE_FREE_RUNG = "collective_free"
+
 #: parameters for the rql rung: auto tile/cb (always lowerable at any
 #: feasible n) and the short-tile-safe tail
 _RQL_PARAMS = {"tile": None, "cb": None, "tail": 128}
@@ -189,6 +200,42 @@ def _note_demotion(plan, from_variant: str, rung: str,
     # session-visible trail lives on the memoized plan, the warn line,
     # and the bench record's degraded tags.
     cache.memoize(plan)
+
+
+def note_collective_escape(label: str, exc: BaseException,
+                           kind: FaultKind, plans=()) -> dict:
+    """Record ONE transport demotion: a supervised collective at `label`
+    was abandoned (or its devices reported unhealthy) and the run
+    escaped onto the communication-free pi-path.  Returns the demotion
+    record (``{"from": "all_to_all", "to": "collective_free", ...}``)
+    and tags it onto every plan in `plans` exactly like a kernel
+    demotion — a run that escaped is never mistaken for a healthy one.
+    """
+    from ..plans import cache
+    from ..plans.core import warn
+
+    record = {
+        "from": "all_to_all",
+        "to": COLLECTIVE_FREE_RUNG,
+        "kind": kind.value,
+        "reason": f"{type(exc).__name__}: {str(exc)[:200]}",
+        "site": label,
+    }
+    for plan in plans:
+        plan.degraded = True
+        plan.demotions.append(dict(record))
+        # in-process cache only, like _note_demotion: an escape is a
+        # property of this session's mesh, not of the tuned kernel
+        cache.memoize(plan)
+    from ..obs import events, metrics
+
+    metrics.inc("pifft_demotions_total", to=COLLECTIVE_FREE_RUNG)
+    events.emit("demotion", cell={"site": label}, **record)
+    warn(f"collective ESCAPED all_to_all -> {COLLECTIVE_FREE_RUNG} at "
+         f"{label} ({kind.value}: {record['reason']}) — per-chip local "
+         f"work with one final host-side reorder; results stay "
+         f"bit-identical, the ICI transpose does not run")
+    return record
 
 
 def resilient_executor(plan, raw: Callable) -> Callable:

@@ -12,10 +12,15 @@ Arming:
 
 * environment — ``PIFFT_FAULT=<site>:<kind>[:<prob>[:<count>]]``,
   comma-separated for multiple specs; ``site`` is an fnmatch pattern,
-  ``kind`` one of transient/capacity/permanent/timeout, ``prob``
+  ``kind`` one of transient/capacity/permanent/timeout/stall, ``prob``
   defaults to 1.0, ``count`` caps total firings (unlimited when
   omitted).  ``PIFFT_FAULT=tube:capacity:1.0`` is the chaos-smoke CI
-  configuration (make bench-chaos).
+  configuration (make bench-chaos).  ``stall`` faults DELAY instead of
+  raising — ``stall=<seconds>`` in the kind token sets the duration
+  (``PIFFT_FAULT=collective:stall=2.0:1.0:1`` wedges the first
+  collective for 2 s, the multichip-smoke recovery configuration) —
+  which is how the whole supervised-abort/escape loop is exercised on
+  CPU (docs/MULTICHIP.md).
 * in-process — the :func:`inject` context manager, which tests use to
   scope a fault to one call.
 
@@ -31,6 +36,7 @@ import dataclasses
 import fnmatch
 import os
 import random
+import time
 from contextlib import contextmanager
 from typing import Optional
 
@@ -45,8 +51,12 @@ KNOWN_SITES = {
     "resolve": "models.pi_fft.resolve_tube_plan (tube-plan resolution "
                "for the sharded paths)",
     "shard": "parallel.pi_shard sharded pi-FFT entries",
-    "collective": "resilience.watchdog.collective_watchdog arm point "
-                  "(parallel/multihost.py rendezvous discipline)",
+    "collective": "collective supervision: the collective_watchdog arm "
+                  "point and the supervise_collective worker entry "
+                  "(parallel/multihost.py rendezvous discipline; stall "
+                  "faults here wedge the supervised region itself, "
+                  "driving the abort/escape recovery loop — "
+                  "docs/MULTICHIP.md)",
     "bench": "bench.py measurement loops",
     "harness": "harness/run_experiments.py sweep cells",
     "serve": "serve/batcher.py tuned-kernel batch invocation (the "
@@ -55,7 +65,12 @@ KNOWN_SITES = {
              "docs/SERVING.md)",
 }
 
-KINDS = ("transient", "capacity", "permanent", "timeout")
+KINDS = ("transient", "capacity", "permanent", "timeout", "stall")
+
+#: default injected-stall duration; long enough that a test-sized
+#: supervision deadline (tenths of a second) expires at least once
+#: inside it, short enough that tier-1 stays fast
+DEFAULT_STALL_S = 1.0
 
 
 class InjectedFault(RuntimeError):
@@ -80,13 +95,17 @@ _TEMPLATES = {
 @dataclasses.dataclass
 class FaultSpec:
     """One armed fault: fnmatch `site` pattern, `kind`, firing
-    probability, optional total-firing cap, and the firing counter."""
+    probability, optional total-firing cap, and the firing counter.
+    ``stall`` faults DELAY instead of raising — ``stall_s`` is the
+    injected delay (``stall=2.5`` in the kind token overrides the
+    default)."""
 
     site: str
     kind: str
     prob: float = 1.0
     count: Optional[int] = None
     fired: int = 0
+    stall_s: float = DEFAULT_STALL_S
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
@@ -95,12 +114,25 @@ class FaultSpec:
             raise ValueError(
                 f"bad fault spec {text!r} (want site:kind[:prob[:count]])")
         kind = parts[1].lower()
+        stall_s = DEFAULT_STALL_S
+        if kind.startswith("stall="):
+            kind, _, secs = kind.partition("=")
+            try:
+                stall_s = float(secs)
+            except ValueError:
+                raise ValueError(f"bad stall duration {secs!r} in "
+                                 f"{text!r} (want stall=<seconds>)")
+            if not stall_s > 0:
+                raise ValueError(f"stall duration must be > 0, got "
+                                 f"{stall_s} in {text!r}")
         if kind not in KINDS:
             raise ValueError(f"bad fault kind {parts[1]!r} "
-                             f"(want one of {KINDS})")
+                             f"(want one of {KINDS}, stall takes an "
+                             f"optional stall=<seconds>)")
         prob = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
         count = int(parts[3]) if len(parts) > 3 and parts[3] else None
-        return cls(site=parts[0], kind=kind, prob=prob, count=count)
+        return cls(site=parts[0], kind=kind, prob=prob, count=count,
+                   stall_s=stall_s)
 
     def exhausted(self) -> bool:
         return self.count is not None and self.fired >= self.count
@@ -153,30 +185,44 @@ def _raise_for(spec: FaultSpec, site: str) -> None:
 
 
 def maybe_fault(site: str) -> None:
-    """The probe: raise the armed fault for `site`, if any fires.
+    """The probe: raise (or, for ``stall`` specs, DELAY) the armed
+    fault for `site`, if any fires.
 
     Near-zero cost when nothing is armed.  Probes run at Python call /
     trace time (never inside traced computation), so an injected fault
     propagates exactly like a real compile-time or dispatch failure —
-    catchable by the retry and degradation layers under test."""
+    catchable by the retry and degradation layers under test.  A stall
+    sleeps ``spec.stall_s`` and then lets the probe continue: the site
+    proceeds late, which is exactly the r05 stuck-then-unstuck shape
+    the collective supervisor exists to detect and recover from."""
     if not _SCOPED and not _env_specs():
         return
     for spec in active_specs():
         if spec.exhausted() or not fnmatch.fnmatch(site, spec.site):
             continue
         if spec.prob >= 1.0 or _RNG.random() < spec.prob:
+            if spec.kind == "stall":
+                spec.fired += 1
+                time.sleep(spec.stall_s)
+                continue  # a stall delays; it never raises
             _raise_for(spec, site)
 
 
 @contextmanager
 def inject(site: str, kind: str, prob: float = 1.0,
-           count: Optional[int] = None):
+           count: Optional[int] = None,
+           stall_s: float = DEFAULT_STALL_S):
     """Scope a fault to a with-block (the test-suite arming path).
     Yields the live :class:`FaultSpec` so callers can assert on
-    ``spec.fired``."""
-    spec = FaultSpec(site=site, kind=kind, prob=prob, count=count)
+    ``spec.fired``.  ``stall_s`` applies to ``kind="stall"`` only."""
+    spec = FaultSpec(site=site, kind=kind, prob=prob, count=count,
+                     stall_s=stall_s)
     if kind not in KINDS:
         raise ValueError(f"bad fault kind {kind!r} (want one of {KINDS})")
+    if kind == "stall" and not stall_s > 0:
+        # mirror FaultSpec.parse: a bad duration must fail HERE, not
+        # surface as a time.sleep ValueError disguised as a site fault
+        raise ValueError(f"stall duration must be > 0, got {stall_s}")
     _SCOPED.append(spec)
     try:
         yield spec

@@ -122,3 +122,25 @@ class Journal:
         if os.path.exists(self.path):
             os.remove(self.path)
         self._cells = {}
+
+    # ----------------------------------------------------- run config
+
+    def guard_config(self, config: dict, label: str = "run") -> None:
+        """Bind the journal to its run configuration (the ``config``
+        cell): a resumed journal written by a DIFFERENT configuration
+        raises ``ValueError`` — resuming a full-size sweep from a
+        smoke journal would splice toy numbers into the record.  Only
+        the keys in `config` are compared, so a journal may carry
+        extra config fields a newer writer added.  Shared by
+        ``bench.py --resume`` and the harness sweeps (`label` names
+        the writer in the refusal)."""
+        prior = self.get("config")
+        if prior is not None:
+            prior = {k: prior.get(k) for k in config}
+            if prior != config:
+                raise ValueError(
+                    f"journal {self.path} was written by a different "
+                    f"{label} configuration ({prior} != {config}); "
+                    f"use a fresh journal or delete it")
+        else:
+            self.record("config", config)

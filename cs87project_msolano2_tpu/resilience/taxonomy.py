@@ -69,6 +69,17 @@ class CollectiveTimeout(TransientBackendError):
     log line).  Transient: the r05 hang recovered by itself."""
 
 
+class CollectiveAborted(CollectiveTimeout):
+    """A supervised collective region was ABANDONED: it overran its
+    abort budget (``abort_waits`` x deadline) and the supervisor gave
+    up waiting and cancelled it (resilience.watchdog.supervise_
+    collective).  Still TRANSIENT for the classifier — the operation
+    was fine, the rendezvous was not — but callers that can re-plan
+    catch it explicitly and take the communication-free escape path
+    instead of retrying the same wedge (parallel/escape.py,
+    docs/MULTICHIP.md)."""
+
+
 class HostDesyncError(PifftError):
     """Multi-host processes disagree about the job topology (process
     count / global device mismatch) — no local retry can fix it."""

@@ -1,31 +1,59 @@
-"""Collective watchdog: a configurable rendezvous deadline surfaced as
-a structured :class:`~.taxonomy.CollectiveTimeout` diagnostic.
+"""Collective supervision: per-collective heartbeats, recovered-stall
+accounting, and a supervised abort that turns a wedged rendezvous into
+a recoverable :class:`~.taxonomy.CollectiveAborted` instead of a hang.
 
 MULTICHIP_r05 recorded the raw form of the problem: an all_to_all
 rendezvous hung for 20 s, the ONLY signal was a C++ ``rendezvous.cc``
 log line ("This thread ... may be stuck"), and eight seconds later a
 second line declared it a false positive.  Nothing in the run's own
-output said either thing.  The watchdog makes the deadline explicit and
-ours: wrap a collective region in :func:`collective_watchdog` and a
-stall past the (configurable, logged) deadline emits a structured
-``CollectiveTimeout`` warning through ``plans.warn`` while the region
-runs — and, in ``strict`` mode, raises :class:`CollectiveTimeout` once
-it completes, so the retry layer can classify it (TRANSIENT) instead of
-a human grepping C++ logs.
+output said either thing — and nothing in the stack could have done
+anything about it had the hang been real.
+
+Two layers now exist:
+
+* :func:`collective_watchdog` (PR 4, kept) — a warn-only deadline: wrap
+  a collective region and a stall past the (validated, logged) deadline
+  emits a structured ``CollectiveTimeout`` warning while the region
+  runs; a region that recovers emits a ``collective_recovered`` event
+  carrying the deadline-wait count (the r05 stuck-then-unstuck window,
+  now visible in OUR output instead of a rendezvous.cc false-positive
+  line).
+* :func:`supervise_collective` (this PR) — the supervisor: the region
+  runs in a worker thread with a heartbeat armed per deadline; each
+  expiry is counted, warned, and emitted (straggler accounting across
+  co-armed regions); past ``abort_waits`` expiries the supervisor
+  cancels the region's :class:`CancellationToken` and raises
+  :class:`CollectiveAborted`, which the resilient sharded entry points
+  (parallel/escape.py) catch to re-plan onto the communication-free
+  pi-path.  Safe points: the token is checked before the region
+  dispatches and may be polled by cooperative callers
+  (``token.checkpoint()``); a worker already blocked inside XLA cannot
+  be interrupted — it is ABANDONED (daemon thread) and its late
+  completion, if any, is emitted as ``collective_late_completion``.
 
 No wall clocks are read (the timing layer owns those — PIF102): the
-watchdog thread counts deadline-sized waits on an event, so "recovered
-after >= k x deadline" is derived purely from the wait count.
+heartbeat thread counts deadline-sized waits on an event, so
+"recovered after >= k x deadline" is derived purely from the wait
+count.
+
+Deadline validation (strict-mode contract): ``PIFFT_RENDEZVOUS_
+DEADLINE_S`` is parsed ONCE at arm time.  A non-numeric, non-finite,
+or non-positive value warns and serves the default — or, under
+``strict=True``, raises ``ValueError`` at arm time instead of letting
+a bad knob silently disarm the deadline.  The parsed value is carried
+in every emitted diagnostic.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 from contextlib import contextmanager
+from typing import Callable, Optional
 
 from .inject import maybe_fault
-from .taxonomy import CollectiveTimeout
+from .taxonomy import CollectiveAborted, CollectiveTimeout
 
 #: default rendezvous deadline; the C++ warner fires at a hardcoded
 #: 20 s, so a 60 s default stays quiet through the r05-style
@@ -33,19 +61,87 @@ from .taxonomy import CollectiveTimeout
 #: genuinely wedged
 DEFAULT_RENDEZVOUS_DEADLINE_S = 60.0
 
+#: default supervised-abort budget: how many whole deadlines a
+#: supervised region may overrun before the supervisor abandons it
+#: (``PIFFT_COLLECTIVE_ABORT_WAITS`` overrides; the warn-only layers
+#: pass None = never abort)
+DEFAULT_ABORT_WAITS = 2
 
-def rendezvous_deadline_s() -> float:
-    """The configured rendezvous deadline
-    (``PIFFT_RENDEZVOUS_DEADLINE_S`` overrides the default)."""
+
+def rendezvous_deadline_s(strict: bool = False) -> float:
+    """The configured rendezvous deadline, validated ONCE at the call
+    (``PIFFT_RENDEZVOUS_DEADLINE_S`` overrides the default).
+
+    A malformed value (non-numeric, non-finite, or <= 0 — a zero
+    deadline would busy-spin the heartbeat) warns with the raw AND the
+    served value, or raises ``ValueError`` under ``strict=True`` so a
+    strict arm point fails at arm time instead of silently running
+    with a deadline the operator never asked for."""
     raw = os.environ.get("PIFFT_RENDEZVOUS_DEADLINE_S", "").strip()
-    try:
-        return float(raw) if raw else DEFAULT_RENDEZVOUS_DEADLINE_S
-    except ValueError:
-        from ..plans.core import warn
-
-        warn(f"PIFFT_RENDEZVOUS_DEADLINE_S={raw!r} is not a number; "
-             f"using {DEFAULT_RENDEZVOUS_DEADLINE_S}")
+    if not raw:
         return DEFAULT_RENDEZVOUS_DEADLINE_S
+    try:
+        value = float(raw)
+    except ValueError:
+        value = None
+    if value is not None and math.isfinite(value) and value > 0:
+        return value
+    msg = (f"PIFFT_RENDEZVOUS_DEADLINE_S={raw!r} is not a positive "
+           f"finite number of seconds")
+    if strict:
+        raise ValueError(msg)
+    from ..plans.core import warn
+
+    warn(f"{msg}; using the default {DEFAULT_RENDEZVOUS_DEADLINE_S:g}s")
+    return DEFAULT_RENDEZVOUS_DEADLINE_S
+
+
+def abort_waits_default() -> int:
+    """The configured supervised-abort budget
+    (``PIFFT_COLLECTIVE_ABORT_WAITS`` overrides the default)."""
+    raw = os.environ.get("PIFFT_COLLECTIVE_ABORT_WAITS", "").strip()
+    try:
+        value = int(raw) if raw else DEFAULT_ABORT_WAITS
+    except ValueError:
+        value = 0
+    if value >= 1:
+        return value
+    from ..plans.core import warn
+
+    warn(f"PIFFT_COLLECTIVE_ABORT_WAITS={raw!r} is not a positive "
+         f"integer; using {DEFAULT_ABORT_WAITS}")
+    return DEFAULT_ABORT_WAITS
+
+
+class CancellationToken:
+    """Cooperative cancellation for a supervised collective region.
+
+    The supervisor calls :meth:`cancel` when the region overruns its
+    abort budget; region code honors it at safe points by calling
+    :meth:`checkpoint`, which raises :class:`CollectiveAborted` once
+    cancelled.  The built-in safe point is the region's own dispatch
+    (``supervise_collective``'s worker checks before calling into the
+    region), so a cancellation landing between retries or before the
+    collective is entered aborts cleanly without touching XLA."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: Optional[str] = None
+
+    def cancel(self, reason: str) -> None:
+        self.reason = reason
+        self._event.set()
+
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def checkpoint(self, label: str = "") -> None:
+        """Raise :class:`CollectiveAborted` if cancelled — the safe
+        point primitive."""
+        if self._event.is_set():
+            raise CollectiveAborted(
+                f"collective region {label or '<unnamed>'} cancelled "
+                f"({self.reason})")
 
 
 class WatchdogReport:
@@ -58,23 +154,85 @@ class WatchdogReport:
         self.fired = 0
 
 
+class SupervisionReport(WatchdogReport):
+    """A supervised region's full accounting: deadline-wait count
+    (``fired``), whether the supervisor ``aborted`` it, and whether it
+    ``recovered`` (completed after overrunning at least one
+    deadline)."""
+
+    def __init__(self, label: str, deadline_s: float,
+                 abort_waits: Optional[int]):
+        super().__init__(label, deadline_s)
+        self.abort_waits = abort_waits
+        self.aborted = False
+        self.recovered = False
+
+
+# live supervised/watched regions, label -> report: the straggler view.
+# A heartbeat names how many sibling regions armed alongside this one
+# have already completed — the one still waiting is the straggler.
+_ACTIVE: dict = {}
+_ACTIVE_LOCK = threading.Lock()
+
+
+def _register(report: WatchdogReport) -> None:
+    with _ACTIVE_LOCK:
+        _ACTIVE[id(report)] = report
+
+
+def _unregister(report: WatchdogReport) -> None:
+    with _ACTIVE_LOCK:
+        _ACTIVE.pop(id(report), None)
+
+
+def _straggler_note(report: WatchdogReport) -> str:
+    with _ACTIVE_LOCK:
+        waiting = [r.label for r in _ACTIVE.values() if r is not report]
+    if not waiting:
+        return ""
+    return f" (co-armed regions still waiting: {', '.join(waiting)})"
+
+
+def active_regions() -> list:
+    """Labels of the currently armed collective regions (diagnostics)."""
+    with _ACTIVE_LOCK:
+        return [r.label for r in _ACTIVE.values()]
+
+
+def _emit_recovered(label: str, fired: int, deadline: float) -> None:
+    """The r05 stuck-then-unstuck window, as OUR structured output: a
+    ``collective_recovered`` warn event carrying the deadline-wait
+    count, instead of a rendezvous.cc false-positive line."""
+    from ..obs import events, metrics
+    from ..plans.core import warn
+
+    metrics.inc("pifft_collective_recoveries_total", label=label)
+    events.emit("collective_recovered", label=label, waits=fired,
+                deadline_s=deadline)
+    warn(f"collective_recovered: {label} completed after >= "
+         f"{fired * deadline:g}s ({fired} x {deadline:g}s deadline "
+         f"waits — stuck-then-unstuck, the MULTICHIP_r05 pattern; raise "
+         f"PIFFT_RENDEZVOUS_DEADLINE_S if this deadline is too twitchy)")
+
+
 @contextmanager
 def collective_watchdog(label: str, deadline_s: float | None = None,
                         strict: bool = False):
-    """Arm a rendezvous deadline around a collective region.
+    """Arm a rendezvous deadline around a collective region (warn-only
+    layer — :func:`supervise_collective` adds the abort).
 
     While the with-block runs, a daemon thread wakes every `deadline_s`
-    (default :func:`rendezvous_deadline_s`) and emits a structured
-    ``CollectiveTimeout`` warning naming the region — the in-band
-    replacement for rendezvous.cc's buried "may be stuck" line.  On
-    exit, a region that overran at least one deadline either raises
-    :class:`CollectiveTimeout` (``strict=True``) or warns that it
-    recovered (the r05 false-positive case, now visible in OUR output).
-    Yields the live :class:`WatchdogReport`."""
+    (default :func:`rendezvous_deadline_s`, validated at THIS arm point
+    — under ``strict`` a malformed env knob raises here, not never) and
+    emits a structured ``CollectiveTimeout`` warning naming the region.
+    On exit, a region that overran at least one deadline either raises
+    :class:`CollectiveTimeout` (``strict=True``) or emits the
+    ``collective_recovered`` event with its wait count.  Yields the
+    live :class:`WatchdogReport`."""
     from ..plans.core import warn
 
     deadline = float(deadline_s if deadline_s is not None
-                     else rendezvous_deadline_s())
+                     else rendezvous_deadline_s(strict=strict))
     maybe_fault("collective")
     report = WatchdogReport(label, deadline)
     done = threading.Event()
@@ -86,12 +244,13 @@ def collective_watchdog(label: str, deadline_s: float | None = None,
             report.fired += 1
             metrics.inc("pifft_watchdog_fires_total", label=label)
             warn(f"CollectiveTimeout: {label} still waiting after "
-                 f">= {report.fired * deadline:.0f}s (deadline "
-                 f"{deadline:.0f}s; PIFFT_RENDEZVOUS_DEADLINE_S "
-                 f"overrides)")
+                 f">= {report.fired * deadline:g}s (deadline "
+                 f"{deadline:g}s; PIFFT_RENDEZVOUS_DEADLINE_S "
+                 f"overrides){_straggler_note(report)}")
 
     thread = threading.Thread(target=watch, name=f"pifft-watchdog-{label}",
                               daemon=True)
+    _register(report)
     thread.start()
     from ..obs import spans
 
@@ -105,17 +264,124 @@ def collective_watchdog(label: str, deadline_s: float | None = None,
     finally:
         done.set()
         thread.join(timeout=deadline + 1.0)
+        _unregister(report)
     if report.fired:
-        from ..obs import events
-
-        events.emit("collective_timeout", label=label,
-                    fired=report.fired, deadline_s=deadline,
-                    recovered=not strict)
         if strict:
+            from ..obs import events
+
+            events.emit("collective_timeout", label=label,
+                        fired=report.fired, deadline_s=deadline,
+                        recovered=False)
             raise CollectiveTimeout(
                 f"{label} exceeded its rendezvous deadline "
-                f"({report.fired} x {deadline:.0f}s)")
-        warn(f"{label} recovered after >= {report.fired * deadline:.0f}s "
-             f"(stuck-then-unstuck, the MULTICHIP_r05 pattern; raise "
-             f"PIFFT_RENDEZVOUS_DEADLINE_S if this deadline is too "
-             f"twitchy)")
+                f"({report.fired} x {deadline:g}s)")
+        _emit_recovered(label, report.fired, deadline)
+
+
+def supervise_collective(fn: Callable, label: str,
+                         deadline_s: float | None = None,
+                         abort_waits: Optional[int] = None,
+                         token: Optional[CancellationToken] = None,
+                         strict: bool = False):
+    """Run ``fn()`` as a SUPERVISED collective region; returns
+    ``(result, SupervisionReport)``.
+
+    The region runs in a daemon worker thread while the supervisor
+    counts deadline-sized waits.  Each expiry is a heartbeat: warned,
+    counted (``pifft_watchdog_fires_total``), and emitted
+    (``collective_heartbeat``), with the straggler note naming any
+    co-armed regions still waiting.  After ``abort_waits`` expiries
+    (default :func:`abort_waits_default`; the region's cancellation
+    `token` is cancelled first, so a cooperative region aborts at its
+    next safe point) the supervisor stops waiting and raises
+    :class:`CollectiveAborted` — the caller's cue to take the
+    communication-free escape path (parallel/escape.py).  A worker
+    blocked inside XLA is abandoned; if it completes later its result
+    is discarded and a ``collective_late_completion`` event records the
+    false-positive window.
+
+    A region that completes after >= 1 wait emits
+    ``collective_recovered`` with its wait count; exceptions from the
+    region propagate unchanged (classified by the retry layer)."""
+    from ..obs import events, metrics, spans
+    from ..plans.core import warn
+
+    deadline = float(deadline_s if deadline_s is not None
+                     else rendezvous_deadline_s(strict=strict))
+    if abort_waits is None:
+        abort_waits = abort_waits_default()
+    token = token or CancellationToken()
+    report = SupervisionReport(label, deadline, abort_waits)
+    done = threading.Event()
+    box: dict = {}
+
+    def work():
+        try:
+            # the stall injection site lives INSIDE the supervised
+            # region: an injected stall delays here, the heartbeat
+            # fires, and the whole recovery loop is exercised on CPU
+            maybe_fault("collective")
+            # safe point: never dispatch into an already-cancelled
+            # region
+            token.checkpoint(label)
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box["error"] = e
+        finally:
+            done.set()
+            if "value" in box and token.cancelled():
+                # the abandoned worker finished anyway — the r05
+                # false-positive shape, recorded instead of lost
+                events.emit("collective_late_completion", label=label,
+                            deadline_s=deadline)
+
+    worker = threading.Thread(target=work,
+                              name=f"pifft-collective-{label}",
+                              daemon=True)
+    _register(report)
+    try:
+        with spans.span(f"collective:{label}", deadline_s=deadline,
+                        supervised=True) as sp:
+            worker.start()
+            while not done.wait(deadline):
+                report.fired += 1
+                metrics.inc("pifft_watchdog_fires_total", label=label)
+                events.emit("collective_heartbeat", label=label,
+                            waits=report.fired, deadline_s=deadline,
+                            abort_waits=abort_waits)
+                warn(f"CollectiveTimeout: {label} still waiting after "
+                     f">= {report.fired * deadline:g}s (deadline "
+                     f"{deadline:g}s, abort after {abort_waits} "
+                     f"waits){_straggler_note(report)}")
+                if report.fired >= abort_waits:
+                    report.aborted = True
+                    token.cancel(
+                        f"{label} overran {report.fired} x "
+                        f"{deadline:g}s deadline waits")
+                    metrics.inc("pifft_collective_aborts_total",
+                                label=label)
+                    events.emit("collective_abandoned", label=label,
+                                waits=report.fired, deadline_s=deadline)
+                    warn(f"collective ABANDONED: {label} after "
+                         f"{report.fired} x {deadline:g}s — "
+                         f"supervisor aborting; the wedged worker is "
+                         f"left behind (daemon) and a late completion "
+                         f"will be recorded")
+                    aborted = CollectiveAborted(
+                        f"{label} abandoned after {report.fired} x "
+                        f"{deadline:g}s deadline waits "
+                        f"(abort_waits={abort_waits}; "
+                        f"PIFFT_COLLECTIVE_ABORT_WAITS overrides)")
+                    # the report rides the exception so the escape
+                    # layer can carry the wait count into its trail
+                    aborted.report = report
+                    raise aborted
+            sp.set(fired=report.fired, aborted=report.aborted)
+    finally:
+        _unregister(report)
+    if "error" in box:
+        raise box["error"]
+    if report.fired:
+        report.recovered = True
+        _emit_recovered(label, report.fired, deadline)
+    return box["value"], report

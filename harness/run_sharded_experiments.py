@@ -29,6 +29,14 @@ Output: datasets/fourier-parallel-pi-sharded-results.tsv
 (n  p  total_ms  funnel_ms  tube_ms — per-DEVICE times; analysis model:
 per-processor, auto-selected since the filename matches no on-chip or
 serialized backend pattern).
+
+Resume discipline (docs/RESILIENCE.md, docs/MULTICHIP.md): per-cell
+completion is journaled to an fsynced JSONL sidecar next to the
+append-only TSV (the same kill-safe contract bench.py and
+run_experiments.py carry) — a sweep killed mid-cell (or mid-STALL: the
+r05 failure mode) restarts from the last completed cell, re-running
+nothing, and the supervised collective cross-check's degrade trail is
+preserved across resumes instead of re-risking the wedge.
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from collections import Counter
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
@@ -53,8 +62,13 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-from harness.run_experiments import done_counts, parse_grid  # noqa: E402
+from harness.run_experiments import (  # noqa: E402
+    done_counts,
+    journal_for,
+    parse_grid,
+)
 
+from cs87project_msolano2_tpu import obs  # noqa: E402
 from cs87project_msolano2_tpu.models.pi_fft import (  # noqa: E402
     funnel_single,
     tube,
@@ -101,6 +115,46 @@ def mesh_crosscheck(n: int = 1 << 12) -> None:
           f"{err / scale:.1e})", file=sys.stderr)
 
 
+def collective_crosscheck(journal, n: int = 64):
+    """The SUPERVISED collective cross-check: run the all_to_all 2-D
+    FFT on the real 8-device mesh through the self-healing entry
+    (collective supervision + consensus + the communication-free
+    escape, docs/MULTICHIP.md) and journal what happened — including
+    the degrade trail, so a sweep that escaped (a wedged rendezvous on
+    this host, an injected stall in CI) says so on EVERY later resume
+    instead of the r05 pattern of a completed run with a buried hang.
+    A journaled cell is not re-run: the trail is PRESERVED."""
+    prior = journal.get("collective_crosscheck")
+    if prior is not None:
+        trail = prior.get("trail") or []
+        print(f"# collective cross-check preserved from journal "
+              f"(degraded={bool(prior.get('degraded'))}"
+              + (f", trail={[t.get('to') for t in trail]}" if trail
+                 else "") + ")", file=sys.stderr)
+        return prior
+    from jax.sharding import Mesh
+
+    from cs87project_msolano2_tpu.parallel import fft2_sharded_resilient
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:8]), ("p",))
+    rng = np.random.default_rng(1)
+    x = (rng.standard_normal((n, n))
+         + 1j * rng.standard_normal((n, n))).astype(np.complex64)
+    y, report = fft2_sharded_resilient(x, mesh)
+    ref = np.fft.fft2(x.astype(np.complex128))
+    err = float(np.max(np.abs(np.asarray(y) - ref)) / np.max(np.abs(ref)))
+    assert err < 1e-5, f"collective cross-check failed: rel err {err:.2e}"
+    rec = journal.record("collective_crosscheck",
+                         {**report.to_record(), "rel_err": err})
+    print(f"# collective cross-check ok (supervised all_to_all, "
+          f"degraded={report.degraded}"
+          + (f", escaped via {[t.get('to') for t in report.trail]}"
+             if report.trail else "") + f", rel err {err:.1e})",
+          file=sys.stderr)
+    return rec
+
+
 def device_fns(n: int, p: int):
     """jitted shard-local phases for device 0 of a p-mesh (all devices
     do identical-shape work — funnel_single's chain length log2(p) and
@@ -144,7 +198,19 @@ def main(argv=None) -> int:
                          "dataset (…-results-full.tsv, cf. the "
                          "reference's 256-rep …-results-full.csv) "
                          "instead of the standard 10-rep file")
+    ap.add_argument("--no-resume", action="store_true",
+                    help="start a FRESH dataset: rotate the TSV and "
+                         "journal and re-run every cell (default: "
+                         "resume — a killed sweep restarts from the "
+                         "last completed cell)")
+    ap.add_argument("--events", default=None, metavar="PATH",
+                    help="write the structured observability event "
+                         "stream to a JSONL file "
+                         "(docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
+
+    if args.events:
+        obs.enable(events_path=args.events)
 
     mesh_crosscheck()
 
@@ -154,7 +220,29 @@ def main(argv=None) -> int:
         args.out,
         f"fourier-parallel-pi-sharded-results{'-' + stem if stem else ''}.tsv",
     )
-    done = done_counts(path)
+    # the kill-safe per-cell resume discipline bench.py gained in PR 4
+    # (docs/RESILIENCE.md): an fsynced JSONL journal rides next to the
+    # append-only TSV, merged per-cell by max with the TSV scan, so a
+    # kill mid-cell (or mid-stall) loses at most the cell it took —
+    # never the sweep's place, never the degrade trail
+    journal = journal_for(path)
+    if not os.path.exists(path):
+        # a rotated/deleted TSV invalidates the sidecar: the journal
+        # may only ever claim cells whose data exists
+        journal.reset()
+    resume = not args.no_resume
+    if not resume:
+        # a fresh run starts a fresh DATASET: the TSV is append-only,
+        # so leaving it would splice two runs' timings into one
+        # per-cell replication count — remove both it and the journal
+        # (whose rep-keyed cells would otherwise claim rows of a file
+        # that no longer matches them)
+        if os.path.exists(path):
+            os.remove(path)
+        journal.reset()
+    journal.guard_config({"dataset": "sharded", "full": bool(args.full)})
+    collective_crosscheck(journal)
+    done = done_counts(path, journal) if resume else Counter()
 
     ns = parse_grid(args.n_grid)
     ps = parse_grid(args.p_grid)
@@ -163,25 +251,45 @@ def main(argv=None) -> int:
 
     with open(path, "a") as fh:
         for n, p in cells:
-            todo = args.reps - done[(n, p)]
+            start_rep = done[(n, p)]
+            todo = args.reps - start_rep
             if todo <= 0:
                 continue
             xr = jnp.asarray(rng.standard_normal(n).astype(np.float32))
             xi = jnp.asarray(rng.standard_normal(n).astype(np.float32))
             funnel_f, tube_only, full = device_fns(n, p)
-            for _ in range(todo):
-                # phase timers compose: total := funnel + tube, the
-                # reference's nested-timer contract (jax_backend.run)
-                if p == 1:
-                    funnel_ms = 0.0  # empty chain, log2(1) = 0 stages
-                    fr, fi = funnel_f(xr, xi)
-                else:
-                    funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi, reps=3)
-                tube_ms, _ = time_ms(tube_only, fr, fi, reps=3)
+            for rep in range(start_rep, args.reps):
+                cell_id = {"n": n, "p": p, "rep": rep}
+                with obs.span("sweep_cell", cell=cell_id,
+                              backend="sharded"):
+                    # phase timers compose: total := funnel + tube, the
+                    # reference's nested-timer contract (jax_backend.run)
+                    if p == 1:
+                        funnel_ms = 0.0  # empty chain, log2(1) stages
+                        fr, fi = funnel_f(xr, xi)
+                    else:
+                        funnel_ms, (fr, fi) = time_ms(funnel_f, xr, xi,
+                                                      reps=3)
+                    tube_ms, _ = time_ms(tube_only, fr, fi, reps=3)
                 fh.write(f"{n}\t{p}\t{funnel_ms + tube_ms:.6f}"
                          f"\t{funnel_ms:.6f}\t{tube_ms:.6f}\n")
                 fh.flush()
+                # fsync the TSV row BEFORE the (itself fsynced) journal
+                # claim, like run_experiments.sweep: the journal may
+                # only ever claim cells whose data exists, even across
+                # a host crash
+                os.fsync(fh.fileno())
+                journal.record(f"{n}:{p}:{rep}",
+                               {"total_ms": funnel_ms + tube_ms})
+                obs.emit("sweep_cell", cell=cell_id, backend="sharded",
+                         total_ms=funnel_ms + tube_ms,
+                         funnel_ms=funnel_ms, tube_ms=tube_ms)
             print(f"# sharded n={n} p={p} done", file=sys.stderr)
+    if obs.enabled():
+        from cs87project_msolano2_tpu.obs import metrics
+
+        obs.emit("metrics", snapshot=metrics.snapshot())
+        obs.flush()
     print(path)
     return 0
 

@@ -617,6 +617,76 @@ def test_pif107_serve_package_is_clean():
     assert findings == [], [f"{f.path}:{f.line}" for f in findings]
 
 
+# ---------------------------------------- PIF108 bare collective call
+
+
+PARALLEL_PATH = os.path.join(PKG, "parallel", "snippet.py")
+COLLECTIVES_PATH = os.path.join(PKG, "parallel", "collectives.py")
+
+BARE_A2A = """
+    import jax
+
+    def transpose(v, axis):
+        return jax.lax.all_to_all(v, axis, split_axis=1,
+                                  concat_axis=0, tiled=True)
+"""
+
+
+def test_pif108_flags_bare_collective_in_parallel():
+    findings = run(BARE_A2A, "PIF108", path=PARALLEL_PATH)
+    assert rule_ids(findings) == ["PIF108"]
+    assert "parallel.collectives" in findings[0].message
+    # import-alias form resolves through the import map too
+    aliased = """
+        from jax.lax import psum as reduce_sum
+
+        def total(v, axis):
+            return reduce_sum(v, axis)
+    """
+    findings = run(aliased, "PIF108", path=PARALLEL_PATH)
+    assert rule_ids(findings) == ["PIF108"]
+
+
+def test_pif108_sanctioned_funnel_and_outside_parallel_pass():
+    # the funnel module itself is the one sanctioned call site
+    assert run(BARE_A2A, "PIF108", path=COLLECTIVES_PATH) == []
+    # the same call outside parallel/ is not this rule's business
+    assert run(BARE_A2A, "PIF108", path="snippet.py") == []
+    # a non-collective jax.lax call in parallel/ passes
+    local = """
+        import jax
+
+        def slice0(v, i, k):
+            return jax.lax.dynamic_slice_in_dim(v, i, k, axis=0)
+    """
+    assert run(local, "PIF108", path=PARALLEL_PATH) == []
+
+
+def test_pif108_noqa_suppresses():
+    code = """
+        import jax
+
+        def transpose(v, axis):
+            return jax.lax.all_to_all(  # pifft: noqa[PIF108]
+                v, axis, split_axis=1, concat_axis=0, tiled=True)
+    """
+    assert run(code, "PIF108", path=PARALLEL_PATH) == []
+
+
+def test_pif108_parallel_package_is_clean():
+    """The shipped parallel/ package must satisfy its own rule with no
+    suppressions: every collective goes through parallel.collectives
+    (the supervised funnel, docs/MULTICHIP.md)."""
+    parallel_dir = os.path.join(PKG, "parallel")
+    findings = [f for f in engine.check_paths([parallel_dir],
+                                              rules=["PIF108"])]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+    for name in os.listdir(parallel_dir):
+        if name.endswith(".py"):
+            src = open(os.path.join(parallel_dir, name)).read()
+            assert "noqa[PIF108]" not in src, name
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 

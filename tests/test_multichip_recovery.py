@@ -1,0 +1,479 @@
+"""Self-healing multichip tests (docs/MULTICHIP.md): collective
+supervision (heartbeats, strict deadline validation, supervised
+abort + cancellation), stall fault injection, the communication-free
+escape path's bit-parity and collective-free-HLO contracts, multihost
+fallback consensus, the end-to-end chaos recovery loop, and the
+sharded harness's journaled kill-safe resume.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs
+from cs87project_msolano2_tpu.parallel import (
+    clear_unhealthy,
+    fft2_collective_free_planes,
+    fft2_sharded_resilient,
+    make_mesh,
+    poisson_solve_collective_free,
+    poisson_solve_sharded,
+    poisson_solve_sharded_resilient,
+    report_unhealthy,
+)
+from cs87project_msolano2_tpu.parallel.escape import (
+    _fft2_escape_fn,
+    _poisson_escape_fn,
+)
+from cs87project_msolano2_tpu.parallel.fft2d import fft2_sharded_planes
+from cs87project_msolano2_tpu.parallel.multihost import agree_on_fallback
+from cs87project_msolano2_tpu.resilience import (
+    CancellationToken,
+    CollectiveAborted,
+    FaultSpec,
+    HostDesyncError,
+    Journal,
+    collective_watchdog,
+    inject,
+    maybe_fault,
+    rendezvous_deadline_s,
+    supervise_collective,
+)
+from cs87project_msolano2_tpu.resilience.watchdog import (
+    DEFAULT_RENDEZVOUS_DEADLINE_S,
+    abort_waits_default,
+)
+
+COLLECTIVE_HLO_OPS = ("all-to-all", "all-reduce", "all-gather",
+                      "collective-permute", "reduce-scatter")
+
+
+@pytest.fixture
+def obs_events():
+    """In-process obs buffer for event asserts; always disarmed (and
+    the metrics registry cleared — the disabled path must stay a
+    verified no-op for later tests) after."""
+    from cs87project_msolano2_tpu.obs import metrics
+
+    if obs.enabled():
+        obs.disable()
+    obs.enable()
+    yield
+    if obs.enabled():
+        obs.disable()
+    metrics.reset()
+
+
+# ------------------------------------------- deadline/knob validation
+
+
+def test_deadline_env_validated_at_arm_time(monkeypatch, capsys):
+    for bad in ("soon", "0", "-5", "inf", "nan"):
+        monkeypatch.setenv("PIFFT_RENDEZVOUS_DEADLINE_S", bad)
+        assert rendezvous_deadline_s() == DEFAULT_RENDEZVOUS_DEADLINE_S
+        err = capsys.readouterr().err
+        # the diagnostic names the raw value AND the served value
+        assert repr(bad) in err and "60" in err
+        # strict mode: a malformed knob fails AT ARM TIME, not never
+        with pytest.raises(ValueError, match="positive finite"):
+            rendezvous_deadline_s(strict=True)
+        with pytest.raises(ValueError, match="positive finite"):
+            with collective_watchdog("region", strict=True):
+                pass  # pragma: no cover — arm raises first
+    monkeypatch.setenv("PIFFT_RENDEZVOUS_DEADLINE_S", "2.5")
+    assert rendezvous_deadline_s(strict=True) == 2.5
+
+
+def test_abort_waits_env_validated(monkeypatch, capsys):
+    monkeypatch.setenv("PIFFT_COLLECTIVE_ABORT_WAITS", "3")
+    assert abort_waits_default() == 3
+    monkeypatch.setenv("PIFFT_COLLECTIVE_ABORT_WAITS", "zero")
+    assert abort_waits_default() == 2
+    assert "PIFFT_COLLECTIVE_ABORT_WAITS" in capsys.readouterr().err
+
+
+# -------------------------------------------------- stall fault specs
+
+
+def test_stall_spec_parse_and_fire():
+    spec = FaultSpec.parse("collective:stall=0.01:1.0:2")
+    assert spec.kind == "stall" and spec.stall_s == 0.01
+    assert spec.prob == 1.0 and spec.count == 2
+    # default duration without '='
+    assert FaultSpec.parse("collective:stall").stall_s > 0
+    with pytest.raises(ValueError, match="stall"):
+        FaultSpec.parse("collective:stall=abc")
+    with pytest.raises(ValueError, match="> 0"):
+        FaultSpec.parse("collective:stall=-1")
+    # a stall DELAYS, never raises, and respects its firing cap
+    with inject("collective", "stall", stall_s=0.01, count=2) as live:
+        for _ in range(4):
+            maybe_fault("collective")
+        assert live.fired == 2
+
+
+# --------------------------------------------------------- supervisor
+
+
+def test_supervise_collective_fast_region_is_untouched(obs_events):
+    value, report = supervise_collective(lambda: 42, "fast",
+                                         deadline_s=5.0)
+    assert value == 42
+    assert report.fired == 0 and not report.aborted
+    assert not report.recovered
+
+
+def test_supervise_collective_recovers_and_emits(obs_events, capsys):
+    with inject("collective", "stall", stall_s=0.3):
+        value, report = supervise_collective(
+            lambda: "done", "stuck-then-unstuck",
+            deadline_s=0.05, abort_waits=50)
+    assert value == "done"
+    assert report.recovered and report.fired >= 1
+    recs = [r for r in obs.snapshot()
+            if r.get("kind") == "collective_recovered"]
+    assert recs and recs[-1]["payload"]["waits"] == report.fired
+    assert recs[-1]["payload"]["deadline_s"] == 0.05
+    assert "collective_recovered" in capsys.readouterr().err
+
+
+def test_supervise_collective_aborts_past_budget(obs_events):
+    # the region itself wedges (the blocked-inside-XLA model: a sleep
+    # the supervisor cannot interrupt) and outlives the abort budget
+    token = CancellationToken()
+    with pytest.raises(CollectiveAborted) as exc_info:
+        supervise_collective(lambda: time.sleep(0.5) or "late",
+                             "wedged", deadline_s=0.05, abort_waits=2,
+                             token=token)
+    report = exc_info.value.report
+    assert report.aborted and report.fired >= 2
+    assert token.cancelled()
+    kinds = [r["kind"] for r in obs.snapshot()]
+    assert "collective_heartbeat" in kinds
+    assert "collective_abandoned" in kinds
+    # the abandoned worker finishes anyway and records the late
+    # completion (the r05 false-positive shape) instead of losing it
+    time.sleep(0.8)
+    kinds = [r["kind"] for r in obs.snapshot()]
+    assert "collective_late_completion" in kinds
+
+
+def test_supervised_abort_at_safe_point_never_dispatches(obs_events):
+    """A stall BEFORE the region (the probe site) cancels at the safe
+    point: the region body itself must never run."""
+    ran = []
+    with inject("collective", "stall", stall_s=0.5):
+        with pytest.raises(CollectiveAborted):
+            supervise_collective(lambda: ran.append(1), "pre-wedged",
+                                 deadline_s=0.05, abort_waits=2)
+    time.sleep(0.6)  # let the worker drain past its stall
+    assert ran == [], "cancelled region was still dispatched"
+
+
+def test_cancellation_token_checkpoint_is_a_safe_point():
+    token = CancellationToken()
+    token.checkpoint("region")  # not cancelled: no-op
+    token.cancel("operator said stop")
+    with pytest.raises(CollectiveAborted, match="operator said stop"):
+        token.checkpoint("region")
+    # a cancelled token also stops a NEW supervised dispatch at the
+    # built-in safe point (the worker checks before calling the region)
+    with pytest.raises(CollectiveAborted):
+        supervise_collective(lambda: "unreachable", "cancelled-early",
+                             deadline_s=5.0, token=token)
+
+
+def test_supervised_region_exceptions_propagate():
+    with pytest.raises(ZeroDivisionError):
+        supervise_collective(lambda: 1 // 0, "raises", deadline_s=5.0)
+
+
+def test_straggler_note_names_co_armed_regions(capsys):
+    from cs87project_msolano2_tpu.resilience.watchdog import (
+        active_regions,
+    )
+
+    with collective_watchdog("regionA", deadline_s=30.0):
+        assert "regionA" in active_regions()
+        with collective_watchdog("regionB", deadline_s=0.05):
+            time.sleep(0.15)  # regionB overruns while regionA is armed
+    err = capsys.readouterr().err
+    assert "co-armed regions still waiting: regionA" in err
+    assert active_regions() == []
+
+
+# --------------------------------------------------- fallback consensus
+
+
+class _FakeClient:
+    def __init__(self, fail=False):
+        self.kv = {}
+        self.barriers = []
+        self.fail = fail
+
+    def key_value_set(self, key, value):
+        self.kv[key] = value
+
+    def wait_at_barrier(self, barrier_id, timeout_in_ms):
+        if self.fail:
+            raise TimeoutError(f"barrier {barrier_id} timed out")
+        self.barriers.append((barrier_id, timeout_in_ms))
+
+
+def test_consensus_single_process_trivially_agrees(obs_events):
+    epoch = agree_on_fallback("test-label", reason="unit test")
+    assert isinstance(epoch, int) and epoch >= 1
+    recs = [r for r in obs.snapshot()
+            if r.get("kind") == "fallback_consensus"]
+    assert recs and recs[-1]["payload"]["agreed"] is True
+
+
+def test_consensus_multiprocess_uses_kv_and_barrier():
+    client = _FakeClient()
+    epoch = agree_on_fallback("test-label", reason="stall",
+                              deadline_s=1.5, client=client, processes=4)
+    assert client.barriers == [(f"pifft-fallback-{epoch}", 1500)]
+    (key, value), = client.kv.items()
+    assert key == f"pifft/fallback/{epoch}/0"
+    assert "test-label" in value
+
+
+def test_consensus_timeout_is_a_classified_desync(obs_events):
+    with pytest.raises(HostDesyncError, match="fallback consensus"):
+        agree_on_fallback("test-label", deadline_s=0.1,
+                          client=_FakeClient(fail=True), processes=2)
+    recs = [r for r in obs.snapshot()
+            if r.get("kind") == "fallback_consensus"]
+    assert recs and recs[-1]["payload"]["agreed"] is False
+
+
+# ------------------------------------- escape path: parity + zero HLO
+
+
+def rand_c64(shape, seed):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape)
+            + 1j * rng.standard_normal(shape)).astype(np.complex64)
+
+
+def test_fft2_escape_parity_bit_for_bit(devices8):
+    """The escape matches the all_to_all path BIT FOR BIT on the
+    8-device mesh — same per-shard plans on the same values, only the
+    data movement re-planned (both under jit: docs/MULTICHIP.md,
+    bit-parity note)."""
+    mesh = make_mesh(8)
+    x = rand_c64((64, 64), seed=0)
+    xr = jnp.asarray(np.real(x)); xi = jnp.asarray(np.imag(x))
+    for inverse in (False, True):
+        a = jax.jit(lambda r, i, inv=inverse: fft2_sharded_planes(
+            r, i, mesh, inverse=inv))(xr, xi)
+        b = fft2_collective_free_planes(xr, xi, mesh, inverse=inverse)
+        assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        assert np.array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        if not inverse:
+            # and it is CORRECT, not merely self-consistent
+            y = np.asarray(b[0]) + 1j * np.asarray(b[1])
+            ref = np.fft.fft2(x.astype(np.complex128))
+            assert np.max(np.abs(y - ref)) / np.max(np.abs(ref)) < 1e-5
+
+
+def test_poisson_escape_parity_bit_for_bit(devices8):
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(3)
+    f = rng.standard_normal((16, 16, 8)).astype(np.float32)
+    a = jax.jit(lambda v: poisson_solve_sharded(v, mesh))(f)
+    b = poisson_solve_collective_free(f, mesh)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_escape_hlo_is_collective_free(devices8):
+    """The machine-checked form of the escape's whole point: the
+    compiled HLO of both escape bodies contains ZERO collective ops
+    (the same check the sharded pi-FFT carries)."""
+    mesh = make_mesh(8)
+    fn2 = _fft2_escape_fn(mesh, "p", False, 64, 64)
+    z = jnp.zeros((64, 64), jnp.float32)
+    hlo = jax.jit(fn2).lower(z, z).compile().as_text()
+    found = [op for op in COLLECTIVE_HLO_OPS if op in hlo]
+    assert not found, f"fft2 escape compiled with collectives: {found}"
+    fn3 = _poisson_escape_fn(mesh, "p", 16, 16, 8)
+    z3 = jnp.zeros((16, 16, 8), jnp.float32)
+    hlo = jax.jit(fn3).lower(z3).compile().as_text()
+    found = [op for op in COLLECTIVE_HLO_OPS if op in hlo]
+    assert not found, f"poisson escape compiled with collectives: {found}"
+
+
+# ----------------------------------------------- the chaos recovery loop
+
+
+def test_chaos_stall_abort_escape_end_to_end(devices8, obs_events):
+    """The acceptance loop: injected stall -> supervised abort ->
+    consensus -> collective_free escape -> bit-identical result, with
+    the degrade trail and the obs events all in place (rc=0 is the
+    CLI's form of this assert: `pifft multichip smoke`)."""
+    mesh = make_mesh(8)
+    x = rand_c64((32, 32), seed=1)
+    y_ok, rep_ok = fft2_sharded_resilient(x, mesh)
+    assert not rep_ok.escaped and not rep_ok.degraded
+    with inject("collective", "stall", stall_s=0.6):
+        y_esc, rep = fft2_sharded_resilient(x, mesh, deadline_s=0.1,
+                                            abort_waits=2)
+    assert rep.escaped and rep.degraded
+    assert rep.waits >= 2
+    assert isinstance(rep.epoch, int)
+    assert [t["to"] for t in rep.trail] == ["collective_free"]
+    assert rep.trail[0]["from"] == "all_to_all"
+    # bit-identical to the healthy supervised run
+    assert np.array_equal(np.asarray(y_ok), np.asarray(y_esc))
+    # the report round-trips to a JSON-safe record (the harness
+    # journals it)
+    json.dumps(rep.to_record())
+    kinds = {r["kind"] for r in obs.snapshot()}
+    for wanted in ("collective_heartbeat", "collective_abandoned",
+                   "fallback_consensus", "demotion",
+                   "collective_escape_completed"):
+        assert wanted in kinds, f"missing {wanted} (have {kinds})"
+    problems = [p for r in obs.snapshot()
+                for p in obs.validate_event(r)]
+    assert problems == []
+
+
+def test_poisson_chaos_recovery(devices8, obs_events):
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(5)
+    f = rng.standard_normal((16, 16, 8)).astype(np.float32)
+    u_ok, rep_ok = poisson_solve_sharded_resilient(f, mesh)
+    assert not rep_ok.escaped
+    with inject("collective", "stall", stall_s=0.6):
+        u_esc, rep = poisson_solve_sharded_resilient(
+            f, mesh, deadline_s=0.1, abort_waits=2)
+    assert rep.escaped and rep.degraded
+    assert np.array_equal(np.asarray(u_ok), np.asarray(u_esc))
+
+
+def test_unhealthy_device_skips_doomed_dispatch(devices8, obs_events,
+                                               monkeypatch):
+    """An out-of-band unhealthy report escapes DIRECTLY: the primary
+    collective is never dispatched (no 2-deadline wait to pay)."""
+    from cs87project_msolano2_tpu.parallel import escape as escape_mod
+
+    def never(*a, **k):  # pragma: no cover — the assert is that
+        raise AssertionError("primary was dispatched")
+
+    monkeypatch.setattr(escape_mod, "supervise_collective", never)
+    mesh = make_mesh(8)
+    report_unhealthy(jax.devices()[0], "operator: ECC errors")
+    try:
+        x = rand_c64((32, 32), seed=2)
+        y, rep = fft2_sharded_resilient(x, mesh)
+        assert rep.escaped and rep.waits == 0
+        assert rep.trail and rep.trail[0]["to"] == "collective_free"
+        assert "unhealthy" in rep.trail[0]["reason"]
+        ref = np.fft.fft2(x.astype(np.complex128))
+        assert np.max(np.abs(np.asarray(y) - ref)) \
+            / np.max(np.abs(ref)) < 1e-5
+    finally:
+        clear_unhealthy()
+
+
+def test_escape_is_transport_only_other_faults_propagate(devices8):
+    """A non-stall fault inside the primary body belongs to the plan
+    degradation chain / retry layer, not to the transport escape."""
+    from cs87project_msolano2_tpu.parallel.escape import run_with_escape
+
+    mesh = make_mesh(8)
+
+    def primary():
+        raise ZeroDivisionError("not a collective problem")
+
+    with pytest.raises(ZeroDivisionError):
+        run_with_escape(primary, lambda: None, "label", mesh,
+                        deadline_s=5.0)
+
+
+# -------------------------------------------------- journal run config
+
+
+def test_journal_guard_config(tmp_path):
+    j = Journal(str(tmp_path / "j.jsonl"))
+    j.guard_config({"dataset": "sharded", "full": False})
+    # same config: fine (and idempotent)
+    j2 = Journal(str(tmp_path / "j.jsonl"))
+    j2.guard_config({"dataset": "sharded", "full": False})
+    # a journal may carry EXTRA config keys a newer writer added
+    j3 = Journal(str(tmp_path / "j.jsonl"))
+    j3.guard_config({"dataset": "sharded"})
+    with pytest.raises(ValueError, match="different run configuration"):
+        Journal(str(tmp_path / "j.jsonl")).guard_config(
+            {"dataset": "sharded", "full": True})
+
+
+# ------------------------------------- sharded sweep: journaled resume
+
+
+@pytest.fixture(scope="module")
+def sharded_harness():
+    import importlib
+
+    return importlib.import_module("harness.run_sharded_experiments")
+
+
+def test_sharded_sweep_resume_recomputes_nothing(sharded_harness,
+                                                 tmp_path, monkeypatch):
+    """Kill a sharded sweep mid-cell and --resume must recompute no
+    completed cell — and preserve the collective cross-check's degrade
+    trail instead of re-risking the wedge (acceptance criterion)."""
+    mod = sharded_harness
+    out = str(tmp_path)
+    argv = ["--n-grid", "1024", "--p-grid", "1,2", "-T", "2",
+            "--out", out]
+    assert mod.main(argv) == 0
+    tsv = os.path.join(out, "fourier-parallel-pi-sharded-results.tsv")
+    rows = open(tsv).read().splitlines()
+    assert len(rows) == 4  # 2 cells x 2 reps
+    journal = mod.journal_for(tsv)
+    cells = journal.load()
+    assert "collective_crosscheck" in cells
+    trail_before = cells["collective_crosscheck"]
+
+    # simulate the kill that truncates the TSV's last line mid-write:
+    # the fsynced journal still holds the rep, so nothing re-runs
+    with open(tsv, "w") as fh:
+        fh.write("\n".join(rows[:-1]) + "\n1024\t2\t0.0")
+
+    calls = []
+    real_time_ms = mod.time_ms
+    monkeypatch.setattr(mod, "time_ms",
+                        lambda *a, **k: calls.append(1)
+                        or real_time_ms(*a, **k))
+    assert mod.main(argv) == 0
+    assert calls == [], "resume recomputed completed cells"
+    # the degrade trail survived the resume untouched
+    cells_after = mod.journal_for(tsv).load()
+    assert cells_after["collective_crosscheck"] == trail_before
+
+
+def test_sharded_sweep_no_resume_starts_fresh(sharded_harness, tmp_path,
+                                              monkeypatch):
+    """--no-resume is a FRESH dataset: the grid re-runs AND the
+    append-only TSV rotates — two runs' timings must never splice into
+    one per-cell replication count."""
+    mod = sharded_harness
+    out = str(tmp_path)
+    argv = ["--n-grid", "1024", "--p-grid", "1", "-T", "1", "--out", out]
+    assert mod.main(argv) == 0
+    tsv = os.path.join(out, "fourier-parallel-pi-sharded-results.tsv")
+    calls = []
+    real_time_ms = mod.time_ms
+    monkeypatch.setattr(mod, "time_ms",
+                        lambda *a, **k: calls.append(1)
+                        or real_time_ms(*a, **k))
+    assert mod.main(argv + ["--no-resume"]) == 0
+    assert calls, "--no-resume must re-run the grid"
+    rows = [ln for ln in open(tsv).read().splitlines() if ln.strip()]
+    assert len(rows) == 1, f"TSV spliced two runs: {rows}"
