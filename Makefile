@@ -9,7 +9,7 @@ SHELL := /bin/bash
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
         bench-chaos serve-smoke serve-slo multichip-smoke replicate \
         run-experiments run-experiments-and-analyze-results analyze \
-        analyze-datasets check lint
+        analyze-datasets analyze-smoke check lint
 
 all:
 	$(MAKE) -C cs87project_msolano2_tpu/native all
@@ -40,6 +40,36 @@ analyze-datasets:
 	  --out datasets
 
 run-experiments-and-analyze-results: run-experiments analyze
+
+# the CI statistical-verification check (docs/ANALYSIS.md): the
+# perf-regression gate over the COMMITTED BENCH trajectory (it must
+# pass — a significant unbaselined throughput regression fails CI with
+# a named metric and a p-value), the loader/change-point report over
+# the same rounds, and a law-fit round trip on the self-test table
+# (the fit must recover known coefficients and exit 0)
+analyze-smoke:
+	set -o pipefail; \
+	python3 -m cs87project_msolano2_tpu.cli analyze gate BENCH_r*.json \
+	  --baseline perf-baseline.json \
+	  | tee /tmp/pifft-analyze-gate.out && \
+	python3 -m cs87project_msolano2_tpu.cli analyze report \
+	  --bench BENCH_r*.json --json \
+	  | python3 -c "import json, sys; r = json.load(sys.stdin); \
+	  assert r['rounds'] and r['skipped_pairs'], r; \
+	  assert r['change_points'], r; \
+	  print('# analyze report ok: %d rounds, %d incomparable pair(s), %d change-point(s)' \
+	        % (len(r['rounds']), len(r['skipped_pairs']), len(r['change_points'])))" && \
+	python3 -c "from cs87project_msolano2_tpu.analyze.lawfit import write_demo_tsv; \
+	  write_demo_tsv('/tmp/pifft-analyze-demo.tsv')" && \
+	python3 -m cs87project_msolano2_tpu.cli analyze fit \
+	  /tmp/pifft-analyze-demo.tsv --json \
+	  | python3 -c "import json, sys; r = json.load(sys.stdin); \
+	  rep = r['/tmp/pifft-analyze-demo.tsv']; \
+	  assert rep['total']['holds'] is True, rep['total']; \
+	  beta = rep['funnel']['beta']; lo, hi = rep['funnel']['ci95']['funnel']; \
+	  assert abs(beta - 2e-6) / 2e-6 < 0.05, beta; \
+	  assert lo < beta < hi, (lo, beta, hi); \
+	  print('# analyze fit ok: law holds, funnel beta %g (true 2e-6), CI [%g, %g]' % (beta, lo, hi))"
 
 bench: all
 	python3 bench.py

@@ -76,7 +76,6 @@ the CI gate over all of this.
 """
 
 import argparse
-import json
 import sys
 
 import numpy as np
@@ -372,6 +371,10 @@ def serve_load_main(args) -> int:
     import asyncio
 
     from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.analyze.records import (
+        emit_record,
+        env_fingerprint,
+    )
     from cs87project_msolano2_tpu.serve import (
         Dispatcher,
         ServeConfig,
@@ -405,6 +408,9 @@ def serve_load_main(args) -> int:
         "value": max((r["p99_ms"] for r in completed), default=None),
         "unit": "ms",
         "serve_load": rows,
+        # the comparability key `analyze gate` groups rounds by: a
+        # smoke SLO row must never read as a hardware regression
+        "env": env_fingerprint(smoke=smoke),
     }
     if smoke:
         record["smoke"] = True
@@ -414,11 +420,12 @@ def serve_load_main(args) -> int:
         record["run"] = obs.run_id()
         from cs87project_msolano2_tpu.obs import export, metrics
 
+        obs.emit("env", **record["env"])
         obs.emit("metrics", snapshot=metrics.snapshot())
         obs.flush()
         if args.trace_out:
             export.write_chrome_trace(args.trace_out)
-    print(json.dumps(record))
+    emit_record(record)
     return 0
 
 
@@ -612,6 +619,11 @@ def main(argv=None) -> int:
         c_ms = cell("c_baseline",
                     lambda: {"c_ms": measure_c_baseline_ms()})["c_ms"]
 
+    from cs87project_msolano2_tpu.analyze.records import (
+        emit_record,
+        env_fingerprint,
+    )
+
     tpu_ms = flagship["tpu_ms"]
     xla_ms = xla.get("xla_ms")
     gflops = 5.0 * n * np.log2(n) / (tpu_ms * 1e-3) / 1e9
@@ -620,6 +632,13 @@ def main(argv=None) -> int:
         "value": round(gflops, 1),
         "unit": "GFLOP/s",
         "plan": flagship["plan"],
+        # the environment fingerprint: the comparability key the
+        # regression gate groups rounds by (docs/ANALYSIS.md) — a smoke
+        # round must refuse comparison against a hardware round instead
+        # of reading as a throughput cliff.  The device kind is the one
+        # that actually served the flagship measurement.
+        "env": env_fingerprint(smoke=bool(args.smoke),
+                               device_kind=flagship.get("device_kind")),
     }
     if args.smoke:
         record["smoke"] = True
@@ -647,17 +666,20 @@ def main(argv=None) -> int:
     record.update(large)
     if obs.enabled():
         # the run id ties this record to every event/span/metric the
-        # run emitted; the metrics snapshot is the stream's last word
+        # run emitted; the metrics snapshot is the stream's last word,
+        # and the env event fingerprints the stream for the analyze
+        # loader exactly as record["env"] fingerprints the record
         record["run"] = obs.run_id()
         from cs87project_msolano2_tpu.obs import export, metrics
 
+        obs.emit("env", **record["env"])
         obs.emit("metrics", snapshot=metrics.snapshot())
         obs.flush()
         if args.trace_out:
             export.write_chrome_trace(args.trace_out)
             plans.warn(f"chrome trace written to {args.trace_out} "
                        f"(open in Perfetto)")
-    print(json.dumps(record))
+    emit_record(record)
     return 0
 
 

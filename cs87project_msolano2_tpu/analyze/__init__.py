@@ -1,0 +1,45 @@
+"""``analyze/`` — the statistical verification layer as a package
+(docs/ANALYSIS.md).
+
+The reference's capstone (SURVEY items 5-6) is hypothesis testing that
+measured runtimes fit the paper's complexity law
+Theta(n(p-1)/p) + Theta((n/p) log2(n/p)) plus fitted speedup figures.
+This package re-expresses that discipline over every measurement
+artifact the framework produces — harness TSVs, BENCH_r\\*.json round
+records, and the obs event/span JSONL — and turns the BENCH trajectory
+into an *enforced invariant*: ``pifft analyze gate`` fails CI with a
+named metric and a p-value on a statistically significant throughput
+regression, instead of a human noticing a smaller number in a JSON
+tail.
+
+Modules:
+
+* :mod:`.lawfit` — the two-coefficient zero-intercept law fit, latency
+  floor, significance + per-cell prediction gate (the single source of
+  truth ``analysis/analyze_results.py`` now shims), extended with
+  confidence intervals and per-cell residual reporting.
+* :mod:`.loader` — one typed sample table over all three measurement
+  sources, each round/stream stamped with an environment fingerprint so
+  only comparable rounds are ever compared.
+* :mod:`.phases` — funnel/tube phase attribution computed directly from
+  nested obs span durations (spans as a first-class measurement source,
+  docs/OBSERVABILITY.md), feeding the same two-law fit as TSV columns.
+* :mod:`.regress` — the nonparametric regression detector (Mann-Whitney
+  over replications, calibrated scalar fallback), change-point summary,
+  and the committed perf-baseline gate.
+* :mod:`.records` — the schema'd record emission helpers bench/harness
+  metric output goes through (check rule PIF109).
+* :mod:`.cli` — ``pifft analyze {fit, report, gate}``.
+"""
+
+from .lawfit import (  # noqa: F401
+    analyze,
+    analyze_table,
+    fit_laws,
+    laws,
+    model_for,
+    prediction_gate,
+    zero_intercept_fit,
+)
+from .loader import Fingerprint, SampleTable, load_bench_round  # noqa: F401
+from .regress import detect_regressions, gate_rounds  # noqa: F401

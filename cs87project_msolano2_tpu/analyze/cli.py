@@ -1,0 +1,260 @@
+"""``pifft analyze {fit, report, gate}`` (docs/ANALYSIS.md).
+
+* ``fit`` — the law fit over harness TSVs and/or the phase spans of an
+  obs event stream: two-coefficient zero-intercept regression,
+  significance + per-cell prediction gate, confidence intervals,
+  residuals, optional matplotlib speedup/residual figures.  Exit 0 iff
+  every fitted law holds (``--allow-fail`` inverts per file, keeping
+  documented negative results falsifying).
+* ``report`` — the loader inventory: samples per source, rounds with
+  environment fingerprints, span-vs-TSV phase shares, and the
+  change-point summary over the BENCH trajectory.
+* ``gate`` — the statistical perf-regression gate over BENCH_r*.json
+  (docs/ANALYSIS.md: Mann-Whitney over replications, calibrated
+  scalar fallback, fingerprint-gated comparability, committed
+  perf-baseline).  Exit 0 = no new significant regression; 1 = at
+  least one, each named with its p-value; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import lawfit, phases, regress
+from .loader import build_table, load_bench_rounds
+from .records import dump_json
+
+__all__ = ["analyze_main"]
+
+
+def _fit_main(args) -> int:
+    reports = {}
+    ok = True
+    for path in args.tsv:
+        try:
+            rep = lawfit.analyze(path, args.alpha, args.plots,
+                                 args.model, verbose=not args.json)
+        except SystemExit as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        reports[path] = rep
+        expected_fail = any(sub in path for sub in args.allow_fail)
+        holds = bool(rep["total"]["holds"])
+        if expected_fail:
+            if holds:
+                print(f"# {path}: documented law violation PASSED the "
+                      "fit — criterion lost its teeth", file=sys.stderr)
+                ok = False
+        else:
+            ok &= holds
+    if args.events:
+        from ..obs.events import load_events
+
+        try:
+            records, dropped = load_events(args.events)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        rows = phases.phase_rows_from_events(records)
+        if len(rows) == 0:
+            print(f"error: {args.events} carries no paired funnel/tube "
+                  "phase spans (arm the run with --events and a phase "
+                  "probe — docs/OBSERVABILITY.md)", file=sys.stderr)
+            return 2
+        model = args.model if args.model != "auto" else "per-processor"
+        rep = lawfit.analyze_table(
+            rows, model, alpha_level=args.alpha,
+            # span durations ride the same dispatch pipeline the TSV
+            # timers do: dispatch-piped models keep their floor column
+            # (docs/OBSERVABILITY.md promises exactly this)
+            has_floor=model in lawfit.FLOOR_MODELS,
+            label=f"{args.events} (span-derived)",
+            verbose=not args.json)
+        if dropped:
+            print(f"# {args.events}: {dropped} corrupt line(s) skipped",
+                  file=sys.stderr)
+        reports[args.events] = rep
+        ok &= bool(rep["total"]["holds"])
+    if not reports:
+        print("error: nothing to fit (give TSVs and/or --events)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(dump_json(reports))
+    return 0 if ok else 1
+
+
+def _report_main(args) -> int:
+    if not (args.tsv or args.bench or args.events):
+        print("error: nothing to report (give TSVs, --bench and/or "
+              "--events)", file=sys.stderr)
+        return 2
+    try:
+        table = build_table(tsv_paths=args.tsv, bench_paths=args.bench,
+                            events_paths=args.events)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    doc = table.summary()
+    # phase shares per derivation, cross-checkable cell by cell
+    shares = {}
+    tsv_rows = table.phase_rows("tsv")
+    if len(tsv_rows):
+        shares["tsv"] = {f"n={n} p={p}": v for (n, p), v in
+                         phases.phase_shares_from_rows(tsv_rows).items()}
+    obs_rows = table.phase_rows("obs")
+    if len(obs_rows):
+        shares["obs"] = {f"n={n} p={p}": v for (n, p), v in
+                         phases.phase_shares_from_rows(obs_rows).items()}
+    if shares:
+        doc["phase_shares"] = shares
+    if table.rounds:
+        doc["change_points"] = regress.change_points(table.rounds)
+        _, _, skipped = regress.detect_regressions(table.rounds)
+        doc["skipped_pairs"] = skipped
+        doc["comparable_pairs"] = (len(table.rounds) - 1 - len(skipped)
+                                   if len(table.rounds) > 1 else 0)
+    if args.json:
+        print(dump_json(doc))
+        return 0
+    print(f"samples: {doc['samples']} "
+          + " ".join(f"{k}={v}" for k, v in
+                     sorted(doc["by_source"].items())))
+    for rnd in doc["rounds"]:
+        print(f"  round r{rnd['index']:02d}  {rnd['path']:<18} "
+              f"{rnd['metrics']:>3} metric(s)  [{rnd['fingerprint']}]")
+    for pair in doc.get("skipped_pairs", []):
+        print(f"  incomparable r{pair['from_round']:02d}->"
+              f"r{pair['to_round']:02d}: {pair['reason']}")
+    for src, cells in shares.items():
+        print(f"phase shares ({src}-derived):")
+        for cell, v in cells.items():
+            print(f"  {cell:<18} funnel {v['funnel']:.3f}  "
+                  f"tube {v['tube']:.3f}  ({v['runs']} run(s))")
+    for metric, cp in sorted(doc.get("change_points", {}).items()):
+        print(f"change-point {metric}: r{cp['from_round']:02d}->"
+              f"r{cp['to_round']:02d} {cp['prev']:g} -> {cp['cur']:g} "
+              f"({cp['change'] * 100:+.1f}%)")
+    return 0
+
+
+def _gate_main(args) -> int:
+    try:
+        rounds = load_bench_rounds(args.bench)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if len(rounds) < 2:
+        print(f"error: a trajectory gate needs >= 2 rounds "
+              f"(got {len(rounds)})", file=sys.stderr)
+        return 2
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = regress.load_perf_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"error: unusable perf baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+    result = regress.gate_rounds(rounds, baseline, alpha=args.alpha,
+                                 threshold=args.threshold)
+    if args.write_baseline:
+        path = regress.write_perf_baseline(
+            args.write_baseline, result.new + result.accepted)
+        print(f"wrote {len(result.new) + len(result.accepted)} accepted "
+              f"regression(s) to {path}")
+        return 0
+    if args.json:
+        print(dump_json(result.to_json()))
+        return 0 if result.ok else 1
+    for rnd in result.rounds:
+        print(f"# round r{rnd.index:02d}  "
+              f"[{rnd.fingerprint.describe()}]  "
+              f"{len(rnd.metrics)} metric(s)")
+    for pair in result.skipped_pairs:
+        print(f"# skipped r{pair['from_round']:02d}->"
+              f"r{pair['to_round']:02d}: incomparable environments "
+              f"({pair['reason']})")
+    for r in result.accepted:
+        print(f"# accepted (baselined): {r.describe()}")
+    for key in result.fixed:
+        print(f"# fixed: baseline entry {key[0]} "
+              f"r{key[1]:02d}->r{key[2]:02d} no longer observed — "
+              "shrink the baseline")
+    insig = [r for r in result.candidates if not r.significant]
+    if insig:
+        print(f"# {len(insig)} worse-direction step(s) below "
+              "significance (noise-compatible)")
+    if result.new:
+        for r in result.new:
+            print(f"REGRESSION {r.describe()}")
+        print(f"analyze gate: {len(result.new)} new significant "
+              f"regression(s) — FAIL")
+        return 1
+    pairs = len(result.rounds) - 1 - len(result.skipped_pairs)
+    print(f"analyze gate: ok ({len(result.rounds)} rounds, "
+          f"{pairs} comparable pair(s), "
+          f"{len(result.candidates)} candidate step(s), 0 new "
+          "significant regressions)")
+    return 0
+
+
+def analyze_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu analyze",
+        description="statistical verification: law fitting over "
+                    "TSV/span measurements, loader inventory, and the "
+                    "perf-regression gate over the BENCH trajectory "
+                    "(docs/ANALYSIS.md)",
+    )
+    sub = ap.add_subparsers(dest="action", required=True)
+
+    fit = sub.add_parser("fit", help="fit the complexity laws")
+    fit.add_argument("tsv", nargs="*", help="harness TSV file(s)")
+    fit.add_argument("--events", default=None, metavar="FILE",
+                     help="also fit the funnel/tube phase spans of an "
+                          "obs event stream (span-derived table)")
+    fit.add_argument("--alpha", type=float, default=0.01)
+    fit.add_argument("--model", default="auto",
+                     choices=("auto",) + lawfit.MODELS)
+    fit.add_argument("--plots", default=None, metavar="DIR",
+                     help="write per-n speedup/phase PDF figures")
+    fit.add_argument("--allow-fail", action="append", default=[],
+                     help="path substring whose total-fit FAILURE is "
+                          "expected (documented negative results)")
+    fit.add_argument("--json", action="store_true")
+
+    report = sub.add_parser("report", help="loader inventory + phase "
+                                           "attribution + change points")
+    report.add_argument("tsv", nargs="*", help="harness TSV file(s)")
+    report.add_argument("--bench", nargs="*", default=[], metavar="FILE",
+                        help="BENCH round record(s)")
+    report.add_argument("--events", nargs="*", default=[],
+                        metavar="FILE", help="obs event stream(s)")
+    report.add_argument("--json", action="store_true")
+
+    gate = sub.add_parser("gate", help="the statistical perf-regression "
+                                       "gate over BENCH rounds")
+    gate.add_argument("bench", nargs="+", help="BENCH_r*.json trajectory")
+    gate.add_argument("--baseline", default=None, metavar="FILE",
+                      help="committed perf baseline (accepted "
+                           "regressions; the perf twin of "
+                           "check-baseline.json)")
+    gate.add_argument("--alpha", type=float,
+                      default=regress.DEFAULT_ALPHA)
+    gate.add_argument("--threshold", type=float,
+                      default=regress.DEFAULT_THRESHOLD,
+                      help="practical-significance floor (relative "
+                           "change in the worse direction)")
+    gate.add_argument("--write-baseline", default=None, metavar="FILE",
+                      help="record the currently significant "
+                           "regressions as accepted and exit 0")
+    gate.add_argument("--json", action="store_true")
+
+    args = ap.parse_args(argv)
+    if args.action == "fit":
+        return _fit_main(args)
+    if args.action == "report":
+        return _report_main(args)
+    return _gate_main(args)

@@ -1,0 +1,91 @@
+"""Paper-figure rendering (A3 parity): the reference's
+``analyze-results-full.R`` renders the publication figures — speedup vs
+p with one curve per n, and per-stage time shares — from the large
+committed datasets.  This module is the single source of truth the
+standalone ``analysis/analyze_results_full.py`` script now shims.
+
+:func:`figure` produces the same two-panel figure per dataset, all
+n-values overlaid, plus :func:`summary`'s text block, from our TSV
+contract.  Figures are best-effort: a machine without matplotlib gets
+the summary and a notice, never a crash (the reference's R -> awk
+fallback philosophy).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from .lawfit import fit_laws, load_tsv, model_for, zero_intercept_fit
+
+__all__ = ["figure", "summary"]
+
+
+def figure(path: str, outdir: str):
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception as e:
+        print(f"# matplotlib unavailable, no figures: {e}", file=sys.stderr)
+        return None
+
+    data, _ = load_tsv(path)
+    n, p, total, funnel, tube = data.T
+    stem = os.path.splitext(os.path.basename(path))[0]
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    for nn in sorted(set(n.astype(int))):
+        sel1 = (n == nn) & (p == 1)
+        if not sel1.any():
+            continue
+        t1 = float(np.mean(total[sel1]))
+        ps = np.array(sorted(set(p[n == nn].astype(int))))
+        emp = np.array([t1 / float(np.mean(total[(n == nn) & (p == pp)]))
+                        for pp in ps])
+        ax1.plot(ps, emp, "o-", label=f"n=2^{int(np.log2(nn))}")
+    ax1.set_xscale("log", base=2)
+    ax1.set_xlabel("processors p")
+    ax1.set_ylabel("speedup over p=1")
+    ax1.set_title("empirical speedup")
+    ax1.legend(fontsize=7)
+
+    # per-stage share of total at each p (aggregated over n)
+    ps = np.array(sorted(set(p.astype(int))))
+    fshare, tshare = [], []
+    for pp in ps:
+        sel = p == pp
+        tot = float(np.sum(funnel[sel]) + np.sum(tube[sel]))
+        fshare.append(float(np.sum(funnel[sel])) / tot if tot else 0.0)
+        tshare.append(float(np.sum(tube[sel])) / tot if tot else 0.0)
+    xs = [str(v) for v in ps]
+    ax2.bar(xs, fshare, label="funnel share")
+    ax2.bar(xs, tshare, bottom=fshare, label="tube share")
+    ax2.set_xlabel("processors p")
+    ax2.set_ylabel("share of per-processor time")
+    ax2.set_title("phase breakdown (funnel grows with p, as the law says)")
+    ax2.legend(fontsize=8)
+
+    fig.suptitle(stem)
+    fig.tight_layout()
+    out = os.path.join(outdir, f"{stem}-figures.pdf")
+    fig.savefig(out)
+    print(f"# wrote {out}", file=sys.stderr)
+    return out
+
+
+def summary(path: str) -> None:
+    data, _ = load_tsv(path)
+    n, p, total, funnel, tube = data.T
+    model = model_for(path)
+    # fit_laws: per-COLUMN regressors (serialized is hybrid — the phase
+    # columns are processor-0 timers, see lawfit.fit_laws)
+    _, funnel_law, tube_law = fit_laws(n, p, model)
+    print(f"== {os.path.basename(path)} (law model: {model}) ==")
+    for name, y, x in (("funnel", funnel, funnel_law),
+                       ("tube", tube, tube_law)):
+        beta, r2, t, a, df = zero_intercept_fit(x, y)
+        print(f"  {name}: beta={beta:.3e} R^2={r2:.4f} t={t:.1f} alpha={a:.2e}")

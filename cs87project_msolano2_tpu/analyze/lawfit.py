@@ -1,0 +1,658 @@
+"""Law fitting (the reference's L5 statistical verification): does the
+measured time obey the predicted complexity law?
+
+This module is the single source of truth the standalone scripts
+``analysis/analyze_results.py`` / ``analysis/analyze_results_full.py``
+now shim (docs/ANALYSIS.md).  The reference's R scripts
+(cpu/pthreads/analyze-results.R:23-157) fit
+
+    total ~ 0 + I(funnel_law + tube_law)     (zero-intercept regression)
+
+with funnel_law = n(p-1)/p and tube_law = (n/p) log2(n/p), report the
+significance of the fit, and plot empirical + fitted speedup.  This is
+the project's integration test: "the implementation scales as designed".
+
+The port is FALSIFIABLE (round 5 hardened it — the reference's
+single-beta significance test cannot reject any positively-correlated
+data):
+
+* the TOTAL is fitted against BOTH phase laws with separate
+  coefficients (the two phases' constants differ by ~800x in some
+  regimes here; the reference's hardware kept them comparable);
+* measurements riding a JAX dispatch pipeline carry a latency-FLOOR
+  column (with a physical sanity bound — see :func:`analyze_table`);
+* acceptance requires, besides significance of every material
+  coefficient, the per-cell PREDICTION GATE
+  median |log(measured/predicted)| < log 2 — the fitted law must
+  predict the typical cell within 2x, not merely correlate.
+
+Package-era extensions (ISSUE 9): every fit reports per-coefficient
+95% confidence intervals and per-(n, p)-cell residuals
+(``report["cells"]``), and :func:`analyze_table` accepts an in-memory
+sample table so span-derived phase times (:mod:`.phases`) feed the
+same fit as TSV columns.
+
+t-statistics use scipy when present, else a normal approximation;
+empirical and fitted speedup tables and optional matplotlib PDFs mirror
+the reference's per-n figure layout.  The awk fallback
+(analyze-results.awk) implements the same criterion for machines
+without numpy, keeping the reference's R -> awk fallback philosophy.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+import numpy as np
+
+__all__ = [
+    "FLOOR_MODELS", "LOG2_GATE", "MODELS", "NATIVE_TIMED",
+    "ON_CHIP_BACKENDS", "SERIALIZED_BACKENDS", "analyze", "analyze_table",
+    "demo_table", "fit_laws", "has_floor_for", "laws", "load_tsv",
+    "ls_fit", "model_for", "plot_results", "prediction_gate",
+    "predicted_total", "script_main", "t_ppf", "t_sf", "write_demo_tsv",
+    "zero_intercept_fit",
+]
+
+
+def t_sf(t: float, df: int) -> float:
+    """P(T > t) for Student's t; scipy when available, else normal tail."""
+    try:
+        from scipy import stats
+
+        return float(stats.t.sf(t, df))
+    except ImportError:
+        return 0.5 * math.erfc(t / math.sqrt(2.0))
+
+
+def t_ppf(q: float, df: int) -> float:
+    """Upper-tail critical value: t with P(T > t) = q (for confidence
+    intervals).  scipy when available, else bisection on :func:`t_sf`'s
+    normal-tail fallback — both sides of the fallback agree, so the
+    reported interval is internally consistent either way."""
+    try:
+        from scipy import stats
+
+        return float(stats.t.isf(q, df))
+    except ImportError:
+        lo, hi = 0.0, 50.0
+        for _ in range(80):
+            mid = 0.5 * (lo + hi)
+            if t_sf(mid, df) > q:
+                lo = mid
+            else:
+                hi = mid
+        return 0.5 * (lo + hi)
+
+
+def load_tsv(path: str) -> tuple:
+    """Returns (rows, n_degraded).  Rows carrying the harness's DEGRADED
+    marker (6th column: loop-slope fell back to dispatch-inclusive wall
+    time) are excluded from the fit — they carry ~100 ms of relay
+    overhead that is not device time."""
+    rows, degraded = [], 0
+    with open(path) as fh:
+        for line in fh:
+            parts = line.strip().split("\t")
+            if len(parts) in (5, 6) and parts[0] and parts[0][0].isdigit():
+                if len(parts) == 6:
+                    if parts[5] != "DEGRADED":
+                        raise SystemExit(
+                            f"{path}: unknown row marker {parts[5]!r} "
+                            "(only DEGRADED is defined) — refusing to fit "
+                            "data of unknown provenance"
+                        )
+                    degraded += 1
+                    continue
+                rows.append([float(v) for v in parts])
+    if not rows:
+        raise SystemExit(f"no usable data rows in {path}")
+    return np.asarray(rows), degraded  # n p total funnel tube
+
+
+# Which complexity law governs each phase depends on WHERE the p virtual
+# processors run:
+#  * per-processor (the reference's law, analyze-results.R:35-37): each
+#    of p real cores runs its own chain, so time tracks the per-processor
+#    work — funnel n(p-1)/p, tube (n/p)log2(n/p).
+#  * on-chip (single-accelerator butterfly backends jax/pallas): ALL p
+#    virtual processors are materialized as rows of one array on one
+#    chip, whose throughput is fixed — time tracks the TOTAL work, p x
+#    the per-processor law: funnel n(p-1) (the paper's redundant
+#    replication made explicit), tube n*log2(n/p) (each stage touches all
+#    n elements regardless of p).  On a real multi-chip mesh each device
+#    runs only its own chain (parallel/pi_shard.py), recovering the
+#    per-processor law.
+#  * einsum-dense (the einsum backend): the same phases expressed as
+#    dense contractions predict DIFFERENT complexity — funnel is the
+#    (p, p, s)-coefficient einsum, Theta(p*n) ~ n(p-1) total work (0 at
+#    p=1, where the funnel is empty); the tube is a dense s-point DFT
+#    matrix per segment — s^2 per processor, with the batch dimension
+#    absorbed by the MXU (see laws()).  Fitting the butterfly law to a
+#    dense implementation would test the wrong hypothesis.
+#  * serialized (CPU backends running all p virtual processors on fewer
+#    real cores: the `serial` backend by construction, and any backend
+#    swept with --oversubscribe, which the harness writes to a distinct
+#    `-oversub-` file so the regime is visible in the filename): wall
+#    time (total_ms) is the SUM over processors — the same total-work
+#    laws as on-chip — but the funnel/tube COLUMNS are still processor
+#    0's per-processor timers (native/pifft_backends.c:62-67), so the
+#    phase fits keep the per-processor laws.  See fit_laws().
+MODELS = ("per-processor", "on-chip", "einsum-dense", "serialized")
+ON_CHIP_BACKENDS = ("jax", "pallas")
+SERIALIZED_BACKENDS = ("serial",)
+
+
+def model_for(path: str, requested: str = "auto") -> str:
+    if requested != "auto":
+        return requested
+    base = os.path.basename(path)
+    if "-oversub-" in base:  # harness --oversubscribe output (any backend)
+        return "serialized"
+    if "-einsum-" in base:
+        return "einsum-dense"
+    if "-jax-scan-" in base:
+        # measured (round 5): the constant-geometry scan tube's stage
+        # ops carry a leading p dimension the VPU absorbs — at fixed n
+        # its time falls ~2x per p-doubling, the PER-PROCESSOR law, not
+        # the total-work law (same mechanism as the einsum s^2 tube:
+        # the chip is unsaturated by one chain, so the p virtual
+        # processors run physically in parallel on the vector units).
+        # The pallas backend, whose sequential grid programs DO
+        # saturate the chip, keeps the total-work on-chip model below.
+        return "per-processor"
+    if any(f"-{b}-" in base for b in ON_CHIP_BACKENDS):
+        return "on-chip"
+    if any(f"-{b}-" in base for b in SERIALIZED_BACKENDS):
+        return "serialized"
+    return "per-processor"
+
+
+def laws(n: np.ndarray, p: np.ndarray,
+         model: str = "per-processor") -> tuple:
+    s = n / p
+    log_s = np.where(s > 1, np.log2(np.maximum(s, 2)), 0.0)
+    if model in ("on-chip", "serialized"):
+        return n * (p - 1), n * log_s
+    if model == "einsum-dense":
+        # tube = a (p, s, s) batched dense matvec on the MXU.  TOTAL
+        # flops are p*s^2 = n^2/p, but the committed sweeps show time
+        # constant along fixed s and falling 4x per p-doubling — the
+        # chip absorbs the batch dimension (matvec leaves the MXU's
+        # lanes idle; batching fills them for free), so wall time
+        # tracks the PER-PROCESSOR dense work s^2 = n^2/p^2.  The
+        # round-4 criterion couldn't reject the total-work guess
+        # (894x measured vs "predicts 32x" while printing Yes); the
+        # falsifiable fit did, and this is the hardware-honest law.
+        return n * (p - 1), s * s
+    return n * (p - 1) / p, s * log_s
+
+
+def fit_laws(n: np.ndarray, p: np.ndarray, model: str) -> tuple:
+    """Per-COLUMN regressors ((total_funnel_x, total_tube_x), funnel_x,
+    tube_x).
+
+    The total is fitted against BOTH phase laws with separate
+    coefficients (round-4 verdict: the single-beta summed-law fit
+    cannot fail against monotone data — the einsum sweep's funnel and
+    tube constants differ by ~800x, and one beta split the difference
+    while the speedup table showed 894x measured vs "predicts 32x").
+    The reference could get away with one beta because its hardware had
+    comparable phase constants (analyze-results.R:46-50 fits the sum);
+    this framework's regimes don't.
+
+    The serialized model is hybrid: total_ms sums over the p virtual
+    processors run back-to-back (total-work laws), but the funnel/tube
+    columns are processor 0's own phase timers
+    (native/pifft_backends.c:62-67) and obey the per-processor laws —
+    fitting them against total-work laws is off by a factor of p (the
+    round-3 advisor measured tube R^2 0.999 -> 0.69 from exactly that).
+    Every other model times all three columns in the same regime."""
+    fl, tl = laws(n, p, model)
+    if model == "serialized":
+        pfl, ptl = laws(n, p, "per-processor")
+        return (fl, tl), pfl, ptl
+    return (fl, tl), fl, tl
+
+
+# Measurements that ride a JAX dispatch pipeline carry a per-run
+# latency FLOOR: a 2^14-point transform does not run 64x faster than a
+# 2^20-point one on hardware both underutilize (round-4 verdict: the
+# jax total fit was R^2=0.40 purely from this floor).  The fit includes
+# a constant column for them.  That is an implementation property, not
+# a law-model property: the per-device `-sharded-` dataset is
+# per-processor-law data timed through jitted jax calls (dispatch
+# ~tens of us), while the native-C-timed sweeps (serial, pthreads)
+# read the reference's floor-free form.
+FLOOR_MODELS = ("on-chip", "einsum-dense")
+NATIVE_TIMED = ("-serial-", "-pthreads-")
+
+
+def has_floor_for(path: str, model: str) -> bool:
+    base = os.path.basename(path)
+    if any(tag in base for tag in NATIVE_TIMED):
+        return False
+    return (model in FLOOR_MODELS or "-sharded-" in base
+            or "-jax-scan-" in base)
+
+
+def _ls_fit_full(y: np.ndarray, cols: list) -> tuple:
+    """Least squares y ~ sum_i beta_i * cols_i (no implicit intercept);
+    returns (betas, r2, tstats, alphas, df, ses) in the caller's units.
+
+    Columns are RMS-normalized internally (law columns span ~1e9 in
+    raw units next to a unit floor column; the raw normal equations'
+    conditioning produced garbage standard errors).  R^2 keeps the
+    zero-intercept convention (1 - SSR / sum(y^2)) so values stay
+    comparable with earlier rounds' logs and the reference's R output.
+    """
+    scales = np.array([max(float(np.sqrt(np.mean(c * c))), 1e-30)
+                       for c in cols])
+    X = np.column_stack([c / s for c, s in zip(cols, scales, strict=True)])
+    betas_n, *_ = np.linalg.lstsq(X, y, rcond=None)
+    resid = y - X @ betas_n
+    df = max(len(y) - X.shape[1], 1)
+    sigma2 = float(resid @ resid) / df
+    xtx_inv = np.linalg.pinv(X.T @ X)
+    ses = np.sqrt(np.maximum(sigma2 * np.diag(xtx_inv), 0.0))
+    tstats = np.where(ses > 0, betas_n / np.where(ses > 0, ses, 1.0), np.inf)
+    alphas = np.array([t_sf(float(t), df) if math.isfinite(t) else 0.0
+                       for t in tstats])
+    ss_tot = float(y @ y)
+    r2 = 1.0 - float(resid @ resid) / ss_tot if ss_tot > 0 else 0.0
+    return betas_n / scales, r2, tstats, alphas, df, ses / scales
+
+
+def ls_fit(y: np.ndarray, cols: list):
+    """(betas, r2, tstats, alphas, df) — the historical 5-tuple form
+    (see :func:`_ls_fit_full` for the standard errors)."""
+    betas, r2, tstats, alphas, df, _ = _ls_fit_full(y, cols)
+    return betas, r2, tstats, alphas, df
+
+
+LOG2_GATE = math.log(2.0)
+
+
+def prediction_gate(y: np.ndarray, yhat: np.ndarray) -> tuple:
+    """Per-cell prediction-error gate: median |log(measured/predicted)|
+    must be < log 2 (i.e. the fitted law predicts the TYPICAL cell
+    within 2x).  Significance alone cannot catch a law that mispredicts
+    per-cell behavior by 30x while correlating with it (round-4
+    verdict, the einsum speedup table).  Returns (ok, median_abs_log).
+
+    Cells where the law predicts <= 0: a correct zero (the phase is
+    empty there — e.g. funnel at p=1 — and the measurement agrees) is
+    skipped; a nonpositive prediction against a real measurement fails
+    the gate outright."""
+    tiny = 1e-3 * float(np.max(y)) if np.max(y) > 0 else 0.0
+    bad = (yhat <= 0) & (y > tiny)
+    if bad.any():
+        return False, float("inf")
+    both = (yhat > 0) & (y > 0)
+    if not both.any():
+        return True, 0.0
+    err = float(np.median(np.abs(np.log(y[both] / yhat[both]))))
+    return err < LOG2_GATE, err
+
+
+def predicted_total(report: dict, n: np.ndarray, p: np.ndarray,
+                    model: str) -> np.ndarray:
+    """Fitted-law total time at (n, p), for speedup tables and figures:
+    the TOTAL fit's own coefficients beta_f*funnel_law + beta_t*tube_law
+    (+ the latency floor where the model carries one)."""
+    fl, tl = laws(n, p, model)
+    t = report["total"]
+    return (t.get("beta_f", 0.0) * fl + t.get("beta_t", 0.0) * tl
+            + t.get("floor", 0.0))
+
+
+def zero_intercept_fit(x: np.ndarray, y: np.ndarray):
+    """y ~ 0 + beta*x: returns (beta, r2, tstat, alpha, df).  The
+    reference's single-regressor form, kept for the phase fits of
+    floor-free models."""
+    betas, r2, tstats, alphas, df = ls_fit(y, [x])
+    return float(betas[0]), r2, float(tstats[0]), float(alphas[0]), df
+
+
+def _cell_residuals(n: np.ndarray, p: np.ndarray, y: np.ndarray,
+                    yhat: np.ndarray) -> list:
+    """Per-(n, p)-cell residual records for the fitted quantity:
+    measured mean, predicted mean, and the log ratio the prediction
+    gate medians over — the 'which cell is the law missing' diagnostic
+    the round-4 verdict wanted next to a bare med|log err|."""
+    out = []
+    for nn in sorted(set(n.astype(int))):
+        for pp in sorted(set(p[n == nn].astype(int))):
+            sel = (n == nn) & (p == pp)
+            meas = float(np.mean(y[sel]))
+            pred = float(np.mean(yhat[sel]))
+            rec = {"n": nn, "p": pp, "measured": round(meas, 6),
+                   "predicted": round(pred, 6), "reps": int(sel.sum())}
+            if meas > 0 and pred > 0:
+                rec["log_ratio"] = round(math.log(meas / pred), 4)
+            out.append(rec)
+    return out
+
+
+def analyze_table(data: np.ndarray, model: str,
+                  alpha_level: float = 0.01, has_floor: bool = False,
+                  label: str = "<table>", degraded: int = 0,
+                  verbose: bool = True) -> dict:
+    """The law fit over an in-memory sample table (rows of
+    ``n p total funnel tube``, the TSV contract) — the single fitting
+    core behind :func:`analyze` (files) and :mod:`.phases`
+    (span-derived tables).  Returns the report dict; ``verbose=False``
+    suppresses the human log for library callers."""
+    say = print if verbose else (lambda *a, **k: None)
+    n, p, total, funnel, tube = data.T
+    (tfl, ttl), funnel_law, tube_law = fit_laws(n, p, model)
+
+    report = {"model": model}
+    say(f"== {label}: {len(n)} runs, "
+        f"n in {sorted(int(v) for v in set(n))}, "
+        f"p in {sorted(int(v) for v in set(p))}, "
+        f"law model: {model}"
+        f"{' + latency floor' if has_floor else ''} ==")
+    if degraded:
+        say(f"# excluded {degraded} DEGRADED rows "
+            "(dispatch-inclusive fallback timing)")
+    for name, y, xcols, colnames in (
+        ("total", total, [tfl, ttl], ["funnel", "tube"]),
+        ("funnel", funnel, [funnel_law], ["funnel"]),
+        ("tube", tube, [tube_law], ["tube"]),
+    ):
+        kept = [(c, nm) for c, nm in zip(xcols, colnames, strict=True)
+                if np.any(c)]
+        if not kept:
+            # Degenerate grid: the law is identically zero here (e.g. a
+            # p=1-only sweep, where funnel_law = n(p-1)/p = 0 — this
+            # container's pthreads capacity is 1 core).  The hypothesis
+            # "time scales as the law" is vacuously satisfied iff the
+            # measured phase time is also ~0; there is nothing to regress.
+            negligible = float(np.mean(y)) <= 1e-3 * float(np.mean(total))
+            verdict = "Yes (vacuous: law = 0 on this grid)" if negligible \
+                else "No"
+            say(f"{name:>6}: law = 0 over the whole grid; measured mean "
+                f"{float(np.mean(y)):.3e} ms  law holds: {verdict}")
+            report[name] = dict(beta=0.0, beta_f=0.0, beta_t=0.0, floor=0.0,
+                                r2=0.0, t=0.0, alpha=1.0, med_log_err=0.0,
+                                signif=negligible, holds=negligible,
+                                ci95={})
+            continue
+
+        def fit(cols, names):
+            betas, r2, tstats, alphas, df, ses = _ls_fit_full(y, cols)
+            return list(betas), r2, list(tstats), list(alphas), df, \
+                list(names), list(ses)
+
+        cols = [c for c, _ in kept]
+        names = [nm for _, nm in kept]
+        if has_floor:
+            # the floor rides each DISPATCHED run: the total always
+            # dispatches, but a phase whose law is 0 at a cell (funnel
+            # at p=1) never runs there — its floor column is the
+            # law-positive indicator, not all-ones
+            if name == "total":
+                fc = np.ones_like(y)
+            else:
+                fc = (cols[0] > 0).astype(float)
+            if np.any(fc):
+                cols = cols + [fc]
+                names = names + ["floor"]
+        betas, r2, tstats, alphas, df, names, ses = fit(cols, names)
+        # floor sanity: the dispatch floor is a LOWER-bound component of
+        # every dispatched run, so the fitted value can never exceed the
+        # smallest dispatched cell's mean (2x margin for noise).  A
+        # "floor" beyond that — or a negative one — is least squares
+        # using the constant column to absorb model misfit in the
+        # large cells (observed: an "82 ms floor" on the einsum sweep,
+        # 300x its smallest cell); drop the column and refit.
+        if "floor" in names:
+            fi = names.index("floor")
+            disp = cols[fi] > 0
+            cell_means = [float(np.mean(y[disp & (n == nn) & (p == pp)]))
+                          for nn in set(n[disp]) for pp in set(p[disp])
+                          if ((n == nn) & (p == pp) & disp).any()]
+            bound = 2.0 * min(cell_means) if cell_means else 0.0
+            if betas[fi] < 0 or betas[fi] > bound:
+                cols.pop(fi)
+                betas, r2, tstats, alphas, df, names, ses = fit(
+                    cols, [nm for nm in names if nm != "floor"])
+        # a law column whose fitted contribution is a negligible share
+        # of the measurement is noise to this fit: a negative or
+        # insignificant coefficient there says nothing about the law
+        # (the einsum funnel is ~0.1% of total next to the Theta(n^2/p)
+        # tube).  Drop negative-negligible columns; exempt
+        # positive-negligible ones from the significance requirement.
+        ymean = max(float(np.mean(y)), 1e-30)
+        while True:
+            shares = {nm: float(np.mean(b * c)) / ymean
+                      for nm, b, c in zip(names, betas, cols, strict=True)}
+            drop = [nm for nm in names if nm != "floor"
+                    and betas[names.index(nm)] < 0 and shares[nm] > -0.01]
+            if not drop:
+                break
+            i = names.index(drop[0])
+            cols.pop(i)
+            remaining = names[:i] + names[i + 1:]
+            if not remaining:
+                names = []
+                break  # nothing left to fit (corrupt data reached here)
+            betas, r2, tstats, alphas, df, names, ses = fit(cols, remaining)
+        # significance is demanded only of coefficients that carry a
+        # material share (>= 5%) of the fitted quantity: a term that
+        # explains 1-2% of a noisy measurement can be real physics with
+        # t < 2.6, and failing the whole law on it tests noise, not the
+        # law.  The prediction gate still covers the total behavior.
+        law_ix = [i for i, nm in enumerate(names) if nm != "floor"]
+        major = [i for i in law_ix if abs(shares[names[i]]) >= 0.05]
+        signif = bool(major) and all(
+            alphas[i] < alpha_level and betas[i] > 0 for i in major)
+        yhat = (np.column_stack(cols) @ np.asarray(betas)
+                if names else np.zeros_like(y))
+        gate_ok, med_err = prediction_gate(y, yhat)
+        holds = signif and gate_ok
+        verdict = ("Yes" if holds else
+                   f"No ({'prediction gate' if signif else 'significance'})")
+        frac = float(np.mean(y)) / max(float(np.mean(total)), 1e-30)
+        if not holds and name != "total" and frac < 0.01:
+            # A phase that is a sub-percent sliver of the total sits at
+            # the timing floor — its measurements are noise, and neither
+            # law acceptance nor rejection is supportable (e.g. the
+            # einsum funnel, Theta(n*p) work next to a Theta(n^2/p)
+            # tube: ratio n/p^2, thousands at these grids).  The
+            # reference never hits this (its funnel is a large share of
+            # total); report it as untestable rather than failing.
+            # record the distinct value "untestable" (truthy, so the
+            # law-gate consumers pass) rather than True, keeping a
+            # broken near-zero timer distinguishable from a real pass
+            holds = "untestable"
+            verdict = (f"untestable (phase is {frac * 100:.2g}% of "
+                       "total — below the timing floor)")
+        # 95% confidence intervals per retained coefficient (t-critical
+        # at the fit's residual df) — the package-era extension: a beta
+        # without an interval cannot anchor a cross-round comparison
+        tcrit = t_ppf(0.025, df)
+        ci95 = {nm: (round(betas[i] - tcrit * ses[i], 12),
+                     round(betas[i] + tcrit * ses[i], 12))
+                for i, nm in enumerate(names)}
+        terms = "  ".join(
+            f"{nm}={betas[i]:.3e}(t={tstats[i]:.1f},a={alphas[i]:.1e})"
+            for i, nm in enumerate(names))
+        say(f"{name:>6}: {terms}   R^2={r2:.4f} (df={df})  "
+            f"med|log err|={med_err:.3f} (gate {LOG2_GATE:.3f})  "
+            f"law holds: {verdict}")
+        get = lambda nm: (betas[names.index(nm)] if nm in names else 0.0)
+        first_law = names[law_ix[0]] if law_ix else None
+        report[name] = dict(
+            beta=get(first_law) if first_law else 0.0,
+            beta_f=get("funnel"), beta_t=get("tube"), floor=get("floor"),
+            r2=r2,
+            t=min((float(tstats[i]) for i in law_ix), default=0.0),
+            alpha=max((float(alphas[i]) for i in major), default=1.0)
+            if major else min((float(alphas[i]) for i in law_ix),
+                              default=1.0),
+            med_log_err=med_err, signif=signif, holds=holds, ci95=ci95)
+        if name == "total":
+            report["cells"] = _cell_residuals(n, p, y, yhat)
+
+    # speedup tables (reference: empirical + fitted, per n)
+    say("\nspeedup (empirical vs fitted-law):")
+    for nn in sorted(set(n.astype(int))):
+        sel1 = (n == nn) & (p == 1)
+        if not sel1.any():
+            continue
+        t1 = float(np.mean(total[sel1]))
+        t1_law = predicted_total(
+            report, np.array([float(nn)]), np.array([1.0]), model)[0]
+        for pp in sorted(set(p[n == nn].astype(int))):
+            sel = (n == nn) & (p == pp)
+            tp = float(np.mean(total[sel]))
+            tp_law = predicted_total(
+                report, np.array([float(nn)]), np.array([float(pp)]),
+                model)[0]
+            fitted = t1_law / max(tp_law, 1e-30)
+            say(f"  n={nn:>9} p={pp:>4}: {t1 / tp:7.2f}x  "
+                f"(law predicts {float(fitted):7.2f}x)")
+    return report
+
+
+def analyze(path: str, alpha_level: float = 0.01, plot_dir=None,
+            model: str = "auto", verbose: bool = True):
+    """The file entry point: load a harness TSV, pick the law model
+    from the filename, fit, optionally render the per-n figures."""
+    data, degraded = load_tsv(path)
+    model = model_for(path, model)
+    report = analyze_table(
+        data, model, alpha_level=alpha_level,
+        has_floor=has_floor_for(path, model),
+        label=os.path.basename(path), degraded=degraded, verbose=verbose)
+    if plot_dir:
+        try:
+            plot_results(data, report, plot_dir, os.path.basename(path))
+        except Exception as e:  # plots are best-effort, like the awk path
+            print(f"# plotting skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    return report
+
+
+def plot_results(data, report, plot_dir: str, stem: str):
+    """Per-n PDF: speedup scatter + fitted curve, stacked phase times —
+    mirroring the reference figure layout (analyze-results.R:119-151)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(plot_dir, exist_ok=True)
+    n, p, total, funnel, tube = data.T
+    model = report.get("model", "per-processor")
+
+    for nn in sorted(set(n.astype(int))):
+        sel1 = (n == nn) & (p == 1)
+        if not sel1.any():
+            continue
+        t1 = float(np.mean(total[sel1]))
+        ps = np.array(sorted(set(p[n == nn].astype(int))))
+        emp = np.array([t1 / float(np.mean(total[(n == nn) & (p == pp)]))
+                        for pp in ps])
+        grid = np.array([2**k for k in range(0, int(np.log2(ps.max())) + 1)])
+        fit = predicted_total(
+            report, np.array([float(nn)]), np.array([1.0]), model
+        )[0] / np.maximum(
+            predicted_total(report, np.full_like(grid, nn, dtype=float),
+                            grid.astype(float), model), 1e-30)
+
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9, 3.6))
+        ax1.plot(ps, emp, "o", label="measured")
+        ax1.plot(grid, fit, "-", label="fitted law")
+        ax1.set_xscale("log", base=2)
+        ax1.set_xlabel("p")
+        ax1.set_ylabel("speedup")
+        ax1.set_title(f"n = {nn}")
+        ax1.legend()
+
+        fmean = [float(np.mean(funnel[(n == nn) & (p == pp)])) for pp in ps]
+        tmean = [float(np.mean(tube[(n == nn) & (p == pp)])) for pp in ps]
+        ax2.bar([str(v) for v in ps], fmean, label="funnel")
+        ax2.bar([str(v) for v in ps], tmean, bottom=fmean, label="tube")
+        ax2.set_xlabel("p")
+        ax2.set_ylabel("phase time (ms)")
+        ax2.legend()
+        fig.tight_layout()
+        out = os.path.join(plot_dir, f"{stem}-n{nn}.pdf")
+        fig.savefig(out)
+        plt.close(fig)
+        print(f"# wrote {out}", file=sys.stderr)
+
+
+def demo_table(model: str = "per-processor", seed: int = 0,
+               beta_f: float = 2e-6, beta_t: float = 3e-6,
+               noise: float = 0.05,
+               ns=(1024, 4096, 16384), ps=(1, 2, 4, 8, 16),
+               reps: int = 5) -> np.ndarray:
+    """A law-obeying synthetic sample table (rows ``n p total funnel
+    tube``) — the self-test generator behind ``make analyze-smoke`` and
+    the fit-recovery tests: the fit must recover ``beta_f``/``beta_t``
+    from this data, and reject data that does not come from the law."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for n in ns:
+        for p in ps:
+            fl, tl = laws(np.array([float(n)]), np.array([float(p)]), model)
+            for _ in range(reps):
+                eps = 1.0 + noise * rng.standard_normal()
+                fm = beta_f * fl[0] * eps
+                tm = beta_t * tl[0] * eps
+                rows.append([n, p, fm + tm, fm, tm])
+    return np.asarray(rows)
+
+
+def write_demo_tsv(path: str, **kwargs) -> str:
+    """:func:`demo_table` in the harness TSV contract, for CLI smoke."""
+    data = demo_table(**kwargs)
+    with open(path, "w") as fh:
+        for n, p, total, fm, tm in data:
+            fh.write(f"{int(n)}\t{int(p)}\t{total:.6f}\t{fm:.6f}"
+                     f"\t{tm:.6f}\n")
+    return path
+
+
+def script_main(argv=None) -> int:
+    """The ``analysis/analyze_results.py`` entry point (shimmed)."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tsv", nargs="+")
+    ap.add_argument("--alpha", type=float, default=0.01)
+    ap.add_argument("--plots", default=None,
+                    help="directory for per-n PDF figures")
+    ap.add_argument("--model", default="auto",
+                    choices=("auto",) + MODELS,
+                    help="complexity-law model; auto picks einsum-dense "
+                         "for the einsum backend, on-chip for the other "
+                         "single-accelerator backends (jax/pallas), and "
+                         "per-processor otherwise")
+    ap.add_argument("--allow-fail", action="append", default=[],
+                    help="filename substring whose total-fit FAILURE is "
+                         "expected (documented negative results, e.g. "
+                         "-jax-unrolled-); such a file failing keeps the "
+                         "exit code 0, and PASSING flips it to 1 — the "
+                         "criterion must keep its teeth")
+    args = ap.parse_args(argv)
+    ok = True
+    for path in args.tsv:
+        report = analyze(path, args.alpha, args.plots, args.model)
+        expected_fail = any(sub in os.path.basename(path)
+                            for sub in args.allow_fail)
+        if expected_fail:
+            if report["total"]["holds"]:
+                print(f"# {os.path.basename(path)}: documented law "
+                      "violation PASSED the fit — criterion lost its "
+                      "teeth", file=sys.stderr)
+                ok = False
+            continue
+        ok &= bool(report["total"]["holds"])
+    return 0 if ok else 1

@@ -1,0 +1,348 @@
+"""The loader layer: one typed sample table over every measurement
+artifact the framework produces (docs/ANALYSIS.md).
+
+Three sources, one schema:
+
+* **harness TSVs** — ``n p total_ms funnel_ms tube_ms [DEGRADED]``
+  rows (the reference contract) become three phase samples per row;
+* **BENCH round records** — the driver-committed ``BENCH_r*.json``
+  files (``{"n": round, "cmd", "rc", "tail", "parsed": {...}}``)
+  become one :class:`BenchRound` each: every numeric field of
+  ``parsed`` is a metric (a list of numbers is a *replicated* metric
+  and earns the real Mann-Whitney test in :mod:`.regress`), and the
+  round carries an environment :class:`Fingerprint`;
+* **obs event streams** — the JSONL a run wrote with ``--events``:
+  funnel/tube span durations become phase samples (spans as a
+  first-class measurement source, docs/OBSERVABILITY.md — the
+  attribution logic lives in :mod:`.phases`), and a ``kind="env"``
+  event fingerprints the whole stream.
+
+**Fingerprints** gate comparability: rounds measured on different
+platforms, device kinds, or smoke tiers are never compared
+(``analyze gate`` reports the skipped pair instead of producing a
+bogus verdict).  Committed rounds predating the ``env`` stamp
+(BENCH_r01-r06) are backfilled tolerantly: the smoke flag from the
+parsed record, the platform from the jax platform banner in the
+captured ``tail`` — and any field that cannot be recovered stays
+``None``, which :meth:`Fingerprint.compatible` treats as "unknown,
+do not refuse on this field alone".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["BenchRound", "Fingerprint", "Sample", "SampleTable",
+           "load_bench_round", "load_bench_rounds", "load_obs_samples",
+           "load_tsv_samples", "build_table"]
+
+#: the jax platform banner the relay prints into captured bench tails —
+#: the backfill source for pre-``env`` committed rounds
+_PLATFORM_BANNER = re.compile(r"Platform '([A-Za-z0-9_]+)' is")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """The environment identity of one measurement round/stream."""
+
+    platform: Optional[str] = None
+    device_kind: Optional[str] = None
+    smoke: bool = False
+    git_rev: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[dict],
+                 smoke: Optional[bool] = None) -> "Fingerprint":
+        env = env or {}
+        return cls(platform=env.get("platform"),
+                   device_kind=env.get("device_kind"),
+                   smoke=bool(env.get("smoke", smoke or False)),
+                   git_rev=env.get("git_rev"))
+
+    def compatible(self, other: "Fingerprint") -> tuple:
+        """(ok, reason): whether metrics measured under ``self`` may be
+        compared against ``other``.  The smoke flag always decides
+        (it is never unknown); platform/device_kind refuse only when
+        BOTH sides are known and differ — a backfilled None means
+        "unrecoverable", not "different"."""
+        if self.smoke != other.smoke:
+            return False, "smoke tier vs hardware tier"
+        for field in ("platform", "device_kind"):
+            a, b = getattr(self, field), getattr(other, field)
+            if a is not None and b is not None and a != b:
+                return False, f"{field} {a!r} vs {b!r}"
+        return True, ""
+
+    def describe(self) -> str:
+        parts = [f"platform={self.platform or '?'}"]
+        if self.device_kind:
+            parts.append(f"device={self.device_kind}")
+        parts.append("smoke" if self.smoke else "hardware")
+        if self.git_rev:
+            parts.append(f"@{self.git_rev}")
+        return " ".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sample:
+    """One measured value with its full context — the table row every
+    source is normalized into."""
+
+    source: str               # "tsv" | "bench" | "obs"
+    metric: str               # "total_ms", "funnel_ms", "n2^24_gflops", ...
+    value: float
+    n: Optional[int] = None
+    p: Optional[int] = None
+    rep: Optional[int] = None
+    round_index: Optional[int] = None
+    fingerprint: Optional[Fingerprint] = None
+    degraded: bool = False
+
+
+@dataclasses.dataclass
+class BenchRound:
+    """One committed BENCH round record, normalized."""
+
+    index: int
+    path: str
+    metrics: dict            # name -> float | list[float] (replications)
+    fingerprint: Fingerprint
+    rc: Optional[int] = None
+    note: Optional[str] = None
+
+    def metric_names(self) -> list:
+        return sorted(self.metrics)
+
+
+class SampleTable:
+    """The merged table: samples from every ingested source plus the
+    bench rounds in trajectory order."""
+
+    def __init__(self):
+        self.samples: list = []
+        self.rounds: list = []
+
+    def add(self, samples) -> "SampleTable":
+        self.samples.extend(samples)
+        return self
+
+    def filter(self, **fields) -> list:
+        out = self.samples
+        for key, want in fields.items():
+            out = [s for s in out if getattr(s, key) == want]
+        return out
+
+    def metrics(self) -> list:
+        return sorted({s.metric for s in self.samples})
+
+    def phase_rows(self, source: str = "tsv") -> np.ndarray:
+        """``n p total funnel tube`` rows (the lawfit contract) from
+        this table's phase samples of one source, pairing the k-th
+        total/funnel/tube samples per (n, p) cell by rep index.
+        DEGRADED samples are excluded, exactly as the TSV fit excludes
+        the marked rows."""
+        cells: dict = {}
+        for s in self.samples:
+            if s.source != source or s.degraded or s.n is None:
+                continue
+            if s.metric in ("total_ms", "funnel_ms", "tube_ms"):
+                cells.setdefault((s.n, s.p, s.rep), {})[s.metric] = s.value
+        rows = []
+        for (n, p, _rep), vals in sorted(cells.items()):
+            if "funnel_ms" not in vals or "tube_ms" not in vals:
+                continue
+            total = vals.get("total_ms",
+                             vals["funnel_ms"] + vals["tube_ms"])
+            rows.append([n, p, total, vals["funnel_ms"], vals["tube_ms"]])
+        return np.asarray(rows) if rows else np.empty((0, 5))
+
+    def summary(self) -> dict:
+        by_source: dict = {}
+        for s in self.samples:
+            by_source[s.source] = by_source.get(s.source, 0) + 1
+        return {
+            "samples": len(self.samples),
+            "by_source": by_source,
+            "metrics": self.metrics(),
+            "rounds": [
+                {"index": r.index, "path": os.path.basename(r.path),
+                 "rc": r.rc, "metrics": len(r.metrics),
+                 "fingerprint": r.fingerprint.describe()}
+                for r in self.rounds
+            ],
+        }
+
+
+# ----------------------------------------------------------- TSV source
+
+
+def load_tsv_samples(path: str,
+                     fingerprint: Optional[Fingerprint] = None) -> list:
+    """Phase samples from one harness TSV.  DEGRADED rows are kept but
+    flagged (the fit path drops them; the loader is an inventory, not a
+    filter).  An UNKNOWN 6th-column marker raises — the same provenance
+    refusal the fit's own reader enforces (lawfit.load_tsv): data of
+    unknown provenance must not silently enter shares/cross-checks the
+    fit path would refuse."""
+    samples = []
+    reps: dict = {}
+    with open(path) as fh:
+        for line in fh:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) not in (5, 6) or not parts[0] \
+                    or not parts[0][0].isdigit():
+                continue
+            if len(parts) == 6 and parts[5] != "DEGRADED":
+                raise ValueError(
+                    f"{path}: unknown row marker {parts[5]!r} (only "
+                    "DEGRADED is defined) — refusing to ingest data of "
+                    "unknown provenance")
+            degraded = len(parts) == 6
+            n, p = int(parts[0]), int(parts[1])
+            rep = reps[(n, p)] = reps.get((n, p), -1) + 1
+            for metric, raw in zip(("total_ms", "funnel_ms", "tube_ms"),
+                                   parts[2:5], strict=True):
+                samples.append(Sample(
+                    source="tsv", metric=metric, value=float(raw),
+                    n=n, p=p, rep=rep, fingerprint=fingerprint,
+                    degraded=degraded))
+    return samples
+
+
+# --------------------------------------------------------- BENCH source
+
+#: parsed-record keys that are structure, not metrics
+_NON_METRIC_KEYS = frozenset(("metric", "unit", "smoke", "degraded",
+                              "run", "env", "note"))
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _round_index(doc: dict, path: str) -> int:
+    idx = doc.get("n")
+    if isinstance(idx, int):
+        return idx
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def load_bench_round(path: str) -> BenchRound:
+    """One BENCH_r*.json file -> a normalized :class:`BenchRound`.
+
+    Accepts both the driver's committed wrapper (``{"n", "cmd", "rc",
+    "tail", "parsed"}``) and a bare record (one JSON line from
+    ``bench.py`` itself).  Every numeric ``parsed`` field is a metric;
+    the headline ``value`` is renamed to the record's ``metric`` name;
+    a list of numbers is kept whole as a replicated metric."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+        else doc
+    metrics: dict = {}
+    for key, val in parsed.items():
+        if key in _NON_METRIC_KEYS:
+            continue
+        if key == "value":
+            name = parsed.get("metric")
+            if isinstance(name, str) and name and _numeric(val):
+                metrics[name] = float(val)
+            continue
+        if _numeric(val):
+            metrics[key] = float(val)
+        elif isinstance(val, list) and val and all(_numeric(v)
+                                                  for v in val):
+            metrics[key] = [float(v) for v in val]
+    # fingerprint: the stamped env when present, else backfill from the
+    # record's smoke flag and the platform banner in the captured tail
+    env = parsed.get("env") if isinstance(parsed.get("env"), dict) \
+        else None
+    fp = Fingerprint.from_env(env, smoke=bool(parsed.get("smoke", False)))
+    if env is None:
+        tail = doc.get("tail") if isinstance(doc.get("tail"), str) else ""
+        m = _PLATFORM_BANNER.search(tail)
+        if m:
+            fp = dataclasses.replace(fp, platform=m.group(1))
+    return BenchRound(index=_round_index(doc, path), path=path,
+                      metrics=metrics, fingerprint=fp,
+                      rc=doc.get("rc") if isinstance(doc.get("rc"), int)
+                      else None,
+                      note=doc.get("note") if isinstance(doc.get("note"),
+                                                         str) else None)
+
+
+def load_bench_rounds(paths) -> list:
+    """Rounds sorted into trajectory order (by round index, then
+    filename, so ties from hand-built files stay deterministic)."""
+    rounds = [load_bench_round(p) for p in paths]
+    rounds.sort(key=lambda r: (r.index, os.path.basename(r.path)))
+    return rounds
+
+
+_LOGN_METRIC = re.compile(r"^n2\^(\d+)_")
+
+
+def bench_samples(rnd: BenchRound) -> list:
+    """A round's metrics as flat samples (n parsed from the ``n2^K_``
+    row prefix where one exists; replicated metrics flatten with rep
+    indices)."""
+    out = []
+    for name, val in rnd.metrics.items():
+        m = _LOGN_METRIC.match(name)
+        n = (1 << int(m.group(1))) if m else None
+        values = val if isinstance(val, list) else [val]
+        for rep, v in enumerate(values):
+            out.append(Sample(
+                source="bench", metric=name, value=v, n=n,
+                rep=rep if isinstance(val, list) else None,
+                round_index=rnd.index, fingerprint=rnd.fingerprint))
+    return out
+
+
+# ----------------------------------------------------------- obs source
+
+
+def load_obs_samples(path: str) -> tuple:
+    """(samples, fingerprint, dropped_lines) from an obs event-stream
+    JSONL: every funnel/tube span becomes a phase sample keyed by its
+    cell identity, and a ``kind="env"`` event (bench/harness emit one
+    when armed) fingerprints the stream.  The reader tolerates the
+    half-written tail a kill leaves (the journal discipline) — a
+    truncated final line is counted, not fatal."""
+    from ..obs.events import load_events
+    from .phases import phase_samples_from_events
+
+    records, dropped = load_events(path)
+    fp = None
+    for rec in records:
+        if rec.get("kind") == "env" and isinstance(rec.get("payload"),
+                                                   dict):
+            fp = Fingerprint.from_env(rec["payload"])
+    samples = phase_samples_from_events(records, fingerprint=fp)
+    return samples, fp, dropped
+
+
+# -------------------------------------------------------------- merging
+
+
+def build_table(tsv_paths=(), bench_paths=(), events_paths=()) \
+        -> SampleTable:
+    """Ingest every named artifact into one table."""
+    table = SampleTable()
+    for path in tsv_paths:
+        table.add(load_tsv_samples(path))
+    if bench_paths:
+        table.rounds = load_bench_rounds(bench_paths)
+        for rnd in table.rounds:
+            table.add(bench_samples(rnd))
+    for path in events_paths:
+        samples, _fp, _dropped = load_obs_samples(path)
+        table.add(samples)
+    return table

@@ -1,0 +1,144 @@
+"""Phase attribution from obs spans (docs/OBSERVABILITY.md,
+docs/ANALYSIS.md): funnel/tube time computed directly from the nested
+span durations a run emitted, instead of from TSV columns.
+
+``models/pi_fft.py`` wraps its two algorithm phases in named spans —
+``funnel`` (the replicated accumulation) and ``tube`` (the segment-
+local chains) — each carrying its cell identity ``{"n": .., "p": ..}``.
+A run armed with ``--events`` therefore already contains a complete
+phase-time decomposition of every transform it executed; this module
+turns that stream into the same ``n p total funnel tube`` sample rows
+the harness TSVs carry, so the two-law fit (:mod:`.lawfit`) can run on
+*measured per-phase span times* with no TSV in the loop, and the two
+derivations can be cross-checked against each other
+(:func:`phase_shares` over either source; the tests assert agreement
+on identical synthetic runs).
+
+Span caveat (the spans-module contract): a span duration is a
+host-side wall interval — on an async dispatch pipeline it is NOT a
+device measurement unless the span closed over an explicit sync.  The
+pi-FFT phase spans wrap eager numpy/jit-blocking phase code, where
+wall time IS phase time; attribution from spans around un-synced
+dispatches would attribute launch time, which is why the fit keeps the
+latency-floor column for on-chip models either way.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..obs.export import spans_from_events
+
+__all__ = ["PHASE_SPAN_NAMES", "phase_rows_from_events",
+           "phase_samples_from_events", "phase_shares",
+           "phase_shares_from_events", "phase_shares_from_rows"]
+
+#: the span names that ARE the algorithm's phase decomposition
+PHASE_SPAN_NAMES = ("funnel", "tube")
+
+
+def _phase_pairs(records: Iterable[dict]) -> dict:
+    """(n, p) -> list of {"funnel_ms": .., "tube_ms": ..} per executed
+    transform, pairing the k-th funnel span with the k-th tube span of
+    the same cell (one transform emits exactly one of each, in order;
+    seq order within the stream preserves that pairing)."""
+    per_cell: dict = {}
+    for sp in spans_from_events(records):
+        name = sp.get("name")
+        if name not in PHASE_SPAN_NAMES:
+            continue
+        cell = sp.get("cell") or {}
+        n, p = cell.get("n"), cell.get("p")
+        if not isinstance(n, int) or not isinstance(p, int):
+            continue
+        runs = per_cell.setdefault((n, p), [])
+        key = f"{name}_ms"
+        # first run still missing this phase gets it; else a new run
+        target = next((r for r in runs if key not in r), None)
+        if target is None:
+            target = {}
+            runs.append(target)
+        target[key] = float(sp.get("dur_s", 0.0)) * 1e3
+    return per_cell
+
+
+def phase_rows_from_events(records: Iterable[dict]) -> np.ndarray:
+    """``n p total funnel tube`` rows (the lawfit/TSV contract) from an
+    event stream's phase spans; total is the phase sum (the TSV total
+    column is also funnel+tube for every backend without a separate
+    total timer).  Incomplete pairs (a run killed between its funnel
+    and tube span) are dropped, like the journal reader drops a
+    half-written tail."""
+    rows = []
+    for (n, p), runs in sorted(_phase_pairs(records).items()):
+        for run in runs:
+            if "funnel_ms" not in run or "tube_ms" not in run:
+                continue
+            rows.append([n, p, run["funnel_ms"] + run["tube_ms"],
+                         run["funnel_ms"], run["tube_ms"]])
+    return np.asarray(rows) if rows else np.empty((0, 5))
+
+
+def phase_samples_from_events(records: Iterable[dict],
+                              fingerprint=None) -> list:
+    """The same pairing as :func:`phase_rows_from_events`, as loader
+    samples (source ``"obs"``) so the merged table can fit or
+    cross-check them."""
+    from .loader import Sample
+
+    out = []
+    for (n, p), runs in sorted(_phase_pairs(records).items()):
+        for rep, run in enumerate(runs):
+            if "funnel_ms" not in run or "tube_ms" not in run:
+                continue
+            for metric in ("funnel_ms", "tube_ms"):
+                out.append(Sample(source="obs", metric=metric,
+                                  value=run[metric], n=n, p=p, rep=rep,
+                                  fingerprint=fingerprint))
+            out.append(Sample(source="obs", metric="total_ms",
+                              value=run["funnel_ms"] + run["tube_ms"],
+                              n=n, p=p, rep=rep, fingerprint=fingerprint))
+    return out
+
+
+def phase_shares_from_rows(rows: np.ndarray) -> dict:
+    """(n, p) -> {"funnel": share, "tube": share, "runs": k} from
+    ``n p total funnel tube`` rows (either derivation).  Shares are of
+    the phase SUM — the decomposition the paper's law speaks about —
+    so the TSV- and span-derived values are directly comparable even
+    where a TSV total column carries overhead outside both phases."""
+    out: dict = {}
+    if len(rows) == 0:
+        return out
+    n, p, _total, funnel, tube = np.asarray(rows).T
+    for nn in sorted(set(n.astype(int))):
+        for pp in sorted(set(p[n == nn].astype(int))):
+            sel = (n == nn) & (p == pp)
+            f = float(np.sum(funnel[sel]))
+            t = float(np.sum(tube[sel]))
+            tot = f + t
+            out[(int(nn), int(pp))] = {
+                "funnel": f / tot if tot else 0.0,
+                "tube": t / tot if tot else 0.0,
+                "runs": int(sel.sum()),
+            }
+    return out
+
+
+def phase_shares_from_events(records: Iterable[dict]) -> dict:
+    return phase_shares_from_rows(phase_rows_from_events(records))
+
+
+def phase_shares(source, tsv_path: Optional[str] = None) -> dict:
+    """Dispatch helper: an events-record list, a span-rows array, or a
+    TSV path (via ``tsv_path=``) — all land in the same share table."""
+    if tsv_path is not None:
+        from .lawfit import load_tsv
+
+        data, _ = load_tsv(tsv_path)
+        return phase_shares_from_rows(data)
+    if isinstance(source, np.ndarray):
+        return phase_shares_from_rows(source)
+    return phase_shares_from_events(source)

@@ -1,0 +1,145 @@
+"""Schema'd metric-record emission (the PIF109 sanctioned layer).
+
+``bench.py`` and the harness print ONE JSON line per run — the line the
+driver commits as ``BENCH_r*.json`` and ``pifft analyze gate`` later
+fits laws over.  An ad-hoc ``json.dumps`` at the emission site can ship
+a record missing the ``metric``/``value``/``unit`` envelope or the
+environment fingerprint, and the gate then either refuses the round or
+— worse — compares a smoke round against hardware.  Every metric
+emission therefore goes through this module (check rule PIF109,
+docs/CHECKS.md): :func:`emit_record` validates the envelope, stamps
+nothing silently, and is the ONE ``json.dumps`` call site on the
+bench/harness metric path.
+
+The **environment fingerprint** (:func:`env_fingerprint`) is the
+comparability key the regression gate groups rounds by: accelerator
+platform, device kind, the smoke flag, and the git revision when one
+is resolvable.  Two rounds whose fingerprints are incompatible
+(:meth:`.loader.Fingerprint.compatible`) are never compared — a CPU
+smoke round "regressing" from a TPU hardware round is not a verdict,
+it is a category error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+__all__ = ["dump_json", "dump_record", "emit_record", "env_fingerprint",
+           "validate_record"]
+
+#: bump when the record envelope changes incompatibly
+RECORD_SCHEMA_VERSION = 1
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _git_rev() -> Optional[str]:
+    """Short git revision of the repo this package lives in, or None
+    (detached artifact dirs, sdist installs, missing git)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO_ROOT,
+            capture_output=True, text=True, timeout=5)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def env_fingerprint(smoke: bool = False,
+                    device_kind: Optional[str] = None) -> dict:
+    """The environment fingerprint stamped on every emitted round
+    record (and mirrored as an ``env`` obs event by armed runs):
+    ``{"platform", "device_kind", "smoke", "git_rev"}``.  ``platform``
+    is the jax backend actually serving this process (axon/tpu/cpu/...)
+    or None where jax is absent; ``git_rev`` is best-effort."""
+    platform = None
+    try:
+        import jax
+
+        platform = str(jax.default_backend())
+    except (ImportError, RuntimeError):
+        # jax absent or no backend initializable: the fingerprint is
+        # still valid, with the platform honestly unknown
+        platform = None
+    fp = {"platform": platform, "device_kind": device_kind,
+          "smoke": bool(smoke)}
+    rev = _git_rev()
+    if rev:
+        fp["git_rev"] = rev
+    return fp
+
+
+def validate_record(rec) -> list:
+    """Problems with a metric record's envelope (empty = valid): it
+    must be a JSON-safe object carrying ``metric`` (str), ``value``
+    (number or None — a failed headline is explicit, never absent) and
+    ``unit`` (str)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    if not isinstance(rec.get("metric"), str) or not rec.get("metric"):
+        problems.append("missing/empty 'metric' name")
+    if "value" not in rec:
+        problems.append("missing 'value' (a failed measurement is an "
+                        "explicit null, not an absent key)")
+    elif (rec["value"] is not None
+          and not isinstance(rec["value"], (int, float))) \
+            or isinstance(rec["value"], bool):
+        problems.append(f"'value' is {type(rec['value']).__name__}, "
+                        "not a number")
+    if not isinstance(rec.get("unit"), str) or not rec.get("unit"):
+        problems.append("missing/empty 'unit'")
+    env = rec.get("env")
+    if env is not None:
+        if not isinstance(env, dict):
+            problems.append(f"'env' is {type(env).__name__}, not a "
+                            "fingerprint object")
+        elif "smoke" not in env:
+            problems.append("'env' fingerprint lacks the 'smoke' flag")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        problems.append(f"record is not JSON-serializable: {e}")
+    return problems
+
+
+def dump_record(rec: dict) -> str:
+    """The validated one-line JSON form of a metric record; raises
+    ``ValueError`` naming every envelope problem rather than emitting a
+    record the gate would refuse later."""
+    problems = validate_record(rec)
+    if problems:
+        raise ValueError("refusing to emit a malformed metric record: "
+                         + "; ".join(problems))
+    return json.dumps(rec)
+
+
+def emit_record(rec: dict, stream=None) -> dict:
+    """Validate and print one metric record (the bench/harness emission
+    path); returns the record."""
+    print(dump_record(rec), file=stream if stream is not None
+          else sys.stdout)
+    return rec
+
+
+def _json_default(o):
+    """numpy scalars (betas, p-values) degrade to floats, anything
+    else to its repr — CLI output must never crash on a report field."""
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+def dump_json(obj, indent: int = 1) -> str:
+    """Pretty JSON for analyze CLI output (reports, gate verdicts) —
+    kept here so the analyze/bench/harness surface has exactly one
+    serialization module (PIF109)."""
+    return json.dumps(obj, indent=indent, sort_keys=True,
+                      default=_json_default)

@@ -1,0 +1,393 @@
+"""The regression detector: nonparametric comparison of each metric
+between successive comparable BENCH rounds, a change-point summary over
+the whole trajectory, and the committed perf-baseline gate
+(docs/ANALYSIS.md).
+
+**What counts as a regression.**  Every metric has a *better*
+direction inferred from its name (GFLOP/s and ``vs_*`` speedups go up;
+``*_ms`` latencies and SLO percentiles go down; anything unclassifiable
+is skipped, never guessed).  A candidate regression is a step between
+two *fingerprint-compatible* successive rounds that moves in the worse
+direction; it becomes significant only when BOTH hold:
+
+* the relative change exceeds the practical threshold (default 10% —
+  below that the verdict would be about measurement noise, not the
+  code), and
+* the statistical test rejects "no change" at ``alpha``:
+
+  - **replicated metrics** (a round recording a list of values per
+    metric) get a one-sided Mann-Whitney U test — rank-based, no
+    normality assumption, exactly the "bootstrap or Mann-Whitney over
+    replications" discipline the reference's R scripts apply to their
+    replication columns;
+  - **scalar metrics** (the committed BENCH_r01..r06 records carry one
+    value per metric) get a calibrated z-score: the trajectory's own
+    step-to-step |log change| distribution (median/MAD, robust to the
+    very outlier under test) estimates the round-to-round noise scale,
+    with a floor so a 2-round history cannot claim perfect precision.
+    The resulting p-value is honest about what a single number can
+    support — a noisy trajectory widens its own tolerance instead of
+    producing bogus verdicts.
+
+**The gate.**  ``pifft analyze gate`` compares detected regressions
+against the committed ``perf-baseline.json`` exactly as ``pifft
+check`` compares findings against ``check-baseline.json``: accepted
+(documented) regressions pass, NEW ones fail CI with the metric name,
+the round pair, and the p-value; baseline entries no longer observed
+are reported as fixed so the file can shrink.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .loader import BenchRound
+
+__all__ = ["Regression", "GateResult", "change_points", "compare_pair",
+           "detect_regressions", "direction_of", "gate_rounds",
+           "load_perf_baseline", "mann_whitney", "write_perf_baseline"]
+
+#: default practical-significance threshold (relative change in the
+#: worse direction below this is never flagged, whatever its p-value)
+DEFAULT_THRESHOLD = 0.10
+
+#: default statistical-significance level
+DEFAULT_ALPHA = 0.05
+
+#: the scalar calibration can never claim the trajectory is quieter
+#: than this (log-change units): a short or lucky history must not
+#: make a 6% wobble "significant"
+SIGMA_FLOOR = 0.05
+
+#: minimum median relative change for a replicated-metric flag — a
+#: Mann-Whitney p below alpha with a sub-noise median shift is a
+#: distribution-shape verdict, not a throughput regression
+REPLICATED_MIN_CHANGE = 0.05
+
+
+def direction_of(metric: str) -> Optional[str]:
+    """"higher" (is better) / "lower" / None (not a perf metric —
+    plan descriptions, counts, round bookkeeping — skipped)."""
+    name = metric.lower()
+    if "gflops" in name:
+        return "higher"
+    if name.startswith("vs_") or "_vs_" in name or name.endswith("_vs_xla"):
+        return "higher"
+    if "roofline" in name or "util" in name:
+        return "higher"
+    if name.endswith("_ms") or "_ms_" in name or "p99" in name \
+            or "p50" in name:
+        return "lower"
+    return None
+
+
+def _norm_sf(z: float) -> float:
+    """P(Z > z), standard normal."""
+    return 0.5 * math.erfc(z / math.sqrt(2.0))
+
+
+def mann_whitney(a, b) -> tuple:
+    """One-sided Mann-Whitney U: (u_statistic, p) for H1 "values in
+    ``b`` tend to be SMALLER than values in ``a``" (caller orients the
+    worse direction).  Normal approximation with tie correction —
+    adequate at bench replication depths (>= ~5 per side), and scipy-
+    free so the gate runs anywhere the loader does."""
+    a = np.asarray(a, dtype=float)
+    b = np.asarray(b, dtype=float)
+    na, nb = len(a), len(b)
+    if na == 0 or nb == 0:
+        return 0.0, 1.0
+    pooled = np.concatenate([a, b])
+    order = np.argsort(pooled, kind="mergesort")
+    ranks = np.empty(len(pooled))
+    # midranks for ties
+    sorted_vals = pooled[order]
+    i = 0
+    while i < len(sorted_vals):
+        j = i
+        while j + 1 < len(sorted_vals) and sorted_vals[j + 1] == \
+                sorted_vals[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    ra = float(np.sum(ranks[:na]))
+    u_a = ra - na * (na + 1) / 2.0        # large when a ranks high
+    mean_u = na * nb / 2.0
+    # tie-corrected variance
+    _, counts = np.unique(pooled, return_counts=True)
+    tie_term = float(np.sum(counts**3 - counts))
+    nn = na + nb
+    var_u = na * nb / 12.0 * ((nn + 1) - tie_term / (nn * (nn - 1))) \
+        if nn > 1 else 0.0
+    if var_u <= 0:
+        return u_a, 1.0
+    # H1: b smaller than a  <=>  a's ranks high  <=>  u_a large
+    z = (u_a - mean_u - 0.5) / math.sqrt(var_u)
+    return u_a, _norm_sf(z)
+
+
+@dataclasses.dataclass
+class Regression:
+    """One flagged (or candidate) worse-direction step."""
+
+    metric: str
+    from_round: int
+    to_round: int
+    prev: float
+    cur: float
+    change: float             # relative, signed in raw units
+    p_value: float
+    test: str                 # "mann-whitney" | "scalar-z"
+    significant: bool
+    direction: str
+
+    def key(self) -> tuple:
+        """Baseline identity, like a check finding's (rule, path,
+        message) key: metric + the round pair."""
+        return (self.metric, self.from_round, self.to_round)
+
+    def describe(self) -> str:
+        arrow = f"{self.prev:g} -> {self.cur:g}"
+        return (f"{self.metric}: r{self.from_round:02d}->"
+                f"r{self.to_round:02d} {arrow} "
+                f"({self.change * 100:+.1f}%, worse; p={self.p_value:.3g},"
+                f" {self.test})")
+
+
+def _rep_mean(val) -> float:
+    return float(np.mean(val)) if isinstance(val, list) else float(val)
+
+
+def _trajectory_sigma(rounds: list, exclude: Optional[tuple] = None) \
+        -> float:
+    """The scalar-comparison noise scale: robust spread of every
+    |log change| between successive comparable rounds, over every
+    directional metric — the trajectory's own empirical round-to-round
+    volatility.  ``exclude`` drops one (from_index, to_index) pair:
+    the step under test must not calibrate its own tolerance, or a
+    large injected regression widens sigma until it excuses itself
+    (leave-one-pair-out)."""
+    changes = []
+    for prev, cur in _comparable_pairs(rounds):
+        if exclude is not None and (prev.index, cur.index) == exclude:
+            continue
+        for metric in set(prev.metrics) & set(cur.metrics):
+            if direction_of(metric) is None:
+                continue
+            a, b = _rep_mean(prev.metrics[metric]), \
+                _rep_mean(cur.metrics[metric])
+            if a > 0 and b > 0:
+                changes.append(abs(math.log(b / a)))
+    if len(changes) < 4:
+        return SIGMA_FLOOR
+    # the MAD-from-zero estimator: under X ~ N(0, sigma),
+    # median(|X|) = 0.6745 sigma.  Genuine improvements in the history
+    # inflate the estimate — a volatile trajectory honestly widens its
+    # own tolerance rather than producing confident verdicts single
+    # numbers cannot support.
+    return max(1.4826 * float(np.median(np.asarray(changes))),
+               SIGMA_FLOOR)
+
+
+def _comparable_pairs(rounds: list) -> list:
+    out = []
+    for prev, cur in zip(rounds, rounds[1:]):
+        ok, _ = prev.fingerprint.compatible(cur.fingerprint)
+        if ok:
+            out.append((prev, cur))
+    return out
+
+
+def compare_pair(prev: BenchRound, cur: BenchRound, sigma: float,
+                 alpha: float = DEFAULT_ALPHA,
+                 threshold: float = DEFAULT_THRESHOLD) -> list:
+    """Every worse-direction step between two comparable rounds (the
+    caller has already checked fingerprints), each carrying its
+    p-value; ``significant`` is set per the module contract."""
+    out = []
+    for metric in sorted(set(prev.metrics) & set(cur.metrics)):
+        worse = direction_of(metric)
+        if worse is None:
+            continue
+        pv, cv = prev.metrics[metric], cur.metrics[metric]
+        a, b = _rep_mean(pv), _rep_mean(cv)
+        if a <= 0 or b <= 0:
+            continue
+        change = (b - a) / a
+        regressed = change < 0 if worse == "higher" else change > 0
+        if not regressed:
+            continue
+        # >= 5 per side: below that the normal approximation is
+        # anticonservative (3v3 complete separation approximates to
+        # p=0.04 where the exact test's floor is 1/C(6,3)=0.05 — a
+        # verdict the test cannot actually produce); thinner
+        # replication falls back to the calibrated scalar path
+        replicated = isinstance(pv, list) and isinstance(cv, list) \
+            and len(pv) >= 5 and len(cv) >= 5
+        if replicated:
+            # orient so H1 = "cur is worse": for higher-better metrics
+            # worse means cur smaller than prev
+            if worse == "higher":
+                _, p = mann_whitney(pv, cv)
+            else:
+                _, p = mann_whitney([-v for v in pv], [-v for v in cv])
+            med_change = abs(float(np.median(cv)) / float(np.median(pv))
+                             - 1.0)
+            significant = p < alpha and med_change > REPLICATED_MIN_CHANGE
+            test = "mann-whitney"
+        else:
+            z = abs(math.log(b / a)) / max(sigma, 1e-9)
+            p = _norm_sf(z)
+            significant = p < alpha and abs(change) > threshold
+            test = "scalar-z"
+        out.append(Regression(
+            metric=metric, from_round=prev.index, to_round=cur.index,
+            prev=round(a, 6), cur=round(b, 6), change=round(change, 6),
+            p_value=float(p), test=test, significant=significant,
+            direction=worse))
+    return out
+
+
+def detect_regressions(rounds: list, alpha: float = DEFAULT_ALPHA,
+                       threshold: float = DEFAULT_THRESHOLD) -> tuple:
+    """(significant_regressions, all_candidates, skipped_pairs) over a
+    trajectory of rounds (trajectory order).  ``skipped_pairs`` names
+    every successive pair the fingerprint check refused, with the
+    reason — the gate REPORTS a cross-environment step, it never
+    compares across one."""
+    skipped = []
+    for prev, cur in zip(rounds, rounds[1:]):
+        ok, reason = prev.fingerprint.compatible(cur.fingerprint)
+        if not ok:
+            skipped.append({
+                "from_round": prev.index, "to_round": cur.index,
+                "reason": reason,
+                "from": prev.fingerprint.describe(),
+                "to": cur.fingerprint.describe(),
+            })
+    candidates = []
+    for prev, cur in _comparable_pairs(rounds):
+        sigma = _trajectory_sigma(rounds,
+                                  exclude=(prev.index, cur.index))
+        candidates.extend(compare_pair(prev, cur, sigma, alpha, threshold))
+    return [r for r in candidates if r.significant], candidates, skipped
+
+
+def change_points(rounds: list) -> dict:
+    """Per-metric largest |log change| step across the comparable
+    trajectory — the "where did this metric's story change" summary
+    (a single-change-point estimator; improvements count too, so the
+    fourstep landing shows up next to any regression)."""
+    out: dict = {}
+    for prev, cur in _comparable_pairs(rounds):
+        for metric in set(prev.metrics) & set(cur.metrics):
+            if direction_of(metric) is None:
+                continue
+            a, b = _rep_mean(prev.metrics[metric]), \
+                _rep_mean(cur.metrics[metric])
+            if a <= 0 or b <= 0:
+                continue
+            step = abs(math.log(b / a))
+            best = out.get(metric)
+            if best is None or step > best["abs_log_change"]:
+                out[metric] = {
+                    "from_round": prev.index, "to_round": cur.index,
+                    "prev": round(a, 6), "cur": round(b, 6),
+                    "change": round((b - a) / a, 6),
+                    "abs_log_change": round(step, 6),
+                }
+    return out
+
+
+# ------------------------------------------------------------- baseline
+
+
+def load_perf_baseline(path: str) -> list:
+    """Accepted-regression keys from a committed perf baseline.
+    Raises ValueError on a structurally wrong document (the CLI turns
+    that into a usage error, like the check baseline loader)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("accepted", []), list):
+        raise ValueError("perf baseline is not an {accepted: [...]} "
+                         "document")
+    out = []
+    for rec in doc.get("accepted", []):
+        out.append((str(rec["metric"]), int(rec["from_round"]),
+                    int(rec["to_round"])))
+    return out
+
+
+def write_perf_baseline(path: str, regressions: Iterable[Regression],
+                        note: str = "") -> str:
+    doc = {
+        "schema": 1,
+        "note": note or ("accepted (documented) perf regressions: the "
+                         "gate fails only on regressions NOT listed "
+                         "here — the perf twin of check-baseline.json"),
+        "accepted": [
+            {"metric": r.metric, "from_round": r.from_round,
+             "to_round": r.to_round,
+             "change": r.change, "p_value": round(r.p_value, 6)}
+            for r in regressions
+        ],
+    }
+    from .records import dump_json
+
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(dump_json(doc) + "\n")
+    return path
+
+
+@dataclasses.dataclass
+class GateResult:
+    """The gate verdict: ``ok`` iff no NEW significant regression."""
+
+    ok: bool
+    new: list                 # significant, not in baseline
+    accepted: list            # significant, grandfathered
+    fixed: list               # baseline keys no longer observed
+    candidates: list          # every worse-direction step (diagnostics)
+    skipped_pairs: list
+    rounds: list
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "new": [dataclasses.asdict(r) for r in self.new],
+            "accepted": [dataclasses.asdict(r) for r in self.accepted],
+            "fixed": [{"metric": m, "from_round": a, "to_round": b}
+                      for (m, a, b) in self.fixed],
+            "candidates": [dataclasses.asdict(r)
+                           for r in self.candidates],
+            "skipped_pairs": self.skipped_pairs,
+            "rounds": [
+                {"index": r.index, "path": r.path,
+                 "fingerprint": r.fingerprint.describe(),
+                 "metrics": len(r.metrics)}
+                for r in self.rounds
+            ],
+            "change_points": change_points(self.rounds),
+        }
+
+
+def gate_rounds(rounds: list, baseline: Optional[list] = None,
+                alpha: float = DEFAULT_ALPHA,
+                threshold: float = DEFAULT_THRESHOLD) -> GateResult:
+    """The CI gate: detect, split against the baseline, verdict."""
+    significant, candidates, skipped = detect_regressions(
+        rounds, alpha, threshold)
+    accepted_keys = set(baseline or [])
+    new = [r for r in significant if r.key() not in accepted_keys]
+    accepted = [r for r in significant if r.key() in accepted_keys]
+    observed = {r.key() for r in significant}
+    fixed = sorted(k for k in accepted_keys if k not in observed)
+    return GateResult(ok=not new, new=new, accepted=accepted,
+                      fixed=fixed, candidates=candidates,
+                      skipped_pairs=skipped, rounds=rounds)

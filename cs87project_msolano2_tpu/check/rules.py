@@ -583,6 +583,57 @@ class BareCollectiveCall(Rule):
                     f"# pifft: noqa[PIF108]")
 
 
+@register
+class AdHocMetricEmission(Rule):
+    id = "PIF109"
+    name = "ad-hoc-metric-emission"
+    summary = ("metric records on the bench/harness/analyze surface go "
+               "through the schema'd analyze.records helpers — no "
+               "ad-hoc json.dumps of metric dicts")
+    invariant = ("the regression gate (docs/ANALYSIS.md) fits laws over "
+                 "committed BENCH round records and groups them by the "
+                 "environment fingerprint; an ad-hoc json.dumps at an "
+                 "emission site can ship a record missing the "
+                 "metric/value/unit envelope or the fingerprint, which "
+                 "`analyze gate` then either refuses (a lost round) or "
+                 "— worse — compares across environments.  "
+                 "analyze.records.emit_record/dump_record validate the "
+                 "envelope BEFORE the line exists; a record that would "
+                 "be refused later fails at emission, where the data "
+                 "still is")
+    default_config = {
+        # an INCLUDE list like PIF107/PIF108's: metric-record emission
+        # is the measurement surface's discipline — bench.py, the
+        # harness sweeps, and the analyze layer itself
+        "paths": ("*bench.py", "*/harness/*", "*/analyze/*"),
+        # the schema'd helpers are the one sanctioned serialization
+        # site on that surface
+        "exempt": ("*analyze/records.py",),
+        "dump_calls": ("json.dumps", "json.dump"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+        import os
+
+        norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+        if not any(fnmatch.fnmatch(norm, pat)
+                   for pat in config["paths"]):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve_call(node)
+            if target in config["dump_calls"]:
+                yield self.finding(
+                    ctx, node,
+                    f"ad-hoc `{target}` on the metric-emission surface "
+                    f"— route records through analyze.records "
+                    f"(emit_record/dump_record validate the envelope + "
+                    f"fingerprint; dump_json for reports) or justify "
+                    f"with # pifft: noqa[PIF109]")
+
+
 def _is_broad_handler(type_node, broad) -> bool:
     """Shared broad-handler predicate (PIF105 and PIF501)."""
     if type_node is None:

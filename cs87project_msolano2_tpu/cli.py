@@ -9,6 +9,8 @@
     python -m cs87project_msolano2_tpu faults {list | inject <spec>}
     python -m cs87project_msolano2_tpu obs {summary | export | validate}
                                          [--events FILE] [--format F]
+    python -m cs87project_msolano2_tpu analyze {fit | report | gate}
+                                         [files ...] [--json]
     python -m cs87project_msolano2_tpu serve [--smoke | --host H --port P]
                                          [--shapes FILE] [...]
     python -m cs87project_msolano2_tpu multichip smoke [-n N]
@@ -44,6 +46,18 @@ table (`--json` for machines), `export --format {chrome,prom}`
 converts it to Chrome trace JSON (Perfetto) or the Prometheus textfile
 format, and `validate` schema-checks every event (the CI obs-smoke
 gate).
+
+The `analyze` subcommand fronts the statistical verification layer
+(docs/ANALYSIS.md): `fit` runs the complexity-law fit (confidence
+intervals, per-cell residuals, optional figures) over harness TSVs
+and/or the funnel/tube phase spans of an obs event stream, `report`
+inventories all three measurement sources with environment
+fingerprints and phase-share cross-checks, and `gate` is the
+statistical perf-regression gate over the committed BENCH_r\\*.json
+trajectory (Mann-Whitney over replications, fingerprint-gated
+comparability, the committed perf-baseline.json) — the CI step that
+fails on a significant throughput regression with a named metric and a
+p-value.
 
 The `serve` subcommand fronts the serving subsystem (docs/SERVING.md):
 an asyncio dispatcher that coalesces concurrent requests into padded
@@ -509,6 +523,10 @@ def main(argv=None) -> int:
         return multichip_main(argv[1:])
     if argv and argv[0] == "obs":
         return obs_main(argv[1:])
+    if argv and argv[0] == "analyze":
+        from .analyze.cli import analyze_main
+
+        return analyze_main(argv[1:])
     if argv and argv[0] == "serve":
         from .serve.cli import serve_main
 

@@ -348,6 +348,14 @@ def main(argv=None) -> int:
 
     if args.events:
         obs.enable(events_path=args.events)
+        # fingerprint the stream so the analyze loader knows which
+        # environment these sweep cells are comparable within
+        # (docs/ANALYSIS.md)
+        from cs87project_msolano2_tpu.analyze.records import (
+            env_fingerprint,
+        )
+
+        obs.emit("env", **env_fingerprint())
 
     ns = parse_grid(args.n_grid)
     ps = parse_grid(args.p_grid)

@@ -1,0 +1,687 @@
+"""Tests for the analyze/ subsystem (docs/ANALYSIS.md): the loader
+over all three measurement sources, the law-fit core (coefficient
+recovery with confidence intervals, the prediction gate's teeth),
+span-derived phase attribution vs the TSV derivation, the statistical
+perf-regression gate (Mann-Whitney over replications, the calibrated
+scalar fallback, fingerprint-gated comparability, the committed
+perf-baseline), and the `pifft analyze {fit,report,gate}` CLI.
+
+The capstone pair is the ISSUE 9 acceptance criterion:
+``test_gate_committed_trajectory_passes`` (the committed BENCH_r01-r06
+rounds must gate clean) and ``test_gate_flags_injected_slowdown``
+(a synthetic round with a 30% slowdown must fail the gate with a named
+metric and a p-value).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.analyze import lawfit, phases, regress
+from cs87project_msolano2_tpu.analyze.loader import (
+    Fingerprint,
+    build_table,
+    load_bench_round,
+    load_bench_rounds,
+    load_obs_samples,
+    load_tsv_samples,
+)
+from cs87project_msolano2_tpu.analyze.records import (
+    dump_record,
+    env_fingerprint,
+    validate_record,
+)
+from cs87project_msolano2_tpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED_ROUNDS = [os.path.join(REPO, f"BENCH_r0{i}.json")
+                    for i in range(1, 7)]
+
+
+# ---------------------------------------------------------- fixtures
+
+
+def write_tsv(path, rows):
+    with open(path, "w") as fh:
+        for row in rows:
+            fh.write("\t".join(str(v) for v in row) + "\n")
+    return str(path)
+
+
+def make_phase_rows(seed=0, ns=(1024, 4096), ps=(1, 2, 4, 8), reps=3):
+    """Deterministic per-processor-law phase rows (n p total funnel
+    tube) shared by the TSV-vs-span agreement tests."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for n in ns:
+        for p in ps:
+            fl, tl = lawfit.laws(np.array([float(n)]),
+                                 np.array([float(p)]))
+            for _ in range(reps):
+                eps = 1 + 0.05 * rng.standard_normal()
+                fm = 2e-6 * fl[0] * eps
+                tm = 3e-6 * tl[0] * eps
+                rows.append([n, p, round(fm + tm, 9), round(fm, 9),
+                             round(tm, 9)])
+    return rows
+
+
+def write_span_events(path, rows, run="testrun", with_env=True,
+                      truncate_tail=False):
+    """The same phase rows as an obs event stream: one funnel + one
+    tube span event per row, the shape obs.events/record_span writes."""
+    seq = 0
+    lines = []
+
+    def event(kind, cell=None, payload=None):
+        nonlocal seq
+        rec = {"v": 1, "run": run, "seq": seq, "t": 0.001 * seq,
+               "kind": kind}
+        if cell:
+            rec["cell"] = cell
+        if payload:
+            rec["payload"] = payload
+        seq += 1
+        return json.dumps(rec)
+
+    if with_env:
+        lines.append(event("env", payload={
+            "platform": "cpu", "device_kind": "cpu-test", "smoke": True}))
+    for n, p, _total, fm, tm in rows:
+        cell = {"n": int(n), "p": int(p)}
+        for name, ms in (("funnel", fm), ("tube", tm)):
+            lines.append(event("span", cell=cell, payload={
+                "name": name, "ts_s": 0.0, "dur_s": ms / 1e3,
+                "tid": 1, "depth": 1, "parent": "cell"}))
+    text = "\n".join(lines) + "\n"
+    if truncate_tail:
+        text += '{"v": 1, "run": "testrun", "seq": 9999, "ki'
+    with open(path, "w") as fh:
+        fh.write(text)
+    return str(path)
+
+
+def write_round(path, index, metrics, env=None, smoke=None, bare=True,
+                tail=""):
+    """A BENCH round file: bare record or driver wrapper."""
+    parsed = {"metric": "fft1d_n2^20_complex64_gflops",
+              "unit": "GFLOP/s"}
+    parsed["value"] = metrics.pop("__value__", 1000.0)
+    parsed.update(metrics)
+    if env is not None:
+        parsed["env"] = env
+    if smoke:
+        parsed["smoke"] = True
+    doc = parsed if bare else {"n": index, "cmd": "python bench.py",
+                               "rc": 0, "tail": tail, "parsed": parsed}
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return str(path)
+
+
+# ------------------------------------------------------------- loader
+
+
+def test_loader_tsv_samples_and_degraded_flag(tmp_path):
+    rows = make_phase_rows()
+    path = write_tsv(tmp_path / "sweep.tsv", rows)
+    with open(path, "a") as fh:
+        fh.write("64\t2\t100.0\t50.0\t50.0\tDEGRADED\n")
+    samples = load_tsv_samples(path)
+    # 3 phase samples per row, plus the degraded row's 3 flagged ones
+    assert len(samples) == 3 * len(rows) + 3
+    degraded = [s for s in samples if s.degraded]
+    assert len(degraded) == 3 and degraded[0].n == 64
+    # rep indices count occurrences per (n, p) cell
+    reps = {s.rep for s in samples if s.n == 1024 and s.p == 1
+            and s.metric == "total_ms"}
+    assert reps == {0, 1, 2}
+
+
+def test_loader_refuses_unknown_row_marker(tmp_path):
+    """The loader enforces the same provenance refusal as the fit's
+    reader: an unknown 6th-column marker must raise, not silently
+    ingest as clean data."""
+    path = write_tsv(tmp_path / "sweep.tsv", make_phase_rows())
+    with open(path, "a") as fh:
+        fh.write("64\t2\t100.0\t50.0\t50.0\tPARTIAL\n")
+    with pytest.raises(ValueError, match="unknown row marker"):
+        load_tsv_samples(path)
+
+
+def test_loader_obs_stream_with_truncated_tail(tmp_path):
+    rows = make_phase_rows()
+    path = write_span_events(tmp_path / "ev.jsonl", rows,
+                             truncate_tail=True)
+    samples, fp, dropped = load_obs_samples(path)
+    assert dropped == 1  # the half-written tail is skipped, not fatal
+    assert fp is not None and fp.platform == "cpu" and fp.smoke
+    # funnel+tube+total per row
+    assert len(samples) == 3 * len(rows)
+
+
+def test_loader_bench_round_fingerprint_stamped_and_backfilled(tmp_path):
+    # stamped round: env wins
+    p1 = write_round(tmp_path / "bench_r07.json", 7,
+                     {"__value__": 1200.0},
+                     env={"platform": "axon", "device_kind": "v5e",
+                          "smoke": False, "git_rev": "abc123"})
+    r7 = load_bench_round(p1)
+    assert r7.index == 7  # from the _rNN filename for bare records
+    assert r7.fingerprint == Fingerprint("axon", "v5e", False, "abc123")
+    assert r7.metrics["fft1d_n2^20_complex64_gflops"] == 1200.0
+    # unstamped wrapper round: smoke flag + platform banner backfill
+    p2 = write_round(tmp_path / "old.json", 3, {"__value__": 900.0},
+                     bare=False,
+                     tail="WARNING: Platform 'axon' is experimental\n")
+    r3 = load_bench_round(p2)
+    assert r3.index == 3  # the wrapper's "n"
+    assert r3.fingerprint.platform == "axon"
+    assert r3.fingerprint.smoke is False
+    assert r3.fingerprint.device_kind is None  # unrecoverable stays None
+
+
+def test_loader_committed_rounds_backfill():
+    rounds = load_bench_rounds(COMMITTED_ROUNDS)
+    assert [r.index for r in rounds] == [1, 2, 3, 4, 5, 6]
+    for r in rounds[:5]:
+        assert r.fingerprint.platform == "axon", r.path
+        assert not r.fingerprint.smoke
+    assert rounds[5].fingerprint.smoke  # r06 is the offline smoke round
+    ok, reason = rounds[4].fingerprint.compatible(rounds[5].fingerprint)
+    assert not ok and "smoke" in reason
+    # replicated-vs-scalar: committed rounds are scalar metrics
+    assert all(isinstance(v, float)
+               for r in rounds for v in r.metrics.values())
+
+
+def test_loader_replicated_metric_kept_whole(tmp_path):
+    path = write_round(tmp_path / "bench_r09.json", 9,
+                       {"tput_gflops": [990.0, 1000.0, 1010.0]},
+                       env=env_fingerprint())
+    rnd = load_bench_round(path)
+    assert rnd.metrics["tput_gflops"] == [990.0, 1000.0, 1010.0]
+
+
+def test_build_table_merges_all_three_sources(tmp_path):
+    rows = make_phase_rows()
+    tsv = write_tsv(tmp_path / "sweep.tsv", rows)
+    ev = write_span_events(tmp_path / "ev.jsonl", rows)
+    rnd = write_round(tmp_path / "bench_r01.json", 1,
+                      {"__value__": 737.1, "vs_baseline": 211.4})
+    table = build_table([tsv], [rnd], [ev])
+    summary = table.summary()
+    assert summary["by_source"] == {"tsv": 3 * len(rows),
+                                    "obs": 3 * len(rows), "bench": 2}
+    assert len(table.rounds) == 1
+    assert table.phase_rows("tsv").shape == (len(rows), 5)
+    assert table.phase_rows("obs").shape == (len(rows), 5)
+
+
+# ------------------------------------------------------------- lawfit
+
+
+def test_fit_recovers_coefficients_with_ci_coverage():
+    """Homoscedastic law data: the fit must recover the true betas and
+    its 95% CIs must cover them (per-seed determinism; the CI is the
+    package-era extension a cross-round comparison anchors on)."""
+    rng = np.random.default_rng(42)
+    rows = []
+    for n in (1024, 4096, 16384):
+        for p in (1, 2, 4, 8, 16):
+            fl, tl = lawfit.laws(np.array([float(n)]),
+                                 np.array([float(p)]))
+            for _ in range(6):
+                # homoscedastic noise well under the smallest cell's
+                # phase time, so OLS standard errors (and hence the
+                # CIs) are exact for this design
+                fm = 2e-6 * fl[0] + 2e-5 * rng.standard_normal()
+                tm = 3e-6 * tl[0] + 2e-5 * rng.standard_normal()
+                rows.append([n, p, fm + tm, fm, tm])
+    rep = lawfit.analyze_table(np.asarray(rows), "per-processor",
+                               verbose=False)
+    assert all(rep[k]["holds"] for k in ("total", "funnel", "tube"))
+    for phase, true_beta in (("funnel", 2e-6), ("tube", 3e-6)):
+        beta = rep[phase]["beta"]
+        assert abs(beta - true_beta) / true_beta < 0.05
+        lo, hi = rep[phase]["ci95"][phase]
+        assert lo <= true_beta <= hi, (phase, lo, true_beta, hi)
+        assert lo < beta < hi
+    # per-cell residuals ride the total fit
+    cells = rep["cells"]
+    assert len(cells) == 15
+    assert all(abs(c["log_ratio"]) < 0.2 for c in cells)
+
+
+def test_prediction_gate_rejects_law_violating_data():
+    """Constant-time data correlates with nothing: the fit must fail
+    (significance or the per-cell prediction gate — the round-5
+    falsifiability requirement)."""
+    rng = np.random.default_rng(7)
+    rows = []
+    for n in (1024, 4096, 16384):
+        for p in (1, 2, 4, 8, 16):
+            for _ in range(5):
+                t = 5.0 * (1 + 0.05 * rng.standard_normal())
+                rows.append([n, p, t, t / 2, t / 2])
+    rep = lawfit.analyze_table(np.asarray(rows), "per-processor",
+                               verbose=False)
+    assert rep["total"]["holds"] is False
+    assert rep["funnel"]["holds"] is False
+
+
+def test_demo_table_roundtrip(tmp_path):
+    path = lawfit.write_demo_tsv(str(tmp_path / "demo.tsv"))
+    rep = lawfit.analyze(path, verbose=False)
+    assert rep["total"]["holds"] is True
+    assert abs(rep["funnel"]["beta"] - 2e-6) / 2e-6 < 0.05
+
+
+def test_t_ppf_fallback_matches_scipy():
+    scipy = pytest.importorskip("scipy")
+    from unittest import mock
+
+    for q, df in ((0.025, 30), (0.05, 8)):
+        want = float(scipy.stats.t.isf(q, df))
+        with mock.patch.dict("sys.modules", {"scipy": None,
+                                             "scipy.stats": None}):
+            got = lawfit.t_ppf(q, df)
+        # the fallback is the normal approximation: exact agreement is
+        # not expected at small df, but the CI must not be wild
+        assert abs(got - want) / want < 0.12, (q, df, got, want)
+
+
+# ----------------------------------------------- phase attribution
+
+
+def test_span_shares_match_tsv_shares_on_same_run(tmp_path):
+    """The acceptance criterion: funnel/tube shares derived from obs
+    spans must agree with TSV-derived shares on the same synthetic
+    run."""
+    from cs87project_msolano2_tpu.obs.events import load_events
+
+    rows = make_phase_rows()
+    tsv = write_tsv(tmp_path / "sweep.tsv", rows)
+    ev = write_span_events(tmp_path / "ev.jsonl", rows)
+    records, dropped = load_events(ev)
+    assert dropped == 0
+    from_spans = phases.phase_shares_from_events(records)
+    from_tsv = phases.phase_shares(None, tsv_path=tsv)
+    assert set(from_spans) == set(from_tsv)
+    for cell in from_tsv:
+        for k in ("funnel", "tube"):
+            assert from_spans[cell][k] == pytest.approx(
+                from_tsv[cell][k], abs=1e-6), (cell, k)
+        assert from_spans[cell]["runs"] == from_tsv[cell]["runs"]
+    # and the span-derived table must pass the same law fit
+    span_rows = phases.phase_rows_from_events(records)
+    rep = lawfit.analyze_table(span_rows, "per-processor", verbose=False)
+    assert all(rep[k]["holds"] for k in ("total", "funnel", "tube"))
+
+
+def test_span_pairing_drops_incomplete_runs(tmp_path):
+    rows = make_phase_rows(ns=(1024,), ps=(2,), reps=2)
+    ev = write_span_events(tmp_path / "ev.jsonl", rows)
+    # append a funnel span with no matching tube (killed mid-run)
+    with open(ev, "a") as fh:
+        fh.write(json.dumps({
+            "v": 1, "run": "testrun", "seq": 500, "t": 5.0,
+            "kind": "span", "cell": {"n": 1024, "p": 2},
+            "payload": {"name": "funnel", "ts_s": 5.0, "dur_s": 0.001,
+                        "tid": 1, "depth": 1}}) + "\n")
+    from cs87project_msolano2_tpu.obs.events import load_events
+
+    records, _ = load_events(ev)
+    assert len(phases.phase_rows_from_events(records)) == len(rows)
+
+
+# ---------------------------------------------------------- regress
+
+
+def test_direction_classification():
+    assert regress.direction_of("fft1d_n2^20_complex64_gflops") == \
+        "higher"
+    assert regress.direction_of("n2^22_ms") == "lower"
+    assert regress.direction_of("vs_baseline") == "higher"
+    assert regress.direction_of("serve_slo_p99_ms") == "lower"
+    assert regress.direction_of("n2^13_carry_passes") is None
+
+
+def test_mann_whitney_separated_and_identical():
+    a = [10.0, 11.0, 12.0, 10.5, 11.5]
+    b = [7.0, 7.5, 8.0, 7.2, 7.8]
+    _, p = regress.mann_whitney(a, b)   # H1: b smaller — true here
+    assert p < 0.01
+    _, p_same = regress.mann_whitney(a, a)
+    assert p_same > 0.3
+
+
+def _quiet_rounds(tmp_path, count=4, seed=3, reps=8):
+    """A quiet replicated trajectory: same distribution each round."""
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(1, count + 1):
+        vals = [round(float(v), 3)
+                for v in 1000.0 + 15.0 * rng.standard_normal(reps)]
+        paths.append(write_round(
+            tmp_path / f"bench_r{i:02d}.json", i,
+            {"__value__": float(np.mean(vals)), "tput_gflops": vals},
+            env={"platform": "cpu", "device_kind": "test",
+                 "smoke": False}))
+    return paths
+
+
+def test_gate_quiet_on_resampled_noise(tmp_path):
+    rounds = load_bench_rounds(_quiet_rounds(tmp_path))
+    result = regress.gate_rounds(rounds)
+    assert result.ok, [r.describe() for r in result.new]
+
+
+def test_gate_flags_injected_slowdown_replicated(tmp_path):
+    """A 30% slowdown over replications must flag via Mann-Whitney
+    with a real p-value; resampled noise (the rounds before it) must
+    not."""
+    paths = _quiet_rounds(tmp_path)
+    rng = np.random.default_rng(9)
+    bad = [round(float(v), 3)
+           for v in 700.0 + 15.0 * rng.standard_normal(8)]
+    paths.append(write_round(
+        tmp_path / "bench_r05.json", 5,
+        {"__value__": float(np.mean(bad)), "tput_gflops": bad},
+        env={"platform": "cpu", "device_kind": "test", "smoke": False}))
+    result = regress.gate_rounds(load_bench_rounds(paths))
+    assert not result.ok
+    flagged = {r.metric: r for r in result.new}
+    assert "tput_gflops" in flagged
+    reg = flagged["tput_gflops"]
+    assert reg.test == "mann-whitney"
+    assert reg.p_value < 0.01
+    assert reg.change < -0.25
+    # only the injected pair flags, not the quiet history
+    assert all(r.to_round == 5 for r in result.new)
+
+
+def test_gate_scalar_slowdown_and_leave_one_out(tmp_path):
+    """Scalar rounds: a quiet history then a 30% drop — the calibrated
+    z must flag it, and the injected step must not widen its own
+    tolerance (leave-one-pair-out)."""
+    paths = []
+    values = [1000.0, 1015.0, 995.0, 1005.0, 1010.0]
+    for i, v in enumerate(values, start=1):
+        paths.append(write_round(
+            tmp_path / f"bench_r{i:02d}.json", i,
+            {"__value__": v, "large_n_gflops": v * 0.9},
+            env={"platform": "cpu", "device_kind": "test",
+                 "smoke": False}))
+    ok = regress.gate_rounds(load_bench_rounds(paths))
+    assert ok.ok
+    paths.append(write_round(
+        tmp_path / "bench_r06.json", 6,
+        {"__value__": 700.0, "large_n_gflops": 630.0},
+        env={"platform": "cpu", "device_kind": "test", "smoke": False}))
+    result = regress.gate_rounds(load_bench_rounds(paths))
+    assert not result.ok
+    assert {r.metric for r in result.new} == \
+        {"fft1d_n2^20_complex64_gflops", "large_n_gflops"}
+    assert all(r.test == "scalar-z" and r.p_value < 0.05
+               for r in result.new)
+
+
+def test_gate_refuses_cross_environment_comparison(tmp_path):
+    """A smoke round after a hardware round is SKIPPED (reported), not
+    compared — even with a catastrophic apparent drop."""
+    p1 = write_round(tmp_path / "bench_r01.json", 1,
+                     {"__value__": 1300.0},
+                     env={"platform": "axon", "device_kind": "v5e",
+                          "smoke": False})
+    p2 = write_round(tmp_path / "bench_r02.json", 2, {"__value__": 1.4},
+                     env={"platform": "cpu", "device_kind": "cpu",
+                          "smoke": True}, smoke=True)
+    result = regress.gate_rounds(load_bench_rounds([p1, p2]))
+    assert result.ok
+    assert len(result.skipped_pairs) == 1
+    assert "smoke" in result.skipped_pairs[0]["reason"]
+    assert result.candidates == []
+
+
+def test_gate_baseline_accepts_and_reports_fixed(tmp_path):
+    paths = _quiet_rounds(tmp_path, count=3)
+    rng = np.random.default_rng(11)
+    bad = [round(float(v), 3)
+           for v in 700.0 + 15.0 * rng.standard_normal(8)]
+    paths.append(write_round(
+        tmp_path / "bench_r04.json", 4,
+        {"__value__": float(np.mean(bad)), "tput_gflops": bad},
+        env={"platform": "cpu", "device_kind": "test", "smoke": False}))
+    rounds = load_bench_rounds(paths)
+    failing = regress.gate_rounds(rounds)
+    assert not failing.ok
+    # write the regressions into a baseline: the gate must now pass
+    bl_path = str(tmp_path / "perf-baseline.json")
+    regress.write_perf_baseline(bl_path, failing.new)
+    baseline = regress.load_perf_baseline(bl_path)
+    accepted = regress.gate_rounds(rounds, baseline)
+    assert accepted.ok
+    assert {r.metric for r in accepted.accepted} == \
+        {r.metric for r in failing.new}
+    # a stale baseline entry is reported fixed, not an error
+    stale = baseline + [("ghost_metric", 1, 2)]
+    res = regress.gate_rounds(rounds, stale)
+    assert res.ok and ("ghost_metric", 1, 2) in res.fixed
+
+
+def test_change_points_name_largest_step(tmp_path):
+    rounds = load_bench_rounds(COMMITTED_ROUNDS)
+    cps = regress.change_points(rounds)
+    # the headline's biggest step is the r02->r03 fused-kernel landing
+    cp = cps["fft1d_n2^20_complex64_gflops"]
+    assert (cp["from_round"], cp["to_round"]) == (2, 3)
+    assert cp["change"] > 0.3
+
+
+# ------------------------------------------- the acceptance criterion
+
+
+def test_gate_committed_trajectory_passes():
+    """ISSUE 9 acceptance: `pifft analyze gate` over the committed
+    BENCH_r01-r06 trajectory exits 0 (with the committed baseline),
+    and the r05->r06 smoke/hardware pair is refused, not compared."""
+    rc = cli_main(["analyze", "gate", *COMMITTED_ROUNDS,
+                   "--baseline", os.path.join(REPO,
+                                              "perf-baseline.json")])
+    assert rc == 0
+
+
+def test_gate_committed_plus_injected_slowdown_fails(tmp_path, capsys):
+    """ISSUE 9 acceptance: against a synthetic round with an injected
+    significant slowdown the gate exits nonzero and names the metric
+    with a p-value."""
+    import shutil
+
+    for p in COMMITTED_ROUNDS:
+        shutil.copy(p, tmp_path / os.path.basename(p))
+    r5 = load_bench_round(COMMITTED_ROUNDS[4])
+    slowed = {}
+    for k, v in r5.metrics.items():
+        d = regress.direction_of(k)
+        if d == "higher":
+            slowed[k] = round(v * 0.7, 4)
+        elif d == "lower":
+            slowed[k] = round(v / 0.7, 4)
+    slowed["metric"] = "fft1d_n2^20_complex64_gflops"
+    slowed["unit"] = "GFLOP/s"
+    slowed["value"] = slowed.pop("fft1d_n2^20_complex64_gflops")
+    slowed["env"] = {"platform": "axon", "smoke": False}
+    with open(tmp_path / "BENCH_r07.json", "w") as fh:
+        json.dump(slowed, fh)
+    files = sorted(str(p) for p in tmp_path.glob("BENCH_r0*.json"))
+    # drop the incomparable smoke round so r07 chains onto r05
+    files = [f for f in files if "r06" not in f]
+    rc = cli_main(["analyze", "gate", *files])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION" in out
+    assert "n2^22_gflops" in out and "p=" in out
+
+
+# ---------------------------------------------------------- records
+
+
+def test_record_validation_and_fingerprint():
+    fp = env_fingerprint(smoke=True, device_kind="cpu-test")
+    assert fp["smoke"] is True and fp["device_kind"] == "cpu-test"
+    good = {"metric": "m", "value": 1.0, "unit": "ms", "env": fp}
+    assert validate_record(good) == []
+    assert json.loads(dump_record(good))["metric"] == "m"
+    assert validate_record({"metric": "m", "unit": "ms"})  # no value
+    assert validate_record({"metric": "m", "value": True, "unit": "x"})
+    assert validate_record({"metric": "m", "value": 1, "unit": "ms",
+                            "env": {"platform": "cpu"}})  # env sans smoke
+    with pytest.raises(ValueError):
+        dump_record({"value": 1.0})
+
+
+def test_bench_record_contract_still_validates():
+    """The committed rounds' parsed records satisfy the emission
+    schema the helpers now enforce (metric/value/unit) — the helper
+    gates future records to the same contract."""
+    for path in COMMITTED_ROUNDS:
+        with open(path) as fh:
+            parsed = json.load(fh)["parsed"]
+        assert validate_record(parsed) == [], path
+
+
+# -------------------------------------------------------------- CLI
+
+
+def test_cli_fit_smoke(tmp_path, capsys):
+    tsv = write_tsv(tmp_path / "fourier-parallel-pi-pthreads-results.tsv",
+                    make_phase_rows())
+    rc = cli_main(["analyze", "fit", tsv, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rep = json.loads(out)[tsv]
+    assert rep["total"]["holds"] is True
+    assert "ci95" in rep["funnel"] and "cells" in rep
+    # --events: the span-derived fit through the same CLI
+    ev = write_span_events(tmp_path / "ev.jsonl", make_phase_rows())
+    rc = cli_main(["analyze", "fit", "--events", ev])
+    assert rc == 0
+    assert "law holds: Yes" in capsys.readouterr().out
+
+
+def test_cli_fit_failure_exit_and_allow_fail(tmp_path, capsys):
+    rng = np.random.default_rng(5)
+    rows = []
+    for n in (1024, 4096, 16384):
+        for p in (1, 2, 4, 8):
+            for _ in range(4):
+                t = 5.0 * (1 + 0.05 * rng.standard_normal())
+                rows.append([n, p, t, t / 2, t / 2])
+    bad = write_tsv(tmp_path / "flat.tsv", rows)
+    assert cli_main(["analyze", "fit", bad, "--json"]) == 1
+    capsys.readouterr()
+    # --allow-fail inverts: a documented violation failing is rc 0
+    assert cli_main(["analyze", "fit", bad, "--allow-fail", "flat",
+                     "--json"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_report_smoke(tmp_path, capsys):
+    rows = make_phase_rows()
+    tsv = write_tsv(tmp_path / "sweep.tsv", rows)
+    ev = write_span_events(tmp_path / "ev.jsonl", rows)
+    rc = cli_main(["analyze", "report", tsv, "--events", ev,
+                   "--bench", *COMMITTED_ROUNDS, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["by_source"] == {"tsv": 3 * len(rows),
+                                "obs": 3 * len(rows), "bench": 36}
+    assert len(doc["rounds"]) == 6
+    assert doc["skipped_pairs"][0]["to_round"] == 6
+    assert doc["comparable_pairs"] == 4
+    assert "change_points" in doc
+    # span- and tsv-derived shares ride side by side, agreeing
+    shares = doc["phase_shares"]
+    for cell, v in shares["tsv"].items():
+        assert shares["obs"][cell]["funnel"] == pytest.approx(
+            v["funnel"], abs=1e-6)
+
+
+def test_cli_missing_inputs_are_usage_errors(tmp_path, capsys):
+    """Missing/corrupt inputs answer the documented rc-2 usage error
+    with an `error:` line, never a traceback."""
+    assert cli_main(["analyze", "report", "--bench",
+                     str(tmp_path / "nope.json")]) == 2
+    assert "error:" in capsys.readouterr().err
+    assert cli_main(["analyze", "fit", "--events",
+                     str(tmp_path / "missing.jsonl")]) == 2
+    assert "error:" in capsys.readouterr().err
+    bad = write_tsv(tmp_path / "marked.tsv", make_phase_rows())
+    with open(bad, "a") as fh:
+        fh.write("64\t2\t1.0\t0.5\t0.5\tWHAT\n")
+    assert cli_main(["analyze", "report", bad]) == 2
+    assert "unknown row marker" in capsys.readouterr().err
+
+
+def test_replicated_threshold_falls_back_to_scalar(tmp_path):
+    """3-4 reps per side is below the normal approximation's validity
+    (its exact-test floor can't reach alpha): such metrics take the
+    calibrated scalar path instead."""
+    paths = []
+    for i, base in enumerate((1000.0, 1002.0, 998.0, 1001.0), start=1):
+        paths.append(write_round(
+            tmp_path / f"bench_r{i:02d}.json", i,
+            {"__value__": base,
+             "tput_gflops": [base - 1, base, base + 1]},
+            env={"platform": "cpu", "device_kind": "test",
+                 "smoke": False}))
+    paths.append(write_round(
+        tmp_path / "bench_r05.json", 5,
+        {"__value__": 700.0, "tput_gflops": [699.0, 700.0, 701.0]},
+        env={"platform": "cpu", "device_kind": "test", "smoke": False}))
+    result = regress.gate_rounds(load_bench_rounds(paths))
+    assert not result.ok
+    assert all(r.test == "scalar-z" for r in result.new)
+
+
+def test_cli_gate_json_and_usage_errors(tmp_path, capsys):
+    rc = cli_main(["analyze", "gate", *COMMITTED_ROUNDS, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["ok"] is True
+    assert len(doc["rounds"]) == 6 and doc["new"] == []
+    assert doc["skipped_pairs"] and doc["change_points"]
+    # a single round is not a trajectory
+    assert cli_main(["analyze", "gate", COMMITTED_ROUNDS[0]]) == 2
+    capsys.readouterr()
+    # an unusable baseline is a usage error, not a crash
+    bad = tmp_path / "bad.json"
+    bad.write_text("[]")
+    assert cli_main(["analyze", "gate", *COMMITTED_ROUNDS,
+                     "--baseline", str(bad)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_gate_write_baseline_roundtrip(tmp_path, capsys):
+    paths = _quiet_rounds(tmp_path, count=3)
+    rng = np.random.default_rng(13)
+    bad = [round(float(v), 3)
+           for v in 700.0 + 15.0 * rng.standard_normal(8)]
+    paths.append(write_round(
+        tmp_path / "bench_r04.json", 4,
+        {"__value__": float(np.mean(bad)), "tput_gflops": bad},
+        env={"platform": "cpu", "device_kind": "test", "smoke": False}))
+    assert cli_main(["analyze", "gate", *paths]) == 1
+    capsys.readouterr()
+    bl = str(tmp_path / "pb.json")
+    assert cli_main(["analyze", "gate", *paths,
+                     "--write-baseline", bl]) == 0
+    capsys.readouterr()
+    assert cli_main(["analyze", "gate", *paths, "--baseline", bl]) == 0
+    out = capsys.readouterr().out
+    assert "accepted (baselined)" in out

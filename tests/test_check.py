@@ -687,6 +687,82 @@ def test_pif108_parallel_package_is_clean():
             assert "noqa[PIF108]" not in src, name
 
 
+# ------------------------------------- PIF109 ad-hoc metric emission
+
+
+BENCH_PATH = os.path.join(REPO, "bench.py")
+HARNESS_PATH = os.path.join(REPO, "harness", "run_experiments.py")
+RECORDS_PATH = os.path.join(PKG, "analyze", "records.py")
+
+ADHOC_DUMPS = """
+    import json
+
+    def main(record):
+        print(json.dumps(record))
+"""
+
+
+def test_pif109_flags_adhoc_dumps_on_metric_surface():
+    for path in (BENCH_PATH, HARNESS_PATH,
+                 os.path.join(PKG, "analyze", "cli.py")):
+        findings = run(ADHOC_DUMPS, "PIF109", path=path)
+        assert rule_ids(findings) == ["PIF109"], path
+        assert "analyze.records" in findings[0].message
+    # import-alias form resolves through the import map too
+    aliased = """
+        from json import dump as jd
+
+        def save(record, fh):
+            jd(record, fh)
+    """
+    findings = run(aliased, "PIF109", path=BENCH_PATH)
+    assert rule_ids(findings) == ["PIF109"]
+
+
+def test_pif109_sanctioned_helper_and_outside_surface_pass():
+    # the schema'd helper module is the one sanctioned call site
+    assert run(ADHOC_DUMPS, "PIF109", path=RECORDS_PATH) == []
+    # the same call off the metric surface is not this rule's business
+    assert run(ADHOC_DUMPS, "PIF109", path="snippet.py") == []
+    assert run(ADHOC_DUMPS, "PIF109",
+               path=os.path.join(PKG, "serve", "cli.py")) == []
+    # json.load (reading committed rounds) is fine on the surface
+    reader = """
+        import json
+
+        def load(path):
+            with open(path) as fh:
+                return json.load(fh)
+    """
+    assert run(reader, "PIF109", path=BENCH_PATH) == []
+
+
+def test_pif109_noqa_suppresses():
+    code = """
+        import json
+
+        def main(record):
+            print(json.dumps(record))  # pifft: noqa[PIF109]
+    """
+    assert run(code, "PIF109", path=BENCH_PATH) == []
+
+
+def test_pif109_metric_surface_is_clean():
+    """The shipped metric-emission surface (bench.py, harness/, the
+    analyze package) must satisfy its own rule with no suppressions:
+    every record goes through analyze.records (docs/ANALYSIS.md)."""
+    surface = [BENCH_PATH, os.path.join(REPO, "harness"),
+               os.path.join(PKG, "analyze")]
+    findings = [f for f in engine.check_paths(surface, rules=["PIF109"])]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+    for root in surface:
+        files = [root] if root.endswith(".py") else [
+            os.path.join(root, nm) for nm in os.listdir(root)
+            if nm.endswith(".py")]
+        for path in files:
+            assert "noqa[PIF109]" not in open(path).read(), path
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 
