@@ -7,9 +7,9 @@
 SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
-        bench-chaos serve-smoke serve-slo multichip-smoke replicate \
-        run-experiments run-experiments-and-analyze-results analyze \
-        analyze-datasets analyze-smoke check lint
+        bench-chaos serve-smoke serve-slo rfft-smoke multichip-smoke \
+        replicate run-experiments run-experiments-and-analyze-results \
+        analyze analyze-datasets analyze-smoke check lint
 
 all:
 	$(MAKE) -C cs87project_msolano2_tpu/native all
@@ -143,6 +143,42 @@ serve-smoke:
 # smoke-sized here — drop --smoke for the real tier on hardware
 serve-slo:
 	PIFFT_PLAN_CACHE=off python3 bench.py --serve-load --smoke
+
+# the CI half-spectrum check (docs/REAL.md): rfft parity vs numpy
+# across sizes, then the bench smoke with the obs meter armed — the
+# METERED pifft_hbm_bytes_total delta of the r2c cell must be EXACTLY
+# half the c2c cell's at equal n (the tentpole win, enforced from the
+# meter, not the formula that feeds it) — then a serve smoke over a
+# mixed c2c/r2c shape file (the r2c burst coalesces into half-width
+# kernel invocations, responses verified vs numpy.fft.rfft, zero
+# schema-invalid events)
+rfft-smoke:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 -c "import numpy as np; \
+	from cs87project_msolano2_tpu.models.real import rfft, irfft; \
+	rng = np.random.default_rng(0); \
+	errs = {}; \
+	[errs.__setitem__(n, float(np.max(np.abs(np.asarray(rfft(x)) - np.fft.rfft(x.astype(np.float64)))) / np.max(np.abs(np.fft.rfft(x.astype(np.float64)))))) \
+	 for n in (1 << 10, 1 << 12, 1 << 14) \
+	 for x in [rng.standard_normal(n).astype(np.float32)]]; \
+	assert all(e <= 1e-5 for e in errs.values()), errs; \
+	x = rng.standard_normal(1 << 12).astype(np.float32); \
+	rt = float(np.max(np.abs(np.asarray(irfft(rfft(x))) - x))); \
+	assert rt <= 1e-4, rt; \
+	print('# rfft parity ok: ' + ', '.join('n=%d %.2e' % kv for kv in sorted(errs.items())))" && \
+	PIFFT_PLAN_CACHE=off python3 bench.py --smoke \
+	  --events /tmp/pifft-rfft-events.jsonl \
+	  | tee /tmp/pifft-rfft-smoke.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-rfft-smoke.json')); \
+	  c2c = r['n2^13_hbm_bytes']; r2c = r['rfft2^13_hbm_bytes']; \
+	  assert r2c * 2 == c2c, (r2c, c2c); \
+	  assert r['rfft2^13_parity_relerr'] <= 1e-5, r; \
+	  assert r['rfft2^13_domain'] == 'r2c', r; \
+	  print('# rfft bytes-halved ok: metered r2c %d B == c2c %d B / 2 at n=2^13' % (r2c, c2c))" && \
+	printf '{"n": 1024, "domain": "r2c"}\n{"n": 1024}\n{"n": 2048}\n' \
+	  > /tmp/pifft-rfft-shapes.jsonl && \
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  serve --smoke --shapes /tmp/pifft-rfft-shapes.jsonl
 
 # the CI multichip check (docs/MULTICHIP.md): the four sharding
 # dryruns on a forced 8-device CPU host platform (incl. the asserted

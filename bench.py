@@ -26,6 +26,12 @@ baselines on this host and prints ONE JSON line:
   launch/retiling/serialization overhead the single-pass pipelines
   remove and the >= 0.8 acceptance figure that tracks the large-n
   falloff (and its fix) release over release.
+* rfft2^K_* — the half-spectrum real-input row beside every c2c
+  large-n row (docs/REAL.md): GFLOP/s on the 2.5 n log2 n real count,
+  the domain-aware roofline_util (the r2c floor is 8 B/element — half
+  of c2c), and the METERED pifft_hbm_bytes_total delta the
+  `make rfft-smoke` gate asserts is exactly half the c2c cell's at
+  equal n.
 
 Kernel selection goes through the plan subsystem
 (cs87project_msolano2_tpu.plans): `plans.tune` races the shared
@@ -249,6 +255,115 @@ def measure_xla_fft_ms(n: int = N, smoke: bool = False):
     return max(raw - epilogue, raw * 0.5)
 
 
+def _metered_hbm_delta(fn) -> tuple:
+    """(result, bytes) of calling `fn` (a roofline_utilization
+    closure): the pifft_hbm_bytes_total delta the call charged —
+    0 while the obs subsystem is disarmed (the meter is a no-op
+    there).  The rfft-smoke gate asserts the r2c delta is exactly
+    half the c2c one at equal n, FROM THE METER, not from the
+    formula that feeds it."""
+    from cs87project_msolano2_tpu.obs import metrics
+
+    before = metrics.counter_value("pifft_hbm_bytes_total")
+    out = fn()
+    return out, int(metrics.counter_value("pifft_hbm_bytes_total")
+                    - before)
+
+
+def measure_rfft_ms(n: int, smoke: bool = False) -> tuple:
+    """(ms, plan) for an n-point half-spectrum r2c key (docs/REAL.md):
+    natural order — the Hermitian merge IS the r2c contract, so unlike
+    the pi-layout c2c rows there is no gather to exclude.  The plan
+    rides the tuned c2c choice at n/2, so a warmed c2c trajectory
+    serves these rows with no extra race."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import maybe_fault
+
+    key = plans.make_key(n, layout="natural", domain="r2c")
+    if smoke:
+        import jax
+        import jax.numpy as jnp
+
+        plan = plans.get_plan(key)
+        k0 = jax.random.PRNGKey(5)
+        xr = jax.random.normal(k0, (n,), jnp.float32)
+        xi = jnp.zeros((n,), jnp.float32)
+
+        def run_smoke():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(plan.fn, xr, xi)
+
+        return _retry(run_smoke, smoke=True,
+                      label=f"rfft smoke n={n}"), plan
+
+    def run():
+        maybe_fault("bench")  # resilience injection site
+        return plans.measured_ms(key)
+
+    return _retry(run, label=f"rfft measured_ms n={n}")
+
+
+def measure_rfft_row(logn: int, smoke: bool = False) -> dict:
+    """One half-spectrum reach row, side by side with the c2c row at
+    the same n: GFLOP/s on the standard real-input count
+    (2.5 n log2 n — half the c2c flops, matching the halved spectrum),
+    the domain-aware roofline utilization (the r2c floor is 8 B/elem),
+    and the METERED HBM-bytes delta — the enforced, not asserted, half
+    of the bytes the c2c cell moved.  Smoke rows additionally record
+    the parity error vs numpy.fft.rfft (the correctness tests cover
+    the ladder; this keeps the CI gate self-contained)."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import classify
+    from cs87project_msolano2_tpu.utils.roofline import (
+        plan_carry_passes,
+        roofline_ceiling,
+        roofline_utilization,
+    )
+
+    out = {}
+    nn = 1 << logn
+    tag = f"rfft2^{logn}"
+    try:
+        ms, plan = measure_rfft_ms(nn, smoke=smoke)
+    except Exception as e:
+        plans.warn(f"rfft 2^{logn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return out
+    out[f"{tag}_ms"] = round(ms, 4)
+    out[f"{tag}_gflops"] = round(
+        2.5 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
+    out[f"{tag}_plan"] = plan.describe()
+    out[f"{tag}_domain"] = "r2c"
+    if plan.degraded:
+        out[f"{tag}_degraded"] = True
+    served = plan.demotions[-1]["to"] if plan.degraded else plan.variant
+    passes = plan_carry_passes(served)
+    ceil = roofline_ceiling(passes)
+    if ceil is not None:
+        out[f"{tag}_carry_passes"] = passes
+        out[f"{tag}_roofline_ceiling"] = round(ceil, 3)
+    util, hbm_bytes = _metered_hbm_delta(
+        lambda: roofline_utilization(nn, ms, plan.key.device_kind,
+                                     passes or 0, domain="r2c"))
+    if hbm_bytes:
+        out[f"{tag}_hbm_bytes"] = hbm_bytes
+    if util is not None:
+        out[f"{tag}_roofline_util"] = round(util, 3)
+        if ceil:
+            out[f"{tag}_util_of_ceiling"] = round(util / ceil, 3)
+    if smoke:
+        from cs87project_msolano2_tpu.models.real import rfft
+
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal(nn).astype(np.float32)
+        ref = np.fft.rfft(x.astype(np.float64))
+        err = float(np.max(np.abs(np.asarray(rfft(x)) - ref))
+                    / np.max(np.abs(ref)))
+        out[f"{tag}_parity_relerr"] = err
+    return out
+
+
 def measure_large_n_row(logn: int, smoke: bool = False) -> dict:
     """One large-n reach row (the reference's pthreads analysis goes to
     n=2^24): the per-key plan at 2^logn — each n gets the plan tuned
@@ -292,8 +407,13 @@ def measure_large_n_row(logn: int, smoke: bool = False) -> dict:
     if ceil is not None:
         out[f"{tag}_carry_passes"] = passes
         out[f"{tag}_roofline_ceiling"] = round(ceil, 3)
-    util = roofline_utilization(nn, ms, plan.key.device_kind,
-                                passes or 0)
+    util, hbm_bytes = _metered_hbm_delta(
+        lambda: roofline_utilization(nn, ms, plan.key.device_kind,
+                                     passes or 0))
+    if hbm_bytes:
+        # the METERED plan-declared traffic this cell charged — the
+        # c2c half of the rfft-smoke bytes-halved assertion
+        out[f"{tag}_hbm_bytes"] = hbm_bytes
     if util is not None:
         out[f"{tag}_roofline_util"] = round(util, 3)
         if ceil:
@@ -601,6 +721,16 @@ def main(argv=None) -> int:
                    probe_n=1 << logn)
         degraded_rows |= bool(row.get(f"n2^{logn}_degraded"))
         large.update(row)
+        # the half-spectrum row at the SAME n, right after its c2c
+        # sibling: GFLOP/s + roofline_util side by side, and the
+        # metered HBM-bytes delta the rfft-smoke gate asserts is
+        # exactly half the c2c cell's (docs/REAL.md)
+        rrow = cell(f"rfft2^{logn}",
+                    lambda logn=logn: measure_rfft_row(
+                        logn, smoke=args.smoke),
+                    probe_n=1 << logn)
+        degraded_rows |= bool(rrow.get(f"rfft2^{logn}_degraded"))
+        large.update(rrow)
     if args.smoke:
         # the interpret-safe sixstep cell (docs/KERNELS.md): rides only
         # in smoke mode — on hardware the 2^25..2^27 rows above exercise

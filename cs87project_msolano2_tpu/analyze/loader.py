@@ -92,7 +92,11 @@ class Fingerprint:
 @dataclasses.dataclass(frozen=True)
 class Sample:
     """One measured value with its full context — the table row every
-    source is normalized into."""
+    source is normalized into.  ``domain`` tags the transform family
+    (docs/REAL.md): half-spectrum rows ("rfft2^K_*" bench metrics)
+    carry "r2c"; every record that predates the domain field —
+    including the committed BENCH_r01-r06 trajectory — backfills the
+    "c2c" default, so old artifacts keep parsing unchanged."""
 
     source: str               # "tsv" | "bench" | "obs"
     metric: str               # "total_ms", "funnel_ms", "n2^24_gflops", ...
@@ -103,6 +107,7 @@ class Sample:
     round_index: Optional[int] = None
     fingerprint: Optional[Fingerprint] = None
     degraded: bool = False
+    domain: str = "c2c"
 
 
 @dataclasses.dataclass
@@ -287,22 +292,31 @@ def load_bench_rounds(paths) -> list:
 
 
 _LOGN_METRIC = re.compile(r"^n2\^(\d+)_")
+_RFFT_METRIC = re.compile(r"^rfft2\^(\d+)_")
 
 
 def bench_samples(rnd: BenchRound) -> list:
     """A round's metrics as flat samples (n parsed from the ``n2^K_``
-    row prefix where one exists; replicated metrics flatten with rep
-    indices)."""
+    row prefix where one exists; ``rfft2^K_`` rows parse the same n
+    and tag ``domain="r2c"`` — everything else, including every
+    pre-domain committed round, backfills "c2c"; replicated metrics
+    flatten with rep indices)."""
     out = []
     for name, val in rnd.metrics.items():
+        domain = "c2c"
         m = _LOGN_METRIC.match(name)
+        if m is None:
+            m = _RFFT_METRIC.match(name)
+            if m is not None:
+                domain = "r2c"
         n = (1 << int(m.group(1))) if m else None
         values = val if isinstance(val, list) else [val]
         for rep, v in enumerate(values):
             out.append(Sample(
                 source="bench", metric=name, value=v, n=n,
                 rep=rep if isinstance(val, list) else None,
-                round_index=rnd.index, fingerprint=rnd.fingerprint))
+                round_index=rnd.index, fingerprint=rnd.fingerprint,
+                domain=domain))
     return out
 
 

@@ -634,6 +634,135 @@ class AdHocMetricEmission(Rule):
                     f"with # pifft: noqa[PIF109]")
 
 
+@register
+class FullSpectrumFftOnRealInput(Rule):
+    id = "PIF110"
+    name = "full-spectrum-fft-on-real-input"
+    summary = ("full-spectrum fft called on a provably real input "
+               "inside shipped hot paths (serve/, parallel/) — the "
+               "half-spectrum rfft moves half the HBM bytes")
+    invariant = ("the kernel family is memory-bound (docs/REAL.md): a "
+                 "real input's spectrum is Hermitian, so a "
+                 "full-spectrum fft on it computes and MOVES twice "
+                 "the bytes the rfft path would — on the serving and "
+                 "sharded hot paths that is a 2x effective-throughput "
+                 "loss the roofline meter will show but no test will "
+                 "fail on.  A provably real argument (a .real "
+                 "projection, a float astype, a real-valued sampler) "
+                 "reaching fft instead of rfft is therefore flagged; "
+                 "intentionally-complex promotions justify with "
+                 "# pifft: noqa[PIF110]")
+    default_config = {
+        # an INCLUDE list like PIF107/108/109: the half-spectrum
+        # discipline binds the SHIPPED hot paths; tests, benches, and
+        # reference oracles promote real inputs deliberately
+        "paths": ("*/serve/*", "*/parallel/*"),
+        # full-spectrum entry points (canonical post-import-map names;
+        # a bare suffix ".fft" match would catch rfft's module, so the
+        # list is explicit)
+        "fft_calls": ("jax.numpy.fft.fft", "numpy.fft.fft"),
+        # package-local full-spectrum entry points, matched by suffix
+        # (relative imports canonicalize to e.g. "models.fft.fft")
+        "fft_suffixes": ("models.fft.fft", "models.fft.fft_planes_fast"),
+        # real-valued constructors: a call to any of these (or a
+        # .real / .astype(<float>) projection) makes a value provably
+        # real
+        "real_calls": ("jax.numpy.real", "numpy.real",
+                       "jax.random.normal", "jax.random.uniform"),
+        "real_methods": ("standard_normal", "normal", "uniform",
+                         "random"),
+        "float_dtypes": ("float32", "float64", "float16", "bfloat16"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+        import os
+
+        norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+        if not any(fnmatch.fnmatch(norm, pat)
+                   for pat in config["paths"]):
+            return
+        fn_defs = (ast.FunctionDef, ast.AsyncFunctionDef)
+        for scope in [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                                   if isinstance(n, fn_defs)]:
+            # single-assignment Name -> value map per scope, so a real
+            # value bound to a local still proves its fft call real —
+            # built from the scope's OWN statements only (a nested
+            # def's locals must not shadow the enclosing scope's
+            # bindings into a false positive)
+            assigns: dict = {}
+            for node in self._own_nodes(scope):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    assigns[name] = (node.value
+                                     if name not in assigns else None)
+            for node in self._own_nodes(scope):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                if not self._is_full_fft(ctx, node, config):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    arg = assigns.get(arg.id) or arg
+                if self._provably_real(ctx, arg, config):
+                    target = ctx.resolve_call(node)
+                    yield self.finding(
+                        ctx, node,
+                        f"full-spectrum `{target}` on a provably real "
+                        f"input — the half-spectrum rfft path "
+                        f"(models.real / domain='r2c' plans) moves "
+                        f"half the HBM bytes (docs/REAL.md); justify "
+                        f"deliberate complex promotion with "
+                        f"# pifft: noqa[PIF110]")
+
+    def _own_nodes(self, scope) -> Iterator:
+        """The scope's own statements — nested defs are separate
+        entries in check()'s scope list, with their own assigns map."""
+        fn_defs = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, fn_defs):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _is_full_fft(self, ctx, call, config) -> bool:
+        target = ctx.resolve_call(call)
+        if not target:
+            return False
+        if target in config["fft_calls"]:
+            return True
+        return any(target == suf or target.endswith("." + suf)
+                   for suf in config["fft_suffixes"])
+
+    def _provably_real(self, ctx, node, config) -> bool:
+        """True when `node` is statically known to be real-valued."""
+        if isinstance(node, ast.Attribute) and node.attr == "real":
+            return True
+        if not isinstance(node, ast.Call):
+            return False
+        target = ctx.resolve_call(node)
+        if target in config["real_calls"]:
+            return True
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in config["real_methods"]:
+                return True
+            if node.func.attr == "astype" and node.args:
+                return self._float_dtype(ctx, node.args[0], config)
+        return False
+
+    def _float_dtype(self, ctx, node, config) -> bool:
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            return node.value in config["float_dtypes"]
+        name = dotted_name(node)
+        if name is None:
+            return False
+        return name.split(".")[-1] in config["float_dtypes"]
+
+
 def _is_broad_handler(type_node, broad) -> bool:
     """Shared broad-handler predicate (PIF105 and PIF501)."""
     if type_node is None:

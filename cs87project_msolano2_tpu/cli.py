@@ -150,6 +150,12 @@ def plan_main(argv) -> int:
     ap.add_argument("--precision",
                     choices=("split3", "highest", "default", "fp32"),
                     default=None)
+    ap.add_argument("--domain", choices=("c2c", "r2c", "c2r"),
+                    default="c2c",
+                    help="warm: transform domain — the half-spectrum "
+                         "real paths (r2c/c2r) require --layout "
+                         "natural and ride the c2c plan at n/2 "
+                         "(docs/REAL.md)")
     ap.add_argument("--force", action="store_true",
                     help="warm: re-tune even on a cache hit")
     args = ap.parse_args(argv)
@@ -180,8 +186,9 @@ def plan_main(argv) -> int:
         for token, rec in sorted(entries.items()):
             key = plans.PlanKey.from_token(token)
             ms = rec.get("ms")
-            print(f"  n={key.n} batch={key.batch} {key.layout} "
-                  f"{key.precision}: {rec['variant']} {rec['params']}"
+            print(f"  n={key.n} domain={key.domain} batch={key.batch} "
+                  f"{key.layout} {key.precision}: {rec['variant']} "
+                  f"{rec['params']}"
                   + (f" ({ms:.4f} ms)" if ms is not None else ""))
         return 0
 
@@ -222,8 +229,14 @@ def plan_main(argv) -> int:
                   f"[{p.source}]{ms}")
         print(f"warmed {len(warmed)} shape(s) from {args.shapes}")
         return 0
-    key = plans.make_key(args.n, tuple(args.batch), layout=args.layout,
-                         precision=args.precision)
+    try:
+        key = plans.make_key(args.n, tuple(args.batch),
+                             layout=args.layout,
+                             precision=args.precision,
+                             domain=args.domain)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     try:
         plan = plans.tune(key, force=args.force)
     except plans.TuningUnavailable as e:

@@ -2,3 +2,4 @@
 
 from .pi_fft import funnel, tube, pi_fft_pi_layout  # noqa: F401
 from .fft import fft, ifft, fft2, fftn  # noqa: F401
+from .real import irfft, rfft  # noqa: F401

@@ -3,7 +3,10 @@
 These are the user-facing transforms (complex64 in, complex64 out, natural
 frequency order) — what ``jnp.fft`` users reach for, built on the same
 funnel/tube stages the benchmarks measure.  The bit-reversal gather lives
-here, at the API boundary, never inside the timed phases.
+here, at the API boundary, never inside the timed phases.  Real inputs
+have a cheaper door: :mod:`.real` (``rfft``/``irfft``) computes only the
+non-redundant half-spectrum and moves half the HBM bytes
+(docs/REAL.md).
 
 Dispatch goes through the plan subsystem (:mod:`..plans`):
 ``plans.plan_for(shape)`` resolves the kernel variant + parameters for

@@ -1,0 +1,204 @@
+"""Half-spectrum real-input transforms: rfft / irfft on the pi-FFT
+plan ladder (docs/REAL.md).
+
+A length-n real signal carries half the information of a length-n
+complex one, and its spectrum is Hermitian (X[n-k] = conj(X[k])), so
+only the n//2+1 leading bins are worth computing, moving, or serving.
+The classic pack trick turns the whole r2c transform into ONE c2c
+transform at HALF the length plus an O(n) elementwise post-pass:
+
+    z[k]  = x[2k] + i·x[2k+1]            (m = n/2 complex points)
+    Z     = FFT_m(z)                      (the existing tuned c2c plan)
+    A[k]  = (Z[k] + conj(Z[m-k])) / 2     (spectrum of even samples)
+    B[k]  = (Z[k] - conj(Z[m-k])) / 2i    (spectrum of odd samples)
+    X[k]  = A[k] + W^k · B[k],  W = e^{-2πi/n},  k = 0..m
+
+The inverse (c2r) runs the same algebra backwards — split X into
+(A, B), rebuild Z = A + i·B, one c2c inverse at m, deinterleave.
+
+Everything here is expressed on split float32 planes (the TPU-native
+representation the whole kernel family uses), and NONE of it is a new
+Pallas kernel: the heavy lifting is the c2c plan at n/2 — which means
+an r2c transform inherits the entire ladder (fused / fourstep /
+sixstep), the autotuner, the plan cache, the degradation chain, and
+the obs spans for free, while moving HALF the HBM bytes of the c2c
+transform at the same n (utils/roofline.py charges it exactly that).
+
+Dispatch goes through the plan subsystem with ``domain="r2c"`` /
+``"c2r"`` keys (plans.core.PlanKey): ``plans.plan_for(shape,
+domain="r2c")`` resolves the half-length c2c choice and
+``plan.execute`` runs pack → kernel → merge as one traceable
+executor.  The r2c executor keeps the uniform ``(xr, xi) -> (yr, yi)``
+plane contract; its ``xi`` operand is ignored (the input is real by
+declaration) and the c2r output's ``yi`` plane is zeros.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _half_twiddles(n: int) -> tuple:
+    """(cos, sin) of 2πk/n for k = 0..n/2 — the W^k factors of the
+    Hermitian merge/split, built host-side in float64 and cast once
+    (same discipline as ops.twiddle: trig error must not ride the
+    kernel's error budget)."""
+    k = np.arange(n // 2 + 1, dtype=np.float64)
+    ang = 2.0 * np.pi * k / float(n)
+    return (jnp.asarray(np.cos(ang), jnp.float32),
+            jnp.asarray(np.sin(ang), jnp.float32))
+
+
+def pack_real_planes(xr):
+    """Deinterleave a real signal (..., n) into the packed complex
+    planes (..., n/2): z[k] = x[2k] + i·x[2k+1]."""
+    return xr[..., 0::2], xr[..., 1::2]
+
+
+def unpack_real_planes(zr, zi):
+    """Inverse of :func:`pack_real_planes`: interleave (..., m) planes
+    back into the real signal (..., 2m)."""
+    return jnp.stack([zr, zi], axis=-1).reshape(
+        zr.shape[:-1] + (2 * zr.shape[-1],))
+
+
+def hermitian_merge(zr, zi, n: int):
+    """The O(n) r2c post-pass: packed-FFT planes (..., m) in natural
+    order -> half-spectrum planes (..., m+1), m = n/2."""
+    m = n // 2
+    idx = jnp.arange(m + 1) % m          # Z[k], k = 0..m (Z[m] = Z[0])
+    rev = (m - jnp.arange(m + 1)) % m    # Z[m-k]
+    zr_k, zi_k = jnp.take(zr, idx, axis=-1), jnp.take(zi, idx, axis=-1)
+    zr_r, zi_r = jnp.take(zr, rev, axis=-1), jnp.take(zi, rev, axis=-1)
+    ar, ai = 0.5 * (zr_k + zr_r), 0.5 * (zi_k - zi_r)
+    br, bi = 0.5 * (zi_k + zi_r), -0.5 * (zr_k - zr_r)
+    c, s = _half_twiddles(n)
+    return ar + c * br + s * bi, ai + c * bi - s * br
+
+
+def hermitian_split(xr, xi, n: int):
+    """The O(n) c2r pre-pass: half-spectrum planes (..., m+1) ->
+    packed planes Z = A + i·B of length m, ready for one c2c inverse.
+    Only the leading m entries of the (A, B) algebra are needed."""
+    m = n // 2
+    rev = m - jnp.arange(m)              # X[m-k], k = 0..m-1
+    xr_k, xi_k = xr[..., :m], xi[..., :m]
+    xr_r, xi_r = jnp.take(xr, rev, axis=-1), jnp.take(xi, rev, axis=-1)
+    ar, ai = 0.5 * (xr_k + xr_r), 0.5 * (xi_k - xi_r)
+    # W^k B[k] = (X[k] - conj(X[m-k])) / 2; undo the twiddle with W^-k
+    tr, ti = 0.5 * (xr_k - xr_r), 0.5 * (xi_k + xi_r)
+    c, s = _half_twiddles(n)
+    c, s = c[:m], s[:m]
+    br, bi = c * tr - s * ti, c * ti + s * tr
+    # Z = A + i·B
+    return ar - bi, ai + br
+
+
+def rfft_executor(c2c_fn, n: int):
+    """Wrap a natural-order c2c executor at n/2 into the r2c executor
+    at n: (xr, xi) -> half-spectrum planes (..., n/2+1).  ``xi`` is
+    ignored — an r2c plan's input is real by declaration."""
+
+    def run(xr, xi):
+        del xi  # real by declaration (domain="r2c")
+        zr, zi = pack_real_planes(xr)
+        zr, zi = c2c_fn(zr, zi)
+        return hermitian_merge(zr, zi, n)
+
+    return run
+
+
+def irfft_executor(c2c_fn, n: int):
+    """Wrap a natural-order c2c executor at n/2 into the c2r executor
+    at n: half-spectrum planes (..., n/2+1) -> (real signal, zeros).
+    The inverse c2c rides the conj trick on the same forward
+    executor, so the rung/variant serving the forward serves the
+    inverse too."""
+    m = n // 2
+    inv_m = np.float32(1.0 / m)
+
+    def run(xr, xi):
+        zr, zi = hermitian_split(xr, xi, n)
+        wr, wi = c2c_fn(zr, -zi)          # IFFT_m = conj∘FFT_m∘conj / m
+        yr = unpack_real_planes(wr * inv_m, -wi * inv_m)
+        return yr, jnp.zeros_like(yr)
+
+    return run
+
+
+def rfft(x, precision: str | None = None, plan=None):
+    """1-D real-input DFT over the trailing axis: real in, the n//2+1
+    leading (non-redundant) complex bins out — ``numpy.fft.rfft``
+    semantics on the plan ladder.  `n` must be a power of two >= 2.
+
+    Dispatches through a ``domain="r2c"`` plan (docs/REAL.md): the
+    packed c2c transform at n/2 runs whatever variant the ladder
+    tuned for THAT key, so rfft inherits the kernel family and the
+    resilience chain with half the HBM traffic of ``fft`` at the same
+    n.  `plan` pins an explicit r2c plan; `precision` picks the kernel
+    precision mode exactly as in :func:`.fft.fft`.
+    """
+    x = jnp.asarray(x)
+    if jnp.iscomplexobj(x):
+        raise ValueError("rfft input must be real (the half-spectrum "
+                         "contract); use fft for complex input")
+    xr = x.astype(jnp.float32)
+    if plan is None:
+        from .. import plans
+
+        plan = plans.plan_for(xr.shape, layout="natural",
+                              precision=precision, domain="r2c")
+    yr, yi = plan.execute(xr, jnp.zeros_like(xr))
+    from .fft import jax_complex
+
+    return jax_complex(yr, yi)
+
+
+def irfft(x, precision: str | None = None, plan=None):
+    """Inverse of :func:`rfft`: n//2+1 half-spectrum bins in, the
+    length-n real signal out (``numpy.fft.irfft`` semantics; n is
+    inferred as 2·(bins-1) and must be a power of two >= 2)."""
+    x = jnp.asarray(x)
+    if not jnp.iscomplexobj(x):
+        x = x.astype(jnp.complex64)
+    n = 2 * (x.shape[-1] - 1)
+    if n < 2:
+        raise ValueError(f"irfft needs >= 2 half-spectrum bins, got "
+                         f"shape {x.shape}")
+    xr = jnp.real(x).astype(jnp.float32)
+    xi = jnp.imag(x).astype(jnp.float32)
+    if plan is None:
+        from .. import plans
+
+        plan = plans.plan_for(xr.shape[:-1] + (n,), layout="natural",
+                              precision=precision, domain="c2r")
+    yr, _ = plan.execute(xr, xi)
+    return yr
+
+
+def rfft_planes_fast(xr, plan=None, precision: str | None = None):
+    """Plane-level r2c through the plan subsystem — the hot-path form
+    (cf. fft_planes_fast): real plane(s) in, half-spectrum (yr, yi)
+    planes out."""
+    if plan is None:
+        from .. import plans
+
+        plan = plans.plan_for(xr.shape, layout="natural",
+                              precision=precision, domain="r2c")
+    return plan.execute(xr, jnp.zeros_like(xr))
+
+
+def irfft_planes_fast(xr, xi, n: int | None = None, plan=None,
+                      precision: str | None = None):
+    """Plane-level c2r: half-spectrum planes (..., m+1) in, the real
+    signal plane (..., n) out (n defaults to 2·(m+1-1))."""
+    n = n if n is not None else 2 * (xr.shape[-1] - 1)
+    if plan is None:
+        from .. import plans
+
+        plan = plans.plan_for(xr.shape[:-1] + (n,), layout="natural",
+                              precision=precision, domain="c2r")
+    yr, _ = plan.execute(xr, xi)
+    return yr

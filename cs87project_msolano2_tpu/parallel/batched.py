@@ -24,19 +24,39 @@ from ..utils.compat import shard_map
 
 def fft_batched_planes(xr, xi, mesh, axis: str = "data",
                        inverse: bool = False, natural: bool = True,
-                       precision: str | None = None):
+                       precision: str | None = None,
+                       domain: str = "c2c"):
     """1-D FFT along the trailing axis of (B, n) re/im planes,
     batch-sharded over `axis`.  Natural order by default, same
     sharding; `natural=False` returns pi layout (per-row bit-reversed,
     forward only — the kernel-native order with the gather left off,
     mirroring the flagship bench contract).  `precision` picks the
     kernel precision mode for the per-shard plan (split3 default /
-    highest / fp32 — see models.fft)."""
+    highest / fp32 — see models.fft).  `domain` picks c2c (default) or
+    the half-spectrum real planes (docs/REAL.md): "r2c" takes real
+    (B, n) planes (xi ignored) and returns (B, n//2+1) half-spectrum
+    shards; "c2r" the reverse — the per-shard plan still rides the
+    tuned c2c kernel at n/2, per shard, with no collectives."""
+    if domain != "c2c":
+        if inverse:
+            raise ValueError("inverse is the c2c conj trick; use "
+                             "domain='c2r' for the real inverse")
+        if not natural:
+            raise ValueError(f"domain={domain!r} requires natural "
+                             f"layout (the half-spectrum has no pi "
+                             f"order)")
     nshards = mesh.shape[axis]
-    local = (xr.shape[0] // nshards,) + tuple(xr.shape[1:])
+    if domain == "c2r":
+        # the signal-side length the plan is keyed by (input planes
+        # carry n//2+1 half-spectrum bins per row)
+        n_signal = 2 * (xr.shape[-1] - 1)
+        local = (xr.shape[0] // nshards,) + tuple(xr.shape[1:-1]) \
+            + (n_signal,)
+    else:
+        local = (xr.shape[0] // nshards,) + tuple(xr.shape[1:])
     plan = plans.plan_for(
         local, layout="natural" if (natural or inverse) else "pi",
-        precision=precision)
+        precision=precision, domain=domain)
 
     def device_fn(br, bi):
         if inverse:
@@ -68,6 +88,16 @@ def fft_batched_sharded(x, mesh, axis: str = "data", inverse: bool = False):
         jnp.real(x).astype(jnp.float32), jnp.imag(x).astype(jnp.float32),
         mesh, axis, inverse,
     )
+    return jax_complex(yr, yi)
+
+
+def rfft_batched_sharded(x, mesh, axis: str = "data"):
+    """Real-input half-spectrum wrapper over fft_batched_planes: real
+    (B, n) in, complex (B, n//2+1) out, batch-sharded, each shard's
+    packed c2c kernel local to its device (docs/REAL.md)."""
+    xr = jnp.real(jnp.asarray(x)).astype(jnp.float32)
+    yr, yi = fft_batched_planes(xr, jnp.zeros_like(xr), mesh, axis,
+                                domain="r2c")
     return jax_complex(yr, yi)
 
 

@@ -56,11 +56,14 @@ from .core import (  # noqa: F401
 def make_key(n: int, batch: tuple = (), layout: str = "natural",
              precision: str | None = None,
              device_kind: str | None = None,
-             dtype: str = "float32") -> PlanKey:
+             dtype: str = "float32",
+             domain: str = "c2c") -> PlanKey:
     """PlanKey for an n-point transform over `batch` leading dims on the
     current (or given) device kind.  Every compile-relevant field is
     passed explicitly (PIF401): a defaulted field here would silently
-    alias keys if the PlanKey default ever diverged."""
+    alias keys if the PlanKey default ever diverged.  `domain` picks
+    c2c (default) or the half-spectrum real paths r2c/c2r — n is the
+    real-side length either way (docs/REAL.md)."""
     return PlanKey(
         device_kind=device_kind or current_device_kind(),
         n=int(n),
@@ -68,6 +71,7 @@ def make_key(n: int, batch: tuple = (), layout: str = "natural",
         layout=layout,
         dtype=dtype,
         precision=precision or "split3",
+        domain=domain,
     )
 
 
@@ -84,6 +88,20 @@ def get_plan(key: PlanKey) -> Plan:
     # it here would kill the opt-in for the rest of the process
     if hit is not None and not (opt_in and hit.source == "static"):
         return hit
+    if key.domain != "c2c":
+        # the real domains RIDE the c2c plan at n/2 (docs/REAL.md):
+        # resolve that key through this same path — a tuned/cached c2c
+        # winner (or the opted-in tune, which then benefits every c2c
+        # caller too) carries straight over, with the pack/Hermitian
+        # wrapping added by the ladder's executor builder.  ms is NOT
+        # copied: the inner timing is not the real path's timing.
+        from . import ladder
+
+        inner = get_plan(ladder.c2c_subkey(key))
+        plan = Plan(key=key, variant=inner.variant,
+                    params=dict(inner.params), source=inner.source)
+        cache.memoize(plan)
+        return plan
     if opt_in:
         try:
             return tune(key)
@@ -168,14 +186,16 @@ def measured_ms(key: PlanKey, *, verbose: bool = True):
 
 
 def plan(n: int, batch: tuple = (), layout: str = "natural",
-         precision: str | None = None) -> Plan:
+         precision: str | None = None, domain: str = "c2c") -> Plan:
     """The single dispatch point: ``plan(n).execute(xr, xi)``."""
-    return get_plan(make_key(n, batch, layout, precision))
+    return get_plan(make_key(n, batch, layout, precision, domain=domain))
 
 
 def plan_for(shape, layout: str = "natural",
-             precision: str | None = None) -> Plan:
+             precision: str | None = None, domain: str = "c2c") -> Plan:
     """Plan for float-plane arrays of `shape` (trailing axis = transform
-    length, leading axes = batch)."""
+    length, leading axes = batch).  For every domain the shape is the
+    SIGNAL-side shape (the real length n) — a c2r plan's executor
+    consumes half-spectrum planes, but its key is still n."""
     shape = tuple(shape)
-    return plan(shape[-1], shape[:-1], layout, precision)
+    return plan(shape[-1], shape[:-1], layout, precision, domain=domain)

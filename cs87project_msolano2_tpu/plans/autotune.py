@@ -54,7 +54,9 @@ def default_timer(fn: Callable, key: PlanKey) -> float:
 
     from ..utils.timing import loop_slope_ms
 
-    shape = key.batch + (key.n,)
+    # the key knows its executor's input-plane shape (a c2r key
+    # consumes half-spectrum planes, not signal-length ones)
+    shape = key.input_shape()
     k0 = jax.random.PRNGKey(0)
     xr = jax.random.normal(k0, shape, jnp.float32)
     xi = jax.random.normal(jax.random.fold_in(k0, 1), shape, jnp.float32)
@@ -62,6 +64,13 @@ def default_timer(fn: Callable, key: PlanKey) -> float:
 
     def body(c):
         yr, yi = fn(c[0], c[1])
+        if yr.shape != c[0].shape:
+            # domain-changing executors (r2c/c2r) cannot feed their
+            # output back as the next iterate: carry the input planes
+            # with a numerically negligible data dependency on the
+            # output so XLA cannot hoist the transform out of the loop
+            eps = np.float32(1e-30)
+            return c[0] + eps * yr[..., :1], c[1] + eps * yi[..., :1]
         return yr * inv, yi * inv
 
     # window sized to the op: big transforms get a smaller k so the k2

@@ -40,6 +40,11 @@ _LOCK = threading.Lock()
 
 _OFF_VALUES = ("off", "0", "none", "disabled")
 
+#: store paths already warned about stale tokens this process — the
+#: skip is announced ONCE per store, not once per lookup (the store is
+#: re-read on every miss)
+_STALE_WARNED: set = set()
+
 
 def _library_version() -> str:
     from .. import __version__
@@ -74,23 +79,55 @@ def store_path(device_kind: str) -> Optional[str]:
 
 def _load_store(device_kind: str) -> dict:
     """The validated plans dict for `device_kind`, or {} when the store
-    is absent, disabled, corrupt, or versioned for different code."""
+    is absent, disabled, corrupt, or versioned for different code.
+
+    Migration hardening: a current-schema store may still carry
+    individual STALE tokens (hand-merged stores, files touched by a
+    mixed-version deploy).  Those are SKIPPED with one ``plans.warn``
+    per store per process — not a crash (``PlanKey.from_token`` raising
+    out of ``plan show`` or a merge-write), and not silent truncation
+    of the whole store: every parseable entry still serves."""
+    kept, _stale = _partition_store(device_kind, quiet=False)
+    return kept
+
+
+def _partition_store(device_kind: str, quiet: bool) -> tuple:
+    """(current, stale) plans dicts from the header-validated store.
+    `quiet` suppresses the once-per-store stale warn (the merge-write
+    path reads through here too and must not double-announce)."""
     path = store_path(device_kind)
     if path is None or not os.path.exists(path):
-        return {}
+        return {}, {}
     try:
         with open(path) as fh:
             data = json.load(fh)
     except (OSError, ValueError):
-        return {}
+        return {}, {}
     if not isinstance(data, dict):
-        return {}
+        return {}, {}
     if (data.get("schema") != SCHEMA_VERSION
             or data.get("library_version") != _library_version()
             or data.get("device_kind") != device_kind):
-        return {}
+        return {}, {}
     plans = data.get("plans")
-    return plans if isinstance(plans, dict) else {}
+    if not isinstance(plans, dict):
+        return {}, {}
+    kept, stale = {}, {}
+    reasons = []
+    for token, rec in plans.items():
+        try:
+            PlanKey.from_token(token)
+        except (ValueError, KeyError, TypeError) as e:
+            stale[token] = rec
+            reasons.append(f"{type(e).__name__}: {str(e)[:80]}")
+            continue
+        kept[token] = rec
+    if stale and not quiet and path not in _STALE_WARNED:
+        _STALE_WARNED.add(path)
+        warn(f"plan store {path}: skipped {len(stale)} stale-schema "
+             f"token(s) (e.g. {reasons[0]}); {len(kept)} current "
+             f"plan(s) kept — re-warm to refresh the skipped keys")
+    return kept, stale
 
 
 def memoize(plan: Plan) -> None:
@@ -150,7 +187,15 @@ def store(plan: Plan, persist: bool = True) -> None:
         with open(f"{path}.lock", "w") as lk:
             if fcntl is not None:
                 fcntl.flock(lk, fcntl.LOCK_EX)
-            plans = _load_store(plan.key.device_kind)
+            # merge over the FULL store contents, stale tokens
+            # included: the read path skips them, but the write path
+            # must carry them through verbatim — a mixed-version
+            # deploy's older processes still own those entries, and
+            # rewriting them away here would be exactly the silent
+            # truncation the skip-with-a-warn policy exists to avoid
+            kept, stale = _partition_store(plan.key.device_kind,
+                                           quiet=True)
+            plans = {**stale, **kept}
             plans[plan.key.token()] = plan.to_record()
             data = {
                 "schema": SCHEMA_VERSION,

@@ -19,9 +19,18 @@ from typing import Callable, Optional
 LAYOUTS = ("natural", "pi")
 PRECISIONS = ("split3", "highest", "default", "fp32")
 
+# transform domains (docs/REAL.md): "c2c" is the classic complex
+# transform; "r2c"/"c2r" are the half-spectrum real-input forward and
+# inverse, which ride the c2c plan at n/2 via the pack/Hermitian-split
+# post-passes — n is ALWAYS the real-side length, so an r2c key at n
+# and the c2c key at n describe the same served signal length
+DOMAINS = ("c2c", "r2c", "c2r")
+
 # bump when PlanKey/Plan serialization or ladder parameter semantics
 # change incompatibly — stale disk stores are then ignored wholesale
-SCHEMA_VERSION = 1
+# (schema 2 added the `domain` field; pre-domain tokens are refused by
+# from_token and skipped-with-a-warn by the disk store loader)
+SCHEMA_VERSION = 2
 
 
 def warn(msg: str) -> None:
@@ -84,6 +93,12 @@ class PlanKey:
     ~4e-6), "highest" (XLA 6-pass f32 emulation), "default" (1-pass
     bf16), or "fp32" (the all-float32 jnp stage path — no MXU tail at
     all: the full-precision escape hatch).
+    domain: "c2c" (complex-to-complex), "r2c" (real forward: real
+    planes of length n in, half-spectrum planes of length n//2+1 out),
+    or "c2r" (the inverse: half-spectrum in, real signal of length n
+    out).  The real domains require natural layout and even n — the
+    half-spectrum has no pi order, and the pack trick needs an
+    even/odd split (docs/REAL.md).
     """
 
     device_kind: str
@@ -92,6 +107,7 @@ class PlanKey:
     layout: str = "natural"
     dtype: str = "float32"
     precision: str = "split3"
+    domain: str = "c2c"
 
     def __post_init__(self):
         if self.layout not in LAYOUTS:
@@ -101,6 +117,28 @@ class PlanKey:
                 f"precision={self.precision!r} not in {PRECISIONS}")
         if self.n < 1:
             raise ValueError(f"n={self.n} must be positive")
+        if self.domain not in DOMAINS:
+            raise ValueError(f"domain={self.domain!r} not in {DOMAINS}")
+        if self.domain != "c2c":
+            if self.layout != "natural":
+                raise ValueError(
+                    f"domain={self.domain!r} requires natural layout "
+                    f"(the half-spectrum has no pi order)")
+            if self.n % 2:
+                raise ValueError(
+                    f"domain={self.domain!r} requires even n (the "
+                    f"pack-two-halves trick splits even/odd samples), "
+                    f"got n={self.n}")
+
+    def input_shape(self) -> tuple:
+        """The float-plane shape this key's executor consumes: the
+        signal planes for c2c/r2c, the half-spectrum planes for c2r."""
+        width = self.n // 2 + 1 if self.domain == "c2r" else self.n
+        return self.batch + (width,)
+
+    def output_width(self) -> int:
+        """Trailing-axis length of this key's executor output."""
+        return self.n // 2 + 1 if self.domain == "r2c" else self.n
 
     def token(self) -> str:
         """Canonical serialized form — the disk-store dictionary key."""
@@ -113,6 +151,7 @@ class PlanKey:
                 "layout": self.layout,
                 "dtype": self.dtype,
                 "precision": self.precision,
+                "domain": self.domain,
             },
             sort_keys=True,
             separators=(",", ":"),
@@ -131,6 +170,7 @@ class PlanKey:
             layout=d["layout"],
             dtype=d["dtype"],
             precision=d["precision"],
+            domain=d["domain"],
         )
 
 
@@ -210,7 +250,14 @@ class Plan:
         return self.fn(xr, xi)
 
     def execute_inverse(self, xr, xi):
-        """Inverse via the conj trick (natural layout only)."""
+        """Inverse via the conj trick (natural layout, c2c only — the
+        real domains are directional by construction: the inverse of an
+        r2c plan is a c2r plan for the same n, not a conj trick)."""
+        if self.key.domain != "c2c":
+            raise ValueError(
+                f"execute_inverse is a c2c conj trick; a "
+                f"{self.key.domain} plan is already directional — plan "
+                f"the opposite domain instead")
         if self.key.layout != "natural":
             raise ValueError("inverse requires a natural-layout plan")
         n = self.key.n

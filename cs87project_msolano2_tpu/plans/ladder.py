@@ -87,6 +87,18 @@ def _pow2(n: int) -> bool:
     return n >= 1 and not (n & (n - 1))
 
 
+def c2c_subkey(key: PlanKey) -> PlanKey:
+    """The half-length natural-order c2c key an r2c/c2r key rides
+    (docs/REAL.md): the pack trick turns a length-n real transform
+    into ONE c2c transform at n/2, so candidates, static defaults,
+    and executors for the real domains all delegate here — the real
+    path inherits the whole ladder with zero new kernels."""
+    import dataclasses
+
+    return dataclasses.replace(key, n=key.n // 2, layout="natural",
+                               domain="c2c")
+
+
 def _nrows(key: PlanKey) -> int:
     return math.prod(key.batch) or 1
 
@@ -194,7 +206,12 @@ def candidates(key: PlanKey) -> list:
     crossovers the fourstep entries lead and sixstep rides at the end
     the same way; at and above SIXSTEP_MIN_N the sixstep entries lead
     and both the fused and fourstep entries (infeasible there) drop
-    out."""
+    out.  Real-domain keys (r2c/c2r) race the HALF-LENGTH c2c ladder:
+    the entries are the sub-key's, but build_executor wraps them in
+    the pack/Hermitian passes, so the race times the real path it
+    will actually serve."""
+    if key.domain != "c2c":
+        return candidates(c2c_subkey(key))
     if key.precision == "fp32":
         return []  # fp32 forces the jnp path; nothing to race
     cands = []
@@ -235,7 +252,11 @@ def static_default(key: PlanKey):
     """Measured-good (variant, params) used when no tuned/cached plan
     exists — the ONLY source offline mode serves.  Mirrors the dispatch
     the library shipped before the plan layer, so un-tuned behavior is
-    never worse than it was."""
+    never worse than it was.  Real-domain keys take the half-length
+    c2c sub-key's default — the variant namespace is shared, and
+    build_executor adds the pack/Hermitian wrapping."""
+    if key.domain != "c2c":
+        return static_default(c2c_subkey(key))
     natural = key.layout == "natural"
     if key.precision == "fp32":
         if not natural:
@@ -301,7 +322,20 @@ def build_executor(key: PlanKey, variant: str, params: dict):
 
     Raises ValueError for statically infeasible parameter combinations
     (the tuner records those as rejections); kernel-level lowering
-    failures surface when the returned callable is first traced."""
+    failures surface when the returned callable is first traced.
+
+    Real-domain keys (r2c/c2r) wrap the half-length c2c executor of
+    the SAME (variant, params) in the O(n) pack/Hermitian passes
+    (models.real) — one executor, traceable end to end, so the
+    degradation chain and the obs spans see the whole real transform
+    as one unit."""
+    if key.domain != "c2c":
+        from ..models import real as real_mod
+
+        inner = build_executor(c2c_subkey(key), variant, params)
+        if key.domain == "r2c":
+            return real_mod.rfft_executor(inner, key.n)
+        return real_mod.irfft_executor(inner, key.n)
     natural = key.layout == "natural"
     n = key.n
 

@@ -93,9 +93,21 @@ def _pi_take(key):
 
 
 def build_rung(key, rung: str) -> Callable:
-    """The executable for one chain rung at `key`'s shape/layout.
+    """The executable for one chain rung at `key`'s shape/layout/domain.
     Raises (statically) when the rung cannot serve the key — the chain
-    walker treats that exactly like the rung failing and moves on."""
+    walker treats that exactly like the rung failing and moves on.
+
+    Real-domain keys (r2c/c2r, docs/REAL.md) degrade like everything
+    else: the kernel rungs (fourstep/rql) serve the half-length packed
+    c2c transform wrapped in the Hermitian passes — built through the
+    same ladder executor builder, so the wrapping is identical to the
+    healthy path's — and the escape rungs use ``jnp.fft.rfft/irfft``
+    and ``numpy.fft.rfft/irfft`` natively (the half-spectrum is their
+    home turf; no rung ever silently widens back to full-spectrum
+    traffic)."""
+    real_domain = getattr(key, "domain", "c2c") != "c2c"
+    inner_n = key.n // 2 if real_domain else key.n
+
     if rung == "fourstep":
         from ..plans import ladder
 
@@ -104,11 +116,13 @@ def build_rung(key, rung: str) -> Callable:
                              "path")
         # build AND probe feasibility statically: past fourstep's VMEM
         # bound (n >= 2^25 — sixstep's whole reason to exist) the
-        # auto-cb chooser raises here and the walk moves on to rql
+        # auto-cb chooser raises here and the walk moves on to rql.
+        # Real domains probe the INNER packed length — the kernel the
+        # rung actually runs.
         from ..ops.pallas_fft import MAX_ROW_TILE, fourstep_auto_cb
 
-        if key.n > MAX_ROW_TILE:
-            fourstep_auto_cb(key.n, MAX_ROW_TILE, 256, True)
+        if inner_n > MAX_ROW_TILE:
+            fourstep_auto_cb(inner_n, MAX_ROW_TILE, 256, True)
         return ladder.build_executor(key, "fourstep",
                                      dict(_FOURSTEP_PARAMS))
 
@@ -121,6 +135,26 @@ def build_rung(key, rung: str) -> Callable:
 
     if rung == "jnp-fft":
         import jax.numpy as jnp
+
+        if real_domain and key.domain == "r2c":
+            def jnp_rfft_run(xr, xi):
+                del xi  # real by declaration (domain="r2c")
+                y = jnp.fft.rfft(xr.astype(jnp.float32), axis=-1)
+                return (jnp.real(y).astype(jnp.float32),
+                        jnp.imag(y).astype(jnp.float32))
+
+            return jnp_rfft_run
+        if real_domain:
+            n = key.n
+
+            def jnp_irfft_run(xr, xi):
+                y = jnp.fft.irfft(xr.astype(jnp.complex64)
+                                  + 1j * xi.astype(jnp.complex64),
+                                  n=n, axis=-1)
+                yr = y.astype(jnp.float32)
+                return yr, jnp.zeros_like(yr)
+
+            return jnp_irfft_run
 
         idx = _pi_take(key)
 
@@ -142,18 +176,39 @@ def build_rung(key, rung: str) -> Callable:
         import numpy as np
 
         idx = _pi_take(key)
-        shape = key.batch + (key.n,)
+        out_shape = key.batch + (key.output_width(),) if real_domain \
+            else key.batch + (key.n,)
 
-        def host_fft(ar, ai):
-            y = np.fft.fft(np.asarray(ar).astype(np.complex128)
-                           + 1j * np.asarray(ai).astype(np.complex128),
-                           axis=-1)
-            if idx is not None:
-                y = y[..., idx]
-            return (y.real.astype(np.float32), y.imag.astype(np.float32))
+        if real_domain and key.domain == "r2c":
+            def host_fft(ar, ai):
+                del ai  # real by declaration (domain="r2c")
+                y = np.fft.rfft(np.asarray(ar).astype(np.float64),
+                                axis=-1)
+                return (y.real.astype(np.float32),
+                        y.imag.astype(np.float32))
+        elif real_domain:
+            n = key.n
 
-        out_struct = (jax.ShapeDtypeStruct(shape, np.float32),
-                      jax.ShapeDtypeStruct(shape, np.float32))
+            def host_fft(ar, ai):
+                y = np.fft.irfft(
+                    np.asarray(ar).astype(np.float64)
+                    + 1j * np.asarray(ai).astype(np.float64),
+                    n=n, axis=-1)
+                return (y.astype(np.float32),
+                        np.zeros_like(y, np.float32))
+        else:
+            def host_fft(ar, ai):
+                y = np.fft.fft(np.asarray(ar).astype(np.complex128)
+                               + 1j * np.asarray(ai).astype(
+                                   np.complex128),
+                               axis=-1)
+                if idx is not None:
+                    y = y[..., idx]
+                return (y.real.astype(np.float32),
+                        y.imag.astype(np.float32))
+
+        out_struct = (jax.ShapeDtypeStruct(out_shape, np.float32),
+                      jax.ShapeDtypeStruct(out_shape, np.float32))
 
         def numpy_run(xr, xi):
             return jax.pure_callback(host_fft, out_struct, xr, xi)

@@ -52,16 +52,27 @@ SERVE_FALLBACK_RUNGS = ("jnp-fft", "numpy-ref")
 @dataclasses.dataclass(frozen=True)
 class GroupKey:
     """The coalescing identity: requests may share a kernel invocation
-    iff they share all four fields."""
+    iff they share all five fields.  ``domain`` separates the
+    half-spectrum real paths (r2c/c2r — docs/REAL.md) from c2c at the
+    same n: an r2c group's coalesced invocation runs the HALF-WIDTH
+    packed kernel, so mixing the domains would stage the wrong
+    planes."""
 
     n: int
     layout: str = "natural"
     precision: str = "split3"
     inverse: bool = False
+    domain: str = "c2c"
 
     def label(self) -> str:
         d = ":inv" if self.inverse else ""
+        d += f":{self.domain}" if self.domain != "c2c" else ""
         return f"{self.n}:{self.layout}:{self.precision}{d}"
+
+    def input_width(self) -> int:
+        """Trailing-axis length of this group's staged request planes
+        (half-spectrum bins for c2r, the signal length otherwise)."""
+        return self.n // 2 + 1 if self.domain == "c2r" else self.n
 
 
 def batch_bucket(size: int) -> int:
@@ -102,7 +113,8 @@ class BatchRunner:
 
     def _plan_for(self, group: GroupKey, bucket: int):
         return plans.plan_for((bucket, group.n), layout=group.layout,
-                              precision=group.precision)
+                              precision=group.precision,
+                              domain=group.domain)
 
     def _callable(self, group: GroupKey, bucket: int,
                   rung: Optional[str]):
@@ -141,8 +153,9 @@ class BatchRunner:
     # ----------------------------------------------------- staging
 
     def _stage(self, group: GroupKey, planes, bucket: int):
-        xr = self.pool.acquire((bucket, group.n))
-        xi = self.pool.acquire((bucket, group.n))
+        width = group.input_width()
+        xr = self.pool.acquire((bucket, width))
+        xi = self.pool.acquire((bucket, width))
         for i, (pr, pi) in enumerate(planes):
             xr[i], xi[i] = pr, pi
         if len(planes) < bucket:  # padding rows must be defined

@@ -34,9 +34,13 @@ from .shapes import ShapeSpec, load_shapes
 from .slo import format_summary
 
 #: the smoke's served set: one coalescing-burst shape + mixed traffic
-#: (a second n and a pi-layout shape, so grouping is exercised)
+#: (a second n, a pi-layout shape, and a half-spectrum r2c shape —
+#: grouping AND the real-input domain path are exercised; the r2c
+#: responses are verified against numpy.fft.rfft and asserted
+#: half-width, docs/REAL.md)
 SMOKE_SPECS = (ShapeSpec(n=4096), ShapeSpec(n=1024),
-               ShapeSpec(n=2048, layout="pi"))
+               ShapeSpec(n=2048, layout="pi"),
+               ShapeSpec(n=1024, domain="r2c"))
 
 
 def _build_config(args) -> ServeConfig:
@@ -125,20 +129,59 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
     k = max(2, args.k)
     burst = specs[0]
     rng = np.random.default_rng(0)
-    inputs = [(rng.standard_normal(burst.n).astype(np.float32),
-               rng.standard_normal(burst.n).astype(np.float32))
-              for _ in range(k)]
-    mixed = [(s, rng.standard_normal(s.n).astype(np.float32),
-              rng.standard_normal(s.n).astype(np.float32))
-             for s in specs[1:] for _ in range(2)]
+
+    def planes_for(spec):
+        """(xr, xi) request planes for one spec's domain: both planes
+        for c2c, a real signal + zeros for r2c, half-spectrum bins
+        for c2r (docs/REAL.md)."""
+        if spec.domain == "c2r":
+            spec_ref = np.fft.rfft(
+                rng.standard_normal(spec.n).astype(np.float64))
+            return (spec_ref.real.astype(np.float32),
+                    spec_ref.imag.astype(np.float32))
+        xr = rng.standard_normal(spec.n).astype(np.float32)
+        if spec.domain == "r2c":
+            return xr, np.zeros_like(xr)
+        return xr, rng.standard_normal(spec.n).astype(np.float32)
+
+    def check_response(spec, xr, xi, resp):
+        """Problem string, or None: natural-layout responses verify
+        against the numpy oracle of their DOMAIN, and half-spectrum
+        responses must actually be half-width (a full-width r2c
+        answer means the packed path never ran)."""
+        if spec.layout != "natural":
+            return None
+        got = np.asarray(resp.yr) + 1j * np.asarray(resp.yi)
+        if spec.domain == "r2c":
+            if got.shape[-1] != spec.n // 2 + 1:
+                return (f"response {resp.rid}: r2c answer is "
+                        f"{got.shape[-1]} bins, want {spec.n // 2 + 1}"
+                        f" (half-spectrum)")
+            ref = np.fft.rfft(xr.astype(np.float64))
+        elif spec.domain == "c2r":
+            got = np.asarray(resp.yr)
+            ref = np.fft.irfft(xr.astype(np.float64)
+                               + 1j * xi.astype(np.float64), n=spec.n)
+        else:
+            ref = np.fft.fft(xr.astype(np.complex128)
+                             + 1j * xi.astype(np.complex128))
+        err = verify.rel_err(got, ref)
+        if err > 1e-4:
+            return (f"response {resp.rid} wrong: rel err {err:.3e} vs "
+                    f"numpy {spec.domain}")
+        return None
+
+    inputs = [planes_for(burst) for _ in range(k)]
+    mixed = [(s, *planes_for(s)) for s in specs[1:] for _ in range(2)]
 
     async def main():
         async with Dispatcher(cfg, specs) as d:
             calls = [d.submit(xr, xi, layout=burst.layout,
-                              precision=burst.precision)
+                              precision=burst.precision,
+                              domain=burst.domain)
                      for xr, xi in inputs]
             calls += [d.submit(xr, xi, layout=s.layout,
-                               precision=s.precision)
+                               precision=s.precision, domain=s.domain)
                       for s, xr, xi in mixed]
             responses = await asyncio.gather(*calls)
             return d, responses
@@ -148,21 +191,22 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
     problems = []
     # every natural-layout response must verify against numpy: a padded
     # coalesced batch that hands back the wrong rows is the one bug a
-    # latency report would never catch
+    # latency report would never catch — and an r2c response must come
+    # back half-width, or the domain plan quietly served full-spectrum
     for (xr, xi), resp in zip(inputs, responses[:k]):
-        if burst.layout != "natural":
+        problem = check_response(burst, xr, xi, resp)
+        if problem:
+            problems.append(problem)
             break
-        ref = np.fft.fft(xr.astype(np.complex128)
-                         + 1j * xi.astype(np.complex128))
-        err = verify.rel_err(np.asarray(resp.yr)
-                             + 1j * np.asarray(resp.yi), ref)
-        if err > 1e-4:
-            problems.append(f"response {resp.rid} wrong: rel err "
-                            f"{err:.3e} vs numpy fft")
+    for (s, xr, xi), resp in zip(mixed, responses[k:]):
+        problem = check_response(s, xr, xi, resp)
+        if problem:
+            problems.append(problem)
             break
 
     label = GroupKey(n=burst.n, layout=burst.layout,
-                     precision=burst.precision).label()
+                     precision=burst.precision,
+                     domain=burst.domain).label()
     reqs = int(metrics.counter_value("pifft_serve_requests_total",
                                      shape=label))
     batches = int(metrics.counter_value("pifft_serve_batches_total",

@@ -191,7 +191,8 @@ class Dispatcher:
         self._ema_ms: dict = {}
         self._rid = itertools.count()
         self._closing = False
-        self._served = {(s.n, s.layout, s.precision) for s in self.specs}
+        self._served = {(s.n, s.layout, s.precision, s.domain)
+                        for s in self.specs}
 
     # ----------------------------------------------------- lifecycle
 
@@ -226,29 +227,70 @@ class Dispatcher:
 
     # ----------------------------------------------------- admission
 
-    async def submit(self, xr, xi, layout: str = "natural",
+    async def submit(self, xr, xi=None, layout: str = "natural",
                      precision: Optional[str] = None,
-                     inverse: bool = False) -> Response:
+                     inverse: bool = False,
+                     domain: str = "c2c") -> Response:
         """Serve one n-point transform of float planes ``(n,)``.
         Raises a :class:`ServeError` subclass — never hangs — when the
-        request cannot be admitted or no rung could serve it."""
+        request cannot be admitted or no rung could serve it.
+
+        `domain` picks the transform family (docs/REAL.md): "c2c"
+        (default — both planes required), "r2c" (real forward: `xr` is
+        the length-n real signal, `xi` may be omitted and must
+        otherwise be zeros — a nonzero imaginary plane on a
+        declared-real request would be silently dropped, which is a
+        wrong answer, so it is refused instead), or "c2r" (the
+        inverse: the planes carry the n//2+1 half-spectrum bins and
+        the response is the length-n real signal)."""
         if self._closing:
             raise DispatcherClosed("dispatcher is shut down")
+        from ..plans.core import DOMAINS
+
+        if domain not in DOMAINS:
+            raise ServeError(f"domain={domain!r} not in {DOMAINS}")
         xr = np.asarray(xr, np.float32)
+        if xi is None:
+            if domain != "r2c":
+                raise ServeError(f"domain={domain!r} requests need both "
+                                 f"planes; only r2c input is real by "
+                                 f"declaration")
+            xi = np.zeros_like(xr)
         xi = np.asarray(xi, np.float32)
         if xr.ndim != 1 or xr.shape != xi.shape:
             raise ServeError(f"request planes must be matching 1-D "
                              f"arrays, got {xr.shape} / {xi.shape}")
-        n = xr.shape[0]
+        if domain == "c2r":
+            # the planes carry half-spectrum bins; the group is keyed
+            # by the real-side length they decode to
+            n = 2 * (xr.shape[0] - 1)
+        else:
+            n = xr.shape[0]
         if n < 2 or n & (n - 1):
-            raise ServeError(f"n={n} is not a power of two >= 2")
+            raise ServeError(f"n={n} is not a power of two >= 2"
+                             + (" (c2r planes must carry n//2+1 bins)"
+                                if domain == "c2r" else ""))
         if inverse and layout != "natural":
             raise ServeError("inverse requires natural layout (the "
                              "conj-trick contract, plans.core)")
+        if domain != "c2c":
+            if inverse:
+                raise ServeError("inverse is the c2c conj trick; use "
+                                 "domain='c2r' for the real inverse")
+            if layout != "natural":
+                raise ServeError(f"domain={domain!r} requires natural "
+                                 f"layout (the half-spectrum has no pi "
+                                 f"order)")
+            if domain == "r2c" and np.any(xi):
+                raise ServeError("r2c request carries a nonzero "
+                                 "imaginary plane — the half-spectrum "
+                                 "path would silently drop it; send "
+                                 "zeros (or omit xi), or use c2c")
         group = GroupKey(n=n, layout=layout,
-                         precision=precision or "split3", inverse=inverse)
+                         precision=precision or "split3",
+                         inverse=inverse, domain=domain)
         if self.config.strict_shapes and \
-                (n, layout, group.precision) not in self._served:
+                (n, layout, group.precision, domain) not in self._served:
             raise ShapeNotServed(
                 f"shape {group.label()} is not in the warmed set "
                 f"({len(self.specs)} shape(s)); add it to the shape "

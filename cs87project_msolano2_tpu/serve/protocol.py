@@ -3,9 +3,13 @@
 One frame = a 4-byte big-endian length + a UTF-8 JSON body.  Requests:
 
     {"op": "fft", "id": 7, "xr": [...], "xi": [...],
-     "layout": "natural", "precision": "split3", "inverse": false}
+     "layout": "natural", "precision": "split3", "inverse": false,
+     "domain": "c2c"}
     {"op": "stats"}
     {"op": "ping"}
+
+``domain`` is optional (default "c2c"); ``"r2c"`` requests may omit
+``xi`` entirely — the input is real by declaration (docs/REAL.md).
 
 Responses mirror :meth:`~.dispatcher.Response.to_record` (with the
 result planes as ``yr``/``yi`` float lists) on success, or
@@ -84,12 +88,14 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
                 "error": {"type": "bad_request",
                           "message": f"unknown op {op!r}"}}
     try:
+        xi = msg.get("xi")
         resp = await dispatcher.submit(
             np.asarray(msg.get("xr", ()), np.float32),
-            np.asarray(msg.get("xi", ()), np.float32),
+            np.asarray(xi, np.float32) if xi is not None else None,
             layout=msg.get("layout", "natural"),
             precision=msg.get("precision"),
-            inverse=bool(msg.get("inverse", False)))
+            inverse=bool(msg.get("inverse", False)),
+            domain=msg.get("domain", "c2c"))
     except ServeError as e:
         return {"id": rid, "ok": False, "error": e.to_record()}
     rec = resp.to_record(arrays=True)
@@ -158,20 +164,23 @@ async def serve_socket(dispatcher: Dispatcher, host: str = "127.0.0.1",
         await server.serve_forever()
 
 
-async def request_over_socket(host: str, port: int, xr, xi,
+async def request_over_socket(host: str, port: int, xr, xi=None,
                               layout: str = "natural",
                               precision: Optional[str] = None,
-                              inverse: bool = False) -> dict:
+                              inverse: bool = False,
+                              domain: str = "c2c") -> dict:
     """Client helper: one fft request over a fresh connection (tests
     and the CLI demo; a real client keeps the connection open)."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
-        writer.write(encode_frame({
+        frame = {
             "op": "fft", "id": 0,
             "xr": np.asarray(xr, np.float64).tolist(),
-            "xi": np.asarray(xi, np.float64).tolist(),
             "layout": layout, "precision": precision,
-            "inverse": inverse}))
+            "inverse": inverse, "domain": domain}
+        if xi is not None:
+            frame["xi"] = np.asarray(xi, np.float64).tolist()
+        writer.write(encode_frame(frame))
         await writer.drain()
         reply = await read_frame(reader)
         if reply is None:

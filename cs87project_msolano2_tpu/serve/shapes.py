@@ -8,7 +8,8 @@ inference stack's warmup pass exists to avoid.  The shape set is a
 JSONL file, one shape per line:
 
     {"n": 1048576, "batch": [], "layout": "pi", "precision": "split3"}
-    {"n": 4096}                        # defaults: batch=(), natural, split3
+    {"n": 4096}                  # defaults: batch=(), natural, split3, c2c
+    {"n": 4096, "domain": "r2c"}  # half-spectrum real shape (docs/REAL.md)
 
 ``pifft plan warm --shapes FILE`` warms the whole set in one call
 (instead of one ``plan warm`` invocation per shape), and
@@ -30,17 +31,30 @@ from .. import plans
 class ShapeSpec:
     """One served transform shape: everything needed to build its
     PlanKey except the device kind (resolved at warm time, so one
-    shape file serves every host)."""
+    shape file serves every host).  ``domain`` declares the transform
+    family: "c2c" (default) or the half-spectrum real paths
+    "r2c"/"c2r" — n is the real-side length either way
+    (docs/REAL.md)."""
 
     n: int
     batch: tuple = ()
     layout: str = "natural"
     precision: str = "split3"
+    domain: str = "c2c"
 
     def __post_init__(self):
         if self.n < 2 or self.n & (self.n - 1):
             raise ValueError(f"served n={self.n} must be a power of two "
                              f">= 2 (the plan ladder's domain)")
+        from ..plans.core import DOMAINS
+
+        if self.domain not in DOMAINS:
+            raise ValueError(f"served domain={self.domain!r} not in "
+                             f"{DOMAINS}")
+        if self.domain != "c2c" and self.layout != "natural":
+            raise ValueError(f"domain={self.domain!r} requires natural "
+                             f"layout (the half-spectrum has no pi "
+                             f"order)")
 
     @classmethod
     def from_record(cls, rec: dict) -> "ShapeSpec":
@@ -52,21 +66,28 @@ class ShapeSpec:
             batch=tuple(int(b) for b in rec.get("batch") or ()),
             layout=rec.get("layout", "natural"),
             precision=rec.get("precision") or "split3",
+            domain=rec.get("domain") or "c2c",
         )
 
     def to_record(self) -> dict:
         return {"n": self.n, "batch": list(self.batch),
-                "layout": self.layout, "precision": self.precision}
+                "layout": self.layout, "precision": self.precision,
+                "domain": self.domain}
 
     def key(self) -> plans.PlanKey:
         """The PlanKey this shape resolves to on the current device."""
         return plans.make_key(self.n, self.batch, layout=self.layout,
-                              precision=self.precision)
+                              precision=self.precision,
+                              domain=self.domain)
 
     def label(self) -> str:
-        """Stable human/metric label (the per-shape SLO row key)."""
+        """Stable human/metric label (the per-shape SLO row key).  The
+        domain column rides every non-c2c label so a half-spectrum SLO
+        row is never mistaken for its full-spectrum sibling at the
+        same n."""
         b = "x".join(str(d) for d in self.batch) + "x" if self.batch else ""
-        return f"{b}{self.n}:{self.layout}:{self.precision}"
+        d = f":{self.domain}" if self.domain != "c2c" else ""
+        return f"{b}{self.n}:{self.layout}:{self.precision}{d}"
 
 
 def load_shapes(path: str) -> list:

@@ -84,21 +84,32 @@ def hbm_peak_bytes_per_s(device_kind: str) -> Optional[float]:
     return best[1] * 1e9 if best else None
 
 
-def fft_min_hbm_bytes(n: int) -> int:
-    """The floor any n-point float32-plane FFT must move through HBM:
-    one read and one write of the re+im planes (4 B x 2 planes x 2
-    directions = 16 B/element).  Twiddle/table traffic is excluded —
-    it is implementation choice, which is exactly what the utilization
-    figure should penalize."""
+def fft_min_hbm_bytes(n: int, domain: str = "c2c") -> int:
+    """The floor any n-point float32-plane FFT must move through HBM.
+
+    c2c: one read and one write of the re+im planes (4 B x 2 planes x
+    2 directions = 16 B/element).  The half-spectrum real domains
+    (r2c/c2r — docs/REAL.md) move HALF that at the same n: the real
+    side is ONE plane of n floats (4n B) and the spectral side two
+    planes of ~n/2 bins (~4n B), so 8 B/element total — the whole
+    point of the domain-aware plan ladder, and the halving the
+    ``make rfft-smoke`` gate asserts against the bytes meter.
+    Twiddle/table traffic is excluded — it is implementation choice,
+    which is exactly what the utilization figure should penalize."""
+    if domain in ("r2c", "c2r"):
+        return 8 * n
     return 16 * n
 
 
-def fft_hbm_bytes(n: int, carry_passes: int = 0) -> int:
+def fft_hbm_bytes(n: int, carry_passes: int = 0,
+                  domain: str = "c2c") -> int:
     """The traffic an n-point transform with `carry_passes` materialized
-    intermediates actually moves: the 16 B/element floor plus one full
-    write+read round trip of the planes per carry pass.  This — not the
-    floor — is what the bytes-moved meter charges."""
-    return fft_min_hbm_bytes(n) * (1 + carry_passes)
+    intermediates actually moves: the per-domain floor plus one full
+    write+read round trip of the planes per carry pass.  A real-domain
+    carry rides the PACKED n/2 complex planes (16 B x n/2 = 8n B), so
+    the halving holds pass for pass.  This — not the floor — is what
+    the bytes-moved meter charges."""
+    return fft_min_hbm_bytes(n, domain) * (1 + carry_passes)
 
 
 def roofline_ceiling(carry_passes: Optional[int]) -> Optional[float]:
@@ -112,25 +123,30 @@ def roofline_ceiling(carry_passes: Optional[int]) -> Optional[float]:
 
 
 def roofline_utilization(n: int, ms: float, device_kind: str,
-                         carry_passes: int = 0) -> Optional[float]:
+                         carry_passes: int = 0,
+                         domain: str = "c2c") -> Optional[float]:
     """Achieved fraction of the HBM roofline for an n-point transform
-    measured at `ms` per call, charging the minimum traffic (see
-    fft_min_hbm_bytes) so the figure reads against the 1/(1+p) ceiling
-    of the path's declared carry passes.  None when the device peak is
-    unknown or the measurement is degenerate."""
+    measured at `ms` per call, charging the minimum traffic of the
+    transform's DOMAIN (see fft_min_hbm_bytes — the real domains'
+    floor is half the c2c one) so the figure reads against the
+    1/(1+p) ceiling of the path's declared carry passes.  None when
+    the device peak is unknown or the measurement is degenerate."""
     from ..obs import metrics
 
     if ms is not None and ms > 0.0:
         # observability: the bytes-moved meter charges the PLAN-DECLARED
-        # traffic (floor + carry round trips), so a run's total data
-        # motion — carries included — is queryable; the floor-only
-        # counter is kept for cross-round comparability
-        metrics.inc("pifft_hbm_min_bytes_total", fft_min_hbm_bytes(n))
-        metrics.inc("pifft_hbm_bytes_total", fft_hbm_bytes(n, carry_passes))
+        # traffic (floor + carry round trips) of the DOMAIN actually
+        # served, so a run's total data motion — carries included, the
+        # r2c halving included — is queryable; the floor-only counter
+        # is kept for cross-round comparability
+        metrics.inc("pifft_hbm_min_bytes_total",
+                    fft_min_hbm_bytes(n, domain))
+        metrics.inc("pifft_hbm_bytes_total",
+                    fft_hbm_bytes(n, carry_passes, domain))
     peak = hbm_peak_bytes_per_s(device_kind)
     if peak is None or ms is None or ms <= 0.0:
         return None
-    util = fft_min_hbm_bytes(n) / (ms * 1e-3) / peak
-    metrics.set_gauge("pifft_roofline_util", util,
+    util = fft_min_hbm_bytes(n, domain) / (ms * 1e-3) / peak
+    metrics.set_gauge("pifft_roofline_util", util, domain=domain,
                       n=f"2^{max(n, 1).bit_length() - 1}")
     return util

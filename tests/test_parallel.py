@@ -123,9 +123,10 @@ def test_fft_batched_planes_per_shard_plan_key(devices8, monkeypatch):
     seen = []
     real_plan_for = batched.plans.plan_for
 
-    def spy(shape, layout="natural", precision=None):
+    def spy(shape, layout="natural", precision=None, domain="c2c"):
         seen.append((tuple(shape), layout, precision))
-        return real_plan_for(shape, layout=layout, precision=precision)
+        return real_plan_for(shape, layout=layout, precision=precision,
+                             domain=domain)
 
     monkeypatch.setattr(batched.plans, "plan_for", spy)
     mesh = make_mesh(8, axis="data")

@@ -1,0 +1,625 @@
+"""Half-spectrum real-input transforms (docs/REAL.md): Hermitian
+symmetry, rfft/irfft parity and round trips across the ladder (fused
+offline + slow-marked fourstep at 2^22), the domain plan-key semantics
+(token round trip, old-schema refusal, stale-token store migration,
+riding the cached c2c winner at n/2), the degrade-chain walk on the
+r2c path down to the numpy rung, the domain-aware roofline traffic
+model (the bytes-halved tentpole), serve-path coalescing of half-width
+r2c requests, the batched/sharded real path, the analyze loader's
+domain backfill, and the PIF110 check rule."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import plans, resilience
+from cs87project_msolano2_tpu.models.real import (
+    hermitian_merge,
+    irfft,
+    pack_real_planes,
+    rfft,
+)
+from cs87project_msolano2_tpu.plans import cache as plan_cache
+from cs87project_msolano2_tpu.plans import ladder
+from cs87project_msolano2_tpu.plans.core import SCHEMA_VERSION, Plan, PlanKey
+
+
+@pytest.fixture(autouse=True)
+def fresh_memory_cache():
+    plan_cache.clear(memory=True, disk=False)
+    yield
+    plan_cache.clear(memory=True, disk=False)
+
+
+def real_input(n, seed=0, batch=()):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(batch + (n,)).astype(np.float32)
+
+
+def rel_err(got, ref):
+    return np.max(np.abs(np.asarray(got) - ref)) / np.max(np.abs(ref))
+
+
+# ------------------------------------------------- parity + properties
+
+
+@pytest.mark.parametrize("n", [2, 4, 64, 1024, 4096, 16384])
+def test_rfft_parity_vs_numpy(n):
+    x = real_input(n, seed=1)
+    assert rel_err(rfft(x), np.fft.rfft(x.astype(np.float64))) < 1e-5
+
+
+def test_rfft_batched_parity():
+    x = real_input(512, seed=2, batch=(3, 5))
+    ref = np.fft.rfft(x.astype(np.float64), axis=-1)
+    assert rel_err(rfft(x), ref) < 1e-5
+
+
+def test_rfft_hermitian_symmetry_property():
+    """The property the half-spectrum exists because of: for random
+    real input, the full spectrum is conjugate-symmetric
+    (X[n-k] = conj(X[k])), the DC and Nyquist bins are real, and
+    rfft is exactly the full spectrum's non-redundant prefix."""
+    from cs87project_msolano2_tpu.models.fft import fft
+
+    n = 2048
+    x = real_input(n, seed=3)
+    full = np.asarray(fft(x)).astype(np.complex128)
+    half = np.asarray(rfft(x)).astype(np.complex128)
+    scale = np.max(np.abs(full))
+    k = np.arange(1, n)
+    assert np.max(np.abs(full[n - k] - np.conj(full[k]))) / scale < 1e-5
+    assert abs(full[0].imag) / scale < 1e-5          # DC is real
+    assert abs(full[n // 2].imag) / scale < 1e-5     # Nyquist is real
+    assert np.max(np.abs(half - full[:n // 2 + 1])) / scale < 1e-5
+    # and the half-spectrum really is half-width
+    assert half.shape == (n // 2 + 1,)
+
+
+@pytest.mark.parametrize("n", [4, 256, 4096])
+def test_rfft_irfft_roundtrip(n):
+    x = real_input(n, seed=4)
+    back = np.asarray(irfft(rfft(x)))
+    assert np.max(np.abs(back - x)) < 1e-4
+
+
+def test_irfft_parity_vs_numpy():
+    n = 1024
+    spec = np.fft.rfft(real_input(n, seed=5).astype(np.float64))
+    ref = np.fft.irfft(spec, n=n)
+    got = np.asarray(irfft(spec.astype(np.complex64)))
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-4
+
+
+def test_rfft_refuses_complex_input():
+    with pytest.raises(ValueError, match="real"):
+        rfft(np.zeros(8, np.complex64))
+
+
+def test_pack_merge_building_blocks():
+    """The O(n) passes in isolation: pack deinterleaves, merge applied
+    to an exact packed FFT reproduces numpy.fft.rfft exactly."""
+    n = 256
+    x = real_input(n, seed=6)
+    zr, zi = pack_real_planes(x)
+    assert np.array_equal(np.asarray(zr), x[0::2])
+    assert np.array_equal(np.asarray(zi), x[1::2])
+    z = np.fft.fft(x[0::2].astype(np.float64)
+                   + 1j * x[1::2].astype(np.float64))
+    yr, yi = hermitian_merge(z.real.astype(np.float32),
+                             z.imag.astype(np.float32), n)
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    assert rel_err(got, np.fft.rfft(x.astype(np.float64))) < 1e-6
+
+
+# ------------------------------------------------- the ladder, wrapped
+
+
+def test_rfft_rides_fused_kernel():
+    """An r2c executor built on the fused single-pass kernel (the
+    inner c2c at n/2 with interpret-safe tile/qb) matches numpy —
+    the pack/Hermitian wrapping composes with the real kernel
+    family, not just the jnp fallback."""
+    n = 1 << 14  # inner fused c2c at 2^13
+    key = plans.make_key(n, layout="natural", domain="r2c")
+    fn = ladder.build_executor(key, "fused",
+                               {"tile": 1 << 12, "qb": 8, "tail": 256})
+    x = real_input(n, seed=7)
+    yr, yi = fn(x, np.zeros_like(x))
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    assert rel_err(got, np.fft.rfft(x.astype(np.float64))) < 1e-5
+
+
+@pytest.mark.slow
+def test_rfft_rides_fourstep_kernel_2_22():
+    """The large-n rung: r2c at 2^22 over the fourstep HBM-carry
+    pipeline at 2^21 (interpret mode — the same code compiles for
+    TPU; the tuned-path acceptance bound is rel err <= 1e-5)."""
+    n = 1 << 22
+    key = plans.make_key(n, layout="natural", domain="r2c")
+    fn = ladder.build_executor(
+        key, "fourstep",
+        {"tile": 1 << 16, "cb": None, "tail": 256, "separable": True})
+    x = real_input(n, seed=8)
+    yr, yi = fn(x, np.zeros_like(x))
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    assert rel_err(got, np.fft.rfft(x.astype(np.float64))) < 1e-5
+
+
+# ----------------------------------------------------- plan-key domain
+
+
+def test_plan_key_domain_token_round_trip():
+    for key in (
+        plans.make_key(1024, layout="natural", domain="r2c",
+                       device_kind="TPU test-kind"),
+        plans.make_key(4096, (8,), layout="natural", domain="c2r",
+                       device_kind="TPU test-kind"),
+        plans.make_key(512),
+    ):
+        assert PlanKey.from_token(key.token()) == key
+    assert plans.make_key(512).domain == "c2c"
+
+
+def test_plan_key_domain_validation():
+    with pytest.raises(ValueError, match="domain"):
+        plans.make_key(512, domain="half")
+    with pytest.raises(ValueError, match="natural"):
+        plans.make_key(512, layout="pi", domain="r2c")
+    with pytest.raises(ValueError, match="even"):
+        plans.make_key(9, domain="r2c")
+
+
+def test_plan_key_io_shapes():
+    k = plans.make_key(1024, (4,), layout="natural", domain="r2c")
+    assert k.input_shape() == (4, 1024) and k.output_width() == 513
+    k = plans.make_key(1024, layout="natural", domain="c2r")
+    assert k.input_shape() == (513,) and k.output_width() == 1024
+    k = plans.make_key(1024)
+    assert k.input_shape() == (1024,) and k.output_width() == 1024
+
+
+def test_old_schema_token_is_refused():
+    """A pre-domain (schema 1) token must be refused cleanly — the
+    field it lacks is compile-relevant, so guessing would alias a
+    half-spectrum plan onto a c2c program."""
+    old = json.dumps({
+        "v": SCHEMA_VERSION - 1, "device_kind": "TPU test-kind",
+        "n": 1024, "batch": [], "layout": "pi", "dtype": "float32",
+        "precision": "split3"}, sort_keys=True, separators=(",", ":"))
+    with pytest.raises(ValueError, match="schema"):
+        PlanKey.from_token(old)
+
+
+def test_stale_tokens_in_disk_store_skipped_with_one_warn(
+        tmp_path, monkeypatch, capsys):
+    """Plan-cache migration hardening: a current-schema store carrying
+    stale (pre-domain) tokens serves every valid entry, skips the
+    stale ones with ONE plans.warn — not a crash, not a silent wipe —
+    and `plan show` survives the same file."""
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = plans.make_key(4096, (16,), device_kind="TPU test-kind")
+    plan_cache.store(Plan(key=key, variant="rows",
+                          params={"tail": 256}, source="tuned", ms=0.5))
+    path = plan_cache.store_path(key.device_kind)
+    with open(path) as fh:
+        data = json.load(fh)
+    stale_token = json.dumps({
+        "v": SCHEMA_VERSION - 1, "device_kind": "TPU test-kind",
+        "n": 2048, "batch": [], "layout": "pi", "dtype": "float32",
+        "precision": "split3"}, sort_keys=True, separators=(",", ":"))
+    data["plans"][stale_token] = {"variant": "rql", "params": {},
+                                  "ms": 0.2}
+    with open(path, "w") as fh:
+        json.dump(data, fh)
+    plan_cache.clear(memory=True, disk=False)
+    plan_cache._STALE_WARNED.clear()
+    # the valid entry still serves from disk
+    hit = plan_cache.lookup(key)
+    assert hit is not None and hit.variant == "rows"
+    err = capsys.readouterr().err
+    assert err.count("stale-schema") == 1
+    assert "skipped 1" in err
+    # repeat loads do not repeat the warn (once per store per process)
+    plan_cache.clear(memory=True, disk=False)
+    assert plan_cache.lookup(key) is not None
+    assert "stale-schema" not in capsys.readouterr().err
+    # and the CLI store listing survives the stale token
+    from cs87project_msolano2_tpu.cli import main
+
+    monkeypatch.setattr(plans, "current_device_kind",
+                        lambda: "TPU test-kind")
+    assert main(["plan", "show"]) == 0
+    out = capsys.readouterr().out
+    assert "domain=c2c" in out and "n=4096" in out
+
+
+def test_r2c_plan_rides_cached_c2c_winner():
+    """The tentpole contract: a tuned c2c winner at n/2 serves the r2c
+    key at n — same variant and params, no extra race, memoized under
+    its own domain token."""
+    kind = plans.current_device_kind()
+    inner = plans.make_key(2048, device_kind=kind)
+    plan_cache.memoize(Plan(key=inner, variant="rql",
+                            params={"tile": 1 << 16, "cb": None,
+                                    "tail": 256},
+                            source="tuned", ms=0.1))
+    key = plans.make_key(4096, layout="natural", domain="r2c",
+                         device_kind=kind)
+    plan = plans.get_plan(key)
+    assert plan.variant == "rql" and plan.source == "tuned"
+    assert plan.params == {"tile": 1 << 16, "cb": None, "tail": 256}
+    assert plan.ms is None  # the inner timing is not the real path's
+    assert plan_cache.lookup(key) is plan  # memoized under the domain
+
+
+def test_r2c_static_default_and_execute():
+    plan = plans.plan_for((1024,), layout="natural", domain="r2c")
+    assert plan.source == "static"
+    x = real_input(1024, seed=9)
+    yr, yi = plan.execute(x, np.zeros_like(x))
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    assert got.shape == (513,)
+    assert rel_err(got, np.fft.rfft(x.astype(np.float64))) < 1e-5
+
+
+def test_execute_inverse_refused_on_real_domains():
+    plan = plans.plan_for((1024,), layout="natural", domain="r2c")
+    with pytest.raises(ValueError, match="directional"):
+        plan.execute_inverse(np.zeros(513, np.float32),
+                             np.zeros(513, np.float32))
+
+
+def test_r2c_candidates_mirror_half_length_c2c():
+    key = PlanKey(device_kind="TPU test-kind", n=1 << 21, batch=(),
+                  layout="natural", dtype="float32", precision="split3",
+                  domain="r2c")
+    sub = ladder.c2c_subkey(key)
+    assert sub.n == 1 << 20 and sub.domain == "c2c"
+    assert ladder.candidates(key) == ladder.candidates(sub)
+    assert ladder.static_default(key) == ladder.static_default(sub)
+
+
+# ------------------------------------------------------- degradation
+
+
+def test_r2c_degrade_walk_ends_at_numpy_rung(monkeypatch, capsys):
+    """The satellite walk: the kernel path AND the jnp escape rung die
+    of CAPACITY; the chain lands on the numpy rung — which speaks
+    rfft natively — with degraded:true, the right answer, and the
+    skipped rungs recorded."""
+    import jax.numpy as jnp
+
+    n = 1 << 10
+    x = real_input(n, seed=10)
+    ref = np.fft.rfft(x.astype(np.float64))
+
+    def boom(*a, **kw):
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected jnp death")
+
+    with resilience.inject("tube", "capacity"):
+        # the r2c jnp rung speaks rfft natively (docs/REAL.md) — kill
+        # exactly that entry point so the walk must go one rung lower
+        monkeypatch.setattr(jnp.fft, "rfft", boom)
+        plan = plans.get_plan(
+            plans.make_key(n, layout="natural", domain="r2c"))
+        yr, yi = plan.execute(x, np.zeros_like(x))
+    got = np.asarray(yr) + 1j * np.asarray(yi)
+    assert rel_err(got, ref) < 1e-5
+    assert plan.degraded is True
+    assert plan.demotions[-1]["to"] == "numpy-ref"
+    skipped = " ".join(plan.demotions[-1].get("skipped", []))
+    assert "jnp-fft" in skipped
+    assert "DEGRADED" in capsys.readouterr().err
+
+
+def test_c2r_jnp_rung_parity():
+    from cs87project_msolano2_tpu.resilience.degrade import build_rung
+
+    n = 512
+    spec = np.fft.rfft(real_input(n, seed=11).astype(np.float64))
+    key = plans.make_key(n, layout="natural", domain="c2r")
+    yr, _ = build_rung(key, "jnp-fft")(
+        spec.real.astype(np.float32), spec.imag.astype(np.float32))
+    ref = np.fft.irfft(spec, n=n)
+    assert np.max(np.abs(np.asarray(yr) - ref)) < 1e-4
+
+
+# ---------------------------------------------------------- roofline
+
+
+def test_roofline_domain_bytes_halved():
+    from cs87project_msolano2_tpu.utils.roofline import (
+        fft_hbm_bytes,
+        fft_min_hbm_bytes,
+    )
+
+    n = 1 << 20
+    assert fft_min_hbm_bytes(n) == 16 * n
+    assert fft_min_hbm_bytes(n, "r2c") == 8 * n
+    assert fft_min_hbm_bytes(n, "c2r") == 8 * n
+    # the halving holds carry pass for carry pass
+    for p in (0, 1, 2):
+        assert fft_hbm_bytes(n, p, "r2c") * 2 == fft_hbm_bytes(n, p)
+
+
+def test_roofline_meter_charges_half_for_r2c():
+    """The enforced tentpole: the metered pifft_hbm_bytes_total delta
+    for an r2c measurement is EXACTLY half the c2c one at equal n and
+    equal carry passes."""
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.obs import metrics
+    from cs87project_msolano2_tpu.utils.roofline import (
+        roofline_utilization,
+    )
+
+    obs.enable()
+    try:
+        metrics.reset()
+        roofline_utilization(1 << 16, 1.0, "TPU v5e", carry_passes=1)
+        c2c = metrics.counter_value("pifft_hbm_bytes_total")
+        roofline_utilization(1 << 16, 1.0, "TPU v5e", carry_passes=1,
+                             domain="r2c")
+        r2c = metrics.counter_value("pifft_hbm_bytes_total") - c2c
+        assert c2c == 2 * r2c > 0
+        # the utilization figure reads against the halved floor
+        u_c2c = roofline_utilization(1 << 16, 1.0, "TPU v5e")
+        u_r2c = roofline_utilization(1 << 16, 1.0, "TPU v5e",
+                                     domain="r2c")
+        assert u_c2c == pytest.approx(2 * u_r2c)
+    finally:
+        obs.disable()
+
+
+# ------------------------------------------------------------- serve
+
+
+def run_async(coro, timeout_s=120.0):
+    async def bounded():
+        return await asyncio.wait_for(coro, timeout=timeout_s)
+
+    return asyncio.run(bounded())
+
+
+def test_serve_r2c_requests_coalesce_half_width():
+    """The serving acceptance: concurrent r2c requests coalesce into
+    fewer (half-width) kernel invocations, every response carries its
+    own half-spectrum verified against numpy.fft.rfft, and the SLO
+    row is domain-tagged."""
+    from cs87project_msolano2_tpu.serve import Dispatcher, ServeConfig
+
+    n, k = 256, 9
+    inputs = [real_input(n, seed=20 + i) for i in range(k)]
+
+    async def main():
+        cfg = ServeConfig(max_batch=8, max_wait_ms=50.0)
+        async with Dispatcher(cfg) as d:
+            resps = await asyncio.gather(
+                *(d.submit(x, domain="r2c") for x in inputs))
+            return d, resps
+
+    d, resps = run_async(main())
+    label = f"{n}:natural:split3:r2c"
+    row = d.stats.summary()[label]
+    assert row["requests"] == k
+    assert 0 < row["batches"] < k  # coalescing happened
+    for x, resp in zip(inputs, resps):
+        got = np.asarray(resp.yr) + 1j * np.asarray(resp.yi)
+        assert got.shape == (n // 2 + 1,)  # half-width, not padded back
+        assert rel_err(got, np.fft.rfft(x.astype(np.float64))) < 1e-4
+        assert not resp.degraded
+
+
+def test_serve_r2c_validation():
+    from cs87project_msolano2_tpu.serve import Dispatcher, ServeError
+
+    async def main():
+        async with Dispatcher() as d:
+            x = real_input(256, seed=30)
+            # omitted xi is fine for r2c
+            ok = await d.submit(x, domain="r2c")
+            with pytest.raises(ServeError, match="nonzero imaginary"):
+                await d.submit(x, np.ones_like(x), domain="r2c")
+            with pytest.raises(ServeError, match="both planes"):
+                await d.submit(x, None)
+            with pytest.raises(ServeError, match="conj trick|inverse"):
+                await d.submit(x, domain="r2c", inverse=True)
+            with pytest.raises(ServeError, match="domain"):
+                await d.submit(x, np.zeros_like(x), domain="zzz")
+            return ok
+
+    ok = run_async(main())
+    assert np.asarray(ok.yr).shape == (129,)
+
+
+def test_serve_c2r_round_trip():
+    from cs87project_msolano2_tpu.serve import Dispatcher
+
+    n = 256
+    x = real_input(n, seed=31)
+    spec = np.fft.rfft(x.astype(np.float64))
+
+    async def main():
+        async with Dispatcher() as d:
+            return await d.submit(spec.real.astype(np.float32),
+                                  spec.imag.astype(np.float32),
+                                  domain="c2r")
+
+    resp = run_async(main())
+    assert np.asarray(resp.yr).shape == (n,)
+    assert np.max(np.abs(np.asarray(resp.yr) - x)) < 1e-4
+
+
+def test_shape_spec_domain_parsing(tmp_path):
+    from cs87project_msolano2_tpu.serve import ShapeSpec, load_shapes
+
+    p = tmp_path / "shapes.jsonl"
+    p.write_text('{"n": 1024, "domain": "r2c"}\n'
+                 '{"n": 1024}\n')
+    specs = load_shapes(str(p))
+    assert specs[0].domain == "r2c" and specs[1].domain == "c2c"
+    assert specs[0].label() == "1024:natural:split3:r2c"
+    assert specs[0].key().domain == "r2c"
+    assert specs[0] != specs[1]  # domains never alias a warm slot
+    with pytest.raises(ValueError, match="domain"):
+        ShapeSpec(n=512, domain="zzz")
+    with pytest.raises(ValueError, match="natural"):
+        ShapeSpec(n=512, layout="pi", domain="r2c")
+
+
+def test_serve_smoke_with_mixed_domain_shapes(tmp_path, capsys):
+    """The make rfft-smoke serving leg, in-process: an r2c burst spec
+    first (so the coalescing assertion runs on the half-spectrum
+    group) plus c2c mixed traffic — zero schema-invalid events."""
+    from cs87project_msolano2_tpu.serve.cli import serve_main
+
+    p = tmp_path / "mixed.jsonl"
+    p.write_text('{"n": 512, "domain": "r2c"}\n{"n": 512}\n')
+    rc = serve_main(["--smoke", "-k", "6", "--shapes", str(p),
+                     "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["problems"]
+    assert out["ok"] is True
+    assert 0 < out["same_shape_batches"] < out["same_shape_requests"]
+    assert out["schema_invalid_events"] == 0
+
+
+# ---------------------------------------------------- batched/sharded
+
+
+def test_rfft_batched_sharded_parity():
+    from cs87project_msolano2_tpu.parallel.batched import (
+        rfft_batched_sharded,
+    )
+    from cs87project_msolano2_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, axis="data")
+    x = real_input(256, seed=40, batch=(16,))
+    y = np.asarray(rfft_batched_sharded(x, mesh, axis="data"))
+    ref = np.fft.rfft(x.astype(np.float64), axis=-1)
+    assert y.shape == (16, 129)
+    assert rel_err(y, ref) < 1e-5
+
+
+def test_batched_planes_domain_rejects_inverse():
+    from cs87project_msolano2_tpu.parallel.batched import (
+        fft_batched_planes,
+    )
+    from cs87project_msolano2_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, axis="data")
+    x = real_input(256, batch=(8,))
+    with pytest.raises(ValueError, match="c2r"):
+        fft_batched_planes(x, np.zeros_like(x), mesh, axis="data",
+                           inverse=True, domain="r2c")
+
+
+# ------------------------------------------------------------ analyze
+
+
+def test_loader_backfills_domain(tmp_path):
+    """Records without a domain parse as c2c (the committed
+    BENCH_r01-r06 trajectory keeps working); rfft2^K rows tag r2c
+    with the same n."""
+    from cs87project_msolano2_tpu.analyze.loader import (
+        bench_samples,
+        load_bench_round,
+    )
+
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps({
+        "n": 99, "rc": 0,
+        "parsed": {"metric": "g", "value": 1.0, "unit": "GFLOP/s",
+                   "n2^13_gflops": 2.0, "rfft2^13_gflops": 1.2,
+                   "smoke": True}}))
+    rnd = load_bench_round(str(p))
+    by_metric = {s.metric: s for s in bench_samples(rnd)}
+    assert by_metric["n2^13_gflops"].domain == "c2c"
+    assert by_metric["rfft2^13_gflops"].domain == "r2c"
+    assert by_metric["rfft2^13_gflops"].n == 1 << 13
+    assert by_metric["g"].domain == "c2c"
+    # the committed pre-domain trajectory still parses
+    committed = load_bench_round("BENCH_r01.json")
+    assert committed.metrics
+    assert all(s.domain == "c2c" for s in bench_samples(committed))
+
+
+# ------------------------------------------------------------- PIF110
+
+
+def test_pif110_flags_full_fft_on_provably_real_input():
+    from cs87project_msolano2_tpu import check
+
+    code = """
+import numpy as np
+import jax.numpy as jnp
+
+def hot(x, rng):
+    a = jnp.fft.fft(jnp.real(x))
+    b = np.fft.fft(x.real)
+    c = jnp.fft.fft(x.astype(jnp.float32))
+    d = jnp.fft.fft(rng.standard_normal(64))
+    xr = np.real(x)
+    e = np.fft.fft(xr)
+    return a, b, c, d, e
+"""
+    found = check.check_source("/repo/serve/hot.py", code,
+                               rules=["PIF110"])
+    assert len(found) == 5
+    assert all(f.rule == "PIF110" for f in found)
+
+
+def test_pif110_negative_and_scope_and_noqa():
+    from cs87project_msolano2_tpu import check
+
+    code = """
+import numpy as np
+import jax.numpy as jnp
+
+def paths(x, xr):
+    ok1 = jnp.fft.fft(x)                 # not provably real
+    ok2 = jnp.fft.rfft(jnp.real(x))      # already half-spectrum
+    ok3 = np.fft.fft(xr.astype(np.complex128) + 1j)  # complex promo
+    bad = jnp.fft.fft(jnp.real(x))  # pifft: noqa[PIF110]
+    return ok1, ok2, ok3, bad
+"""
+    assert check.check_source("/repo/parallel/p.py", code,
+                              rules=["PIF110"]) == []
+    flagged = "def f(x):\n    import jax.numpy as jnp\n" \
+              "    return jnp.fft.fft(jnp.real(x))\n"
+    # include-scoped: the same pattern outside serve/parallel passes
+    assert check.check_source("/repo/models/m.py", flagged,
+                              rules=["PIF110"]) == []
+    assert check.check_source("/repo/tests/t.py", flagged,
+                              rules=["PIF110"]) == []
+    assert len(check.check_source("/repo/serve/s.py", flagged,
+                                  rules=["PIF110"])) == 1
+
+
+# ----------------------------------------------------------- cli/bench
+
+
+def test_cli_plan_warm_domain_validation(capsys):
+    from cs87project_msolano2_tpu.cli import main
+
+    # pi layout + r2c is a key-validation error, reported not raised
+    assert main(["plan", "warm", "-n", "2^10", "--domain", "r2c"]) == 2
+    assert "natural" in capsys.readouterr().err
+    # valid combo still refuses offline tuning (exit 2, like c2c)
+    assert main(["plan", "warm", "-n", "2^10", "--layout", "natural",
+                 "--domain", "r2c"]) == 2
+    assert "offline" in capsys.readouterr().err
+
+
+def test_bench_rfft_row_smoke():
+    """The bench rfft cell end to end (offline smoke sizes): the row
+    reports ms/gflops/plan/domain and numpy parity."""
+    import bench
+
+    row = bench.measure_rfft_row(10, smoke=True)
+    assert row["rfft2^10_ms"] > 0
+    assert row["rfft2^10_domain"] == "r2c"
+    assert row["rfft2^10_parity_relerr"] < 1e-5
+    assert row["rfft2^10_plan"]["variant"]
