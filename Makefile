@@ -7,7 +7,8 @@
 SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
-        bench-chaos serve-smoke serve-slo rfft-smoke multichip-smoke \
+        bench-chaos serve-smoke serve-slo rfft-smoke precision-smoke \
+        multichip-smoke \
         replicate run-experiments run-experiments-and-analyze-results \
         analyze analyze-datasets analyze-smoke check lint
 
@@ -179,6 +180,51 @@ rfft-smoke:
 	  > /tmp/pifft-rfft-shapes.jsonl && \
 	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
 	  serve --smoke --shapes /tmp/pifft-rfft-shapes.jsonl
+
+# the CI mixed-precision check (docs/PRECISION.md): (1) numerical
+# parity within each mode's committed error budget at 2^10..2^14 vs
+# the float64 reference; (2) the bench smoke with the obs meter armed
+# — the METERED pifft_hbm_bytes_total delta of the bf16-storage cell
+# must be EXACTLY half the fp32-storage (split3) cell's at equal n,
+# with the bf16 parity error inside its budget (the bytes-halving is
+# enforced from the meter AND never bought with a blown contract);
+# (3) an INJECTED budget violation (PIFFT_PRECISION_BUDGET=0) must
+# walk the serve plan UP the degrade chain to fp32 with degraded:true
+# tagged on the plan and the serve response; (4) a serve smoke over a
+# mixed-precision shape file (bf16 + split3 groups coalesce
+# separately, responses verified within each mode's budget)
+precision-smoke:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 -c "import numpy as np; \
+	from cs87project_msolano2_tpu import plans; \
+	from cs87project_msolano2_tpu.ops.precision import error_budget, rel_err; \
+	rng = np.random.default_rng(0); \
+	errs = {}; \
+	[errs.__setitem__((m, n), rel_err(*(lambda yr, yi: (np.asarray(yr), np.asarray(yi)))(*plans.plan(n, layout='natural', precision=m).execute(xr, xi)), np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128)).real, np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128)).imag)) \
+	 for m in ('split3', 'highest', 'default', 'fp32', 'bf16') \
+	 for n in (1 << 10, 1 << 12, 1 << 14) \
+	 for xr in [rng.standard_normal(n).astype(np.float32)] \
+	 for xi in [rng.standard_normal(n).astype(np.float32)]]; \
+	bad = {k: (e, error_budget(k[0])) for k, e in errs.items() if e > error_budget(k[0])}; \
+	assert not bad, bad; \
+	print('# precision parity ok: ' + ', '.join('%s@%d %.1e<=%.0e' % (m, n, e, error_budget(m)) for (m, n), e in sorted(errs.items())))" && \
+	PIFFT_PLAN_CACHE=off python3 bench.py --smoke \
+	  --events /tmp/pifft-precision-events.jsonl \
+	  | tee /tmp/pifft-precision-smoke.json && \
+	python3 -c "import json; \
+	from cs87project_msolano2_tpu.ops.precision import error_budget; \
+	r = json.load(open('/tmp/pifft-precision-smoke.json')); \
+	bf16 = r['bf16_2^13_hbm_bytes']; fp32 = r['n2^13_hbm_bytes']; \
+	assert bf16 * 2 == fp32, (bf16, fp32); \
+	assert r['bf16_2^13_parity_relerr'] <= error_budget('bf16'), r; \
+	assert r['bf16_2^13_precision'] == 'bf16', r; \
+	print('# precision bytes-halved ok: metered bf16 %d B == fp32 %d B / 2 at n=2^13 (parity %.1e)' % (bf16, fp32, r['bf16_2^13_parity_relerr']))" && \
+	PIFFT_PLAN_CACHE=off PIFFT_PRECISION_BUDGET=0 \
+	  python3 -m cs87project_msolano2_tpu.serve.precision_smoke && \
+	printf '{"n": 1024, "precision": "bf16"}\n{"n": 1024}\n{"n": 2048, "precision": "bf16"}\n' \
+	  > /tmp/pifft-precision-shapes.jsonl && \
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  serve --smoke --shapes /tmp/pifft-precision-shapes.jsonl
 
 # the CI multichip check (docs/MULTICHIP.md): the four sharding
 # dryruns on a forced 8-device CPU host platform (incl. the asserted

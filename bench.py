@@ -303,6 +303,52 @@ def measure_rfft_ms(n: int, smoke: bool = False) -> tuple:
     return _retry(run, label=f"rfft measured_ms n={n}")
 
 
+def _row_fields(tag: str, nn: int, ms: float, plan,
+                domain: str = "c2c", flops_per: float = 5.0) -> dict:
+    """The row-measurement scaffolding every reach-row kind shares
+    (c2c, rfft, precision-mode): ms, GFLOP/s on the given flop count,
+    the plan description, the degraded flag, the carry-pass-aware
+    ceiling of the variant that actually SERVED (a demoted row is
+    judged by its rung's carries, not the dead winner's), and the
+    METERED domain-/dtype-aware roofline figures — the bytes charged
+    come from the plan's own storage width (Plan.storage_bytes), so a
+    bf16 cell meters half and an escape-rung demotion meters fp32."""
+    from cs87project_msolano2_tpu.utils.roofline import (
+        plan_carry_passes,
+        roofline_ceiling,
+        roofline_utilization,
+    )
+
+    out = {f"{tag}_ms": round(ms, 4),
+           f"{tag}_gflops": round(
+               flops_per * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1),
+           f"{tag}_plan": plan.describe()}
+    if plan.degraded:
+        out[f"{tag}_degraded"] = True
+    served = plan.demotions[-1]["to"] if plan.degraded else plan.variant
+    passes = plan_carry_passes(served)
+    ceil = roofline_ceiling(passes)
+    if ceil is not None:
+        out[f"{tag}_carry_passes"] = passes
+        out[f"{tag}_roofline_ceiling"] = round(ceil, 3)
+    util, hbm_bytes = _metered_hbm_delta(
+        lambda: roofline_utilization(nn, ms, plan.key.device_kind,
+                                     passes or 0, domain=domain,
+                                     storage_bytes=plan.storage_bytes()))
+    if hbm_bytes:
+        # the METERED plan-declared traffic this cell charged — the
+        # raw material of the rfft-smoke and precision-smoke
+        # bytes-halved assertions
+        out[f"{tag}_hbm_bytes"] = hbm_bytes
+    if util is not None:
+        out[f"{tag}_roofline_util"] = round(util, 3)
+        if ceil:
+            # the acceptance figure: how close the path runs to ITS
+            # own carry-pass-aware cap (target >= 0.8 per row)
+            out[f"{tag}_util_of_ceiling"] = round(util / ceil, 3)
+    return out
+
+
 def measure_rfft_row(logn: int, smoke: bool = False) -> dict:
     """One half-spectrum reach row, side by side with the c2c row at
     the same n: GFLOP/s on the standard real-input count
@@ -314,13 +360,7 @@ def measure_rfft_row(logn: int, smoke: bool = False) -> dict:
     the ladder; this keeps the CI gate self-contained)."""
     from cs87project_msolano2_tpu import plans
     from cs87project_msolano2_tpu.resilience import classify
-    from cs87project_msolano2_tpu.utils.roofline import (
-        plan_carry_passes,
-        roofline_ceiling,
-        roofline_utilization,
-    )
 
-    out = {}
     nn = 1 << logn
     tag = f"rfft2^{logn}"
     try:
@@ -329,29 +369,9 @@ def measure_rfft_row(logn: int, smoke: bool = False) -> dict:
         plans.warn(f"rfft 2^{logn} not measured "
                    f"({classify(e).value} {type(e).__name__}: "
                    f"{str(e)[:200]})")
-        return out
-    out[f"{tag}_ms"] = round(ms, 4)
-    out[f"{tag}_gflops"] = round(
-        2.5 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
-    out[f"{tag}_plan"] = plan.describe()
+        return {}
+    out = _row_fields(tag, nn, ms, plan, domain="r2c", flops_per=2.5)
     out[f"{tag}_domain"] = "r2c"
-    if plan.degraded:
-        out[f"{tag}_degraded"] = True
-    served = plan.demotions[-1]["to"] if plan.degraded else plan.variant
-    passes = plan_carry_passes(served)
-    ceil = roofline_ceiling(passes)
-    if ceil is not None:
-        out[f"{tag}_carry_passes"] = passes
-        out[f"{tag}_roofline_ceiling"] = round(ceil, 3)
-    util, hbm_bytes = _metered_hbm_delta(
-        lambda: roofline_utilization(nn, ms, plan.key.device_kind,
-                                     passes or 0, domain="r2c"))
-    if hbm_bytes:
-        out[f"{tag}_hbm_bytes"] = hbm_bytes
-    if util is not None:
-        out[f"{tag}_roofline_util"] = round(util, 3)
-        if ceil:
-            out[f"{tag}_util_of_ceiling"] = round(util / ceil, 3)
     if smoke:
         from cs87project_msolano2_tpu.models.real import rfft
 
@@ -361,6 +381,82 @@ def measure_rfft_row(logn: int, smoke: bool = False) -> dict:
         err = float(np.max(np.abs(np.asarray(rfft(x)) - ref))
                     / np.max(np.abs(ref)))
         out[f"{tag}_parity_relerr"] = err
+    return out
+
+
+def measure_precision_ms(n: int, mode: str, smoke: bool = False) -> tuple:
+    """(ms, plan) for an n-point pi-layout key at precision `mode`
+    (docs/PRECISION.md) — the flagship measurement path with the
+    precision axis pinned, so a bf16-storage cell rides the same
+    tuning/cache/degradation machinery as its fp32 sibling."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import maybe_fault
+
+    key = plans.make_key(n, layout="pi", precision=mode)
+    if smoke:
+        import jax
+        import jax.numpy as jnp
+
+        plan = plans.get_plan(key)
+        k0 = jax.random.PRNGKey(7)
+        xr = jax.random.normal(k0, (n,), jnp.float32)
+        xi = jax.random.normal(jax.random.fold_in(k0, 1), (n,),
+                               jnp.float32)
+
+        def run_smoke():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(plan.fn, xr, xi)
+
+        return _retry(run_smoke, smoke=True,
+                      label=f"{mode} smoke n={n}"), plan
+
+    def run():
+        maybe_fault("bench")  # resilience injection site
+        return plans.measured_ms(key)
+
+    return _retry(run, label=f"{mode} measured_ms n={n}")
+
+
+def measure_precision_row(logn: int, mode: str = "bf16",
+                          smoke: bool = False) -> dict:
+    """One precision-mode row beside the split3 c2c row at the same n
+    (docs/PRECISION.md): GFLOP/s on the standard count, the
+    dtype-aware roofline utilization (bf16 storage floors at
+    8 B/element — half of fp32), and the METERED HBM-bytes delta the
+    `make precision-smoke` gate asserts is exactly half the fp32
+    cell's at equal n.  Smoke rows additionally record the parity
+    error vs numpy, which the gate asserts within the MODE's budget —
+    the bytes-halving must never be bought with a blown contract."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import classify
+
+    nn = 1 << logn
+    tag = f"{mode}_2^{logn}"
+    try:
+        ms, plan = measure_precision_ms(nn, mode, smoke=smoke)
+    except Exception as e:
+        plans.warn(f"{mode} 2^{logn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return {}
+    out = _row_fields(tag, nn, ms, plan)
+    out[f"{tag}_precision"] = plan.effective_precision()
+    if smoke:
+        from cs87project_msolano2_tpu.ops.precision import rel_err
+        from cs87project_msolano2_tpu.utils.verify import (
+            pi_layout_to_natural,
+        )
+
+        rng = np.random.default_rng(8)
+        xr = rng.standard_normal(nn).astype(np.float32)
+        xi = rng.standard_normal(nn).astype(np.float32)
+        yr, yi = plan.execute(xr, xi)
+        got = pi_layout_to_natural(np.asarray(yr)
+                                   + 1j * np.asarray(yi))
+        ref = np.fft.fft(xr.astype(np.complex128)
+                         + 1j * xi.astype(np.complex128))
+        out[f"{tag}_parity_relerr"] = rel_err(got.real, got.imag,
+                                              ref.real, ref.imag)
     return out
 
 
@@ -376,13 +472,7 @@ def measure_large_n_row(logn: int, smoke: bool = False) -> dict:
     plan demoted mid-measurement is tagged ``<tag>_degraded``."""
     from cs87project_msolano2_tpu import plans
     from cs87project_msolano2_tpu.resilience import classify
-    from cs87project_msolano2_tpu.utils.roofline import (
-        plan_carry_passes,
-        roofline_ceiling,
-        roofline_utilization,
-    )
 
-    out = {}
     nn = 1 << logn
     tag = f"n2^{logn}"
     try:
@@ -391,35 +481,8 @@ def measure_large_n_row(logn: int, smoke: bool = False) -> dict:
         plans.warn(f"large-n 2^{logn} not measured "
                    f"({classify(e).value} {type(e).__name__}: "
                    f"{str(e)[:200]})")
-        return out
-    out[f"{tag}_ms"] = round(ms, 4)
-    out[f"{tag}_gflops"] = round(
-        5.0 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1)
-    out[f"{tag}_plan"] = plan.describe()
-    if plan.degraded:
-        out[f"{tag}_degraded"] = True
-    # the roofline ceiling is a property of the variant that actually
-    # SERVED the measurement (a demoted row is judged by its rung's
-    # carry passes, not the dead winner's)
-    served = plan.demotions[-1]["to"] if plan.degraded else plan.variant
-    passes = plan_carry_passes(served)
-    ceil = roofline_ceiling(passes)
-    if ceil is not None:
-        out[f"{tag}_carry_passes"] = passes
-        out[f"{tag}_roofline_ceiling"] = round(ceil, 3)
-    util, hbm_bytes = _metered_hbm_delta(
-        lambda: roofline_utilization(nn, ms, plan.key.device_kind,
-                                     passes or 0))
-    if hbm_bytes:
-        # the METERED plan-declared traffic this cell charged — the
-        # c2c half of the rfft-smoke bytes-halved assertion
-        out[f"{tag}_hbm_bytes"] = hbm_bytes
-    if util is not None:
-        out[f"{tag}_roofline_util"] = round(util, 3)
-        if ceil:
-            # the acceptance figure: how close the path runs to ITS
-            # own carry-pass-aware cap (target >= 0.8 per row)
-            out[f"{tag}_util_of_ceiling"] = round(util / ceil, 3)
+        return {}
+    out = _row_fields(tag, nn, ms, plan)
     try:
         xla_ms = measure_xla_fft_ms(nn, smoke=smoke)
     except Exception as e:
@@ -731,6 +794,16 @@ def main(argv=None) -> int:
                     probe_n=1 << logn)
         degraded_rows |= bool(rrow.get(f"rfft2^{logn}_degraded"))
         large.update(rrow)
+        # the bf16-storage row at the SAME n, beside its fp32-storage
+        # siblings: GFLOP/s + dtype-aware roofline side by side, and
+        # the metered HBM-bytes delta the precision-smoke gate asserts
+        # is exactly half the split3 cell's (docs/PRECISION.md)
+        prow = cell(f"bf16_2^{logn}",
+                    lambda logn=logn: measure_precision_row(
+                        logn, "bf16", smoke=args.smoke),
+                    probe_n=1 << logn)
+        degraded_rows |= bool(prow.get(f"bf16_2^{logn}_degraded"))
+        large.update(prow)
     if args.smoke:
         # the interpret-safe sixstep cell (docs/KERNELS.md): rides only
         # in smoke mode — on hardware the 2^25..2^27 rows above exercise

@@ -96,7 +96,12 @@ class Sample:
     (docs/REAL.md): half-spectrum rows ("rfft2^K_*" bench metrics)
     carry "r2c"; every record that predates the domain field —
     including the committed BENCH_r01-r06 trajectory — backfills the
-    "c2c" default, so old artifacts keep parsing unchanged."""
+    "c2c" default, so old artifacts keep parsing unchanged.
+    ``precision`` tags the plan precision mode the same way
+    (docs/PRECISION.md): precision-mode rows ("bf16_2^K_*" metrics)
+    carry their mode, and every record that predates the precision
+    axis — the whole committed r01-r06 trajectory — backfills
+    "split3", the mode those rounds actually ran."""
 
     source: str               # "tsv" | "bench" | "obs"
     metric: str               # "total_ms", "funnel_ms", "n2^24_gflops", ...
@@ -108,6 +113,7 @@ class Sample:
     fingerprint: Optional[Fingerprint] = None
     degraded: bool = False
     domain: str = "c2c"
+    precision: str = "split3"
 
 
 @dataclasses.dataclass
@@ -293,30 +299,43 @@ def load_bench_rounds(paths) -> list:
 
 _LOGN_METRIC = re.compile(r"^n2\^(\d+)_")
 _RFFT_METRIC = re.compile(r"^rfft2\^(\d+)_")
+#: precision-mode row prefixes (docs/PRECISION.md): bench emits one
+#: row set per raced storage mode beside the split3 cells — the mode
+#: rides the metric name exactly as the domain does for rfft rows
+_PRECISION_METRIC = re.compile(
+    r"^(bf16|fp32|highest|default)_2\^(\d+)_")
 
 
 def bench_samples(rnd: BenchRound) -> list:
     """A round's metrics as flat samples (n parsed from the ``n2^K_``
     row prefix where one exists; ``rfft2^K_`` rows parse the same n
-    and tag ``domain="r2c"`` — everything else, including every
-    pre-domain committed round, backfills "c2c"; replicated metrics
-    flatten with rep indices)."""
+    and tag ``domain="r2c"``; ``bf16_2^K_`` (and any other
+    precision-mode prefix) rows parse the same n and tag their
+    ``precision`` — everything else, including every pre-domain /
+    pre-precision committed round (BENCH_r01-r06), backfills "c2c" /
+    "split3"; replicated metrics flatten with rep indices)."""
     out = []
     for name, val in rnd.metrics.items():
         domain = "c2c"
+        precision = "split3"
         m = _LOGN_METRIC.match(name)
         if m is None:
             m = _RFFT_METRIC.match(name)
             if m is not None:
                 domain = "r2c"
         n = (1 << int(m.group(1))) if m else None
+        if m is None:
+            pm = _PRECISION_METRIC.match(name)
+            if pm is not None:
+                precision = pm.group(1)
+                n = 1 << int(pm.group(2))
         values = val if isinstance(val, list) else [val]
         for rep, v in enumerate(values):
             out.append(Sample(
                 source="bench", metric=name, value=v, n=n,
                 rep=rep if isinstance(val, list) else None,
                 round_index=rnd.index, fingerprint=rnd.fingerprint,
-                domain=domain))
+                domain=domain, precision=precision))
     return out
 
 

@@ -763,6 +763,79 @@ class FullSpectrumFftOnRealInput(Rule):
         return name.split(".")[-1] in config["float_dtypes"]
 
 
+@register
+class HardCodedDtypeCast(Rule):
+    id = "PIF111"
+    name = "hard-coded-dtype-cast"
+    summary = ("hard-coded device dtype cast (astype(jnp.float32) / "
+               "astype(jnp.bfloat16) literals) in ops/ and plans/ hot "
+               "paths outside the sanctioned precision-resolution site "
+               "(ops/precision.py)")
+    invariant = ("precision is a TUNED plan axis with an error-budget "
+                 "contract (docs/PRECISION.md): the storage dtype of "
+                 "every plane and twiddle table is resolved from the "
+                 "plan's precision mode at ONE site, ops/precision.py "
+                 "— a hard-coded jnp dtype cast in an ops/ or plans/ "
+                 "hot path is exactly how a bf16-storage plan quietly "
+                 "widens back to fp32 traffic (forfeiting the metered "
+                 "bytes-halving the precision-smoke gate enforces) or "
+                 "a split3 plan quietly loses the error compensation "
+                 "its budget assumes.  Host-side numpy table "
+                 "construction (np.float32) is outside the rule: it "
+                 "runs at trace time, not in the kernels' data path")
+    default_config = {
+        # an INCLUDE list like PIF107/108/109/110's: the storage
+        # discipline binds the kernel and plan layers, where casts
+        # become HBM traffic
+        "paths": ("*/ops/*", "*/plans/*"),
+        # the one sanctioned resolution site (as_compute/as_storage/
+        # make_dot live there)
+        "exempt": ("*ops/precision.py",),
+        # device dtype literals (canonical post-import-map names) —
+        # numpy host dtypes are deliberately absent
+        "dtypes": ("jax.numpy.float32", "jax.numpy.bfloat16",
+                   "jax.numpy.float16", "jax.numpy.float64"),
+        # string-literal spellings of the same casts
+        "dtype_strings": ("float32", "bfloat16", "float16", "float64"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+        import os
+
+        norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+        if not any(fnmatch.fnmatch(norm, pat)
+                   for pat in config["paths"]):
+            return
+        dtypes = set(config["dtypes"])
+        strings = set(config["dtype_strings"])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"):
+                continue
+            label = self._dtype_label(ctx, node.args[0], dtypes, strings)
+            if label:
+                yield self.finding(
+                    ctx, node,
+                    f"hard-coded dtype cast `.astype({label})` in an "
+                    f"ops/plans hot path — resolve storage through "
+                    f"ops.precision (as_compute / as_storage / "
+                    f"storage_dtype), the sanctioned precision-"
+                    f"resolution site, or justify with "
+                    f"# pifft: noqa[PIF111]")
+
+    def _dtype_label(self, ctx, arg, dtypes, strings) -> Optional[str]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return repr(arg.value) if arg.value in strings else None
+        name = dotted_name(arg)
+        if name is None:
+            return None
+        target = ctx.imports.resolve(name)
+        return name if target in dtypes else None
+
+
 def _is_broad_handler(type_node, broad) -> bool:
     """Shared broad-handler predicate (PIF105 and PIF501)."""
     if type_node is None:

@@ -147,9 +147,15 @@ def plan_main(argv) -> int:
                     help="leading batch dims for warm (default: none)")
     ap.add_argument("--layout", choices=("natural", "pi"), default="pi",
                     help="output order the plan is tuned for")
-    ap.add_argument("--precision",
-                    choices=("split3", "highest", "default", "fp32"),
-                    default=None)
+    from .ops.precision import PRECISIONS
+
+    ap.add_argument("--precision", choices=PRECISIONS, default=None,
+                    help="precision mode to tune for — a TUNED plan "
+                         "axis (docs/PRECISION.md): 'bf16' races the "
+                         "bytes-halving bfloat16-storage variants "
+                         "(fp32 accumulate) against their fp32-storage "
+                         "siblings; 'fp32' is the full-precision "
+                         "kernel path")
     ap.add_argument("--domain", choices=("c2c", "r2c", "c2r"),
                     default="c2c",
                     help="warm: transform domain — the half-spectrum "
@@ -183,12 +189,25 @@ def plan_main(argv) -> int:
                   "defaults until warmed)")
             return 0
         print(f"store:        {path} ({len(entries)} plan(s))")
+        from .ops.precision import error_budget, storage_dtype
+
         for token, rec in sorted(entries.items()):
             key = plans.PlanKey.from_token(token)
             ms = rec.get("ms")
+            # precision-aware listing (docs/PRECISION.md): the served
+            # mode may differ from the key's when the race pinned a
+            # tighter-storage sibling — show what actually won, its
+            # storage dtype, and the budget the key contracts
+            served = (rec.get("params") or {}).get("precision") \
+                or key.precision
+            prec = key.precision
+            if served != key.precision:
+                prec = f"{key.precision}->{served}"
             print(f"  n={key.n} domain={key.domain} batch={key.batch} "
-                  f"{key.layout} {key.precision}: {rec['variant']} "
-                  f"{rec['params']}"
+                  f"{key.layout} {prec} "
+                  f"[{storage_dtype(served)}, budget "
+                  f"{error_budget(key.precision):.0e}]: "
+                  f"{rec['variant']} {rec['params']}"
                   + (f" ({ms:.4f} ms)" if ms is not None else ""))
         return 0
 

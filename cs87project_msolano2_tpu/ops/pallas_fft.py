@@ -34,19 +34,38 @@ from ..resilience.inject import maybe_fault
 from ..utils.compat import pvary_all, shape_struct, vma_of
 from .bits import bit_reverse_indices, ilog2
 from .butterfly import stage_full
+from .precision import SPLIT3  # noqa: F401  (re-export: the sentinel
+#   moved to ops.precision — the sanctioned precision-resolution site —
+#   with make_dot; existing callers keep importing it from here)
+from .precision import as_compute as _f32
+from .precision import as_storage, jnp_dtype
+from .precision import make_dot as _make_dot
 from .twiddle import twiddle_tables
 
 LANE = 128
 
+#: the default storage dtype name — every kernel stores fp32 planes
+#: unless the plan's precision mode narrows it (ops.precision,
+#: docs/PRECISION.md)
+DEFAULT_STORAGE = "float32"
 
-def _out_struct(shape, like):
+
+def _storage(storage):
+    """Normalized storage dtype name (None -> fp32) and its jnp dtype."""
+    name = storage or DEFAULT_STORAGE
+    return name, jnp_dtype(name)
+
+
+def _out_struct(shape, like, dtype=None):
     """ShapeDtypeStruct for a pallas_call output, carrying the varying-
     across-mesh-axes set of the input operand: under shard_map with
     check_vma=True (the default) pallas outputs must declare their vma,
     and ours always matches the data operand's (the kernel is pointwise
     in the sharded batch dimension).  On JAX versions without vma
-    tracking this degrades to a plain struct (utils.compat)."""
-    return shape_struct(shape, jnp.float32, vma_of(like))
+    tracking this degrades to a plain struct (utils.compat).  `dtype`
+    overrides the float32 default for narrow-STORAGE outputs
+    (ops.precision: bf16 planes in HBM, fp32 accumulate in-kernel)."""
+    return shape_struct(shape, dtype or jnp.float32, vma_of(like))
 
 
 def _pvary_like(arrs, like):
@@ -64,39 +83,10 @@ DEFAULT_TILE = 1 << 15
 # emulation) costs ~100 us of the tile pass — the single largest term in
 # the whole transform — while DEFAULT (1-pass bf16, rel err ~4e-3) fails
 # the 1e-5 bound.  split3 decomposes each operand into bf16 hi + lo
-# residual planes and keeps the three significant cross products
-# (x_hi B_hi + x_hi B_lo + x_lo B_hi, f32 accumulation); the dropped
-# x_lo B_lo term is ~2^-18 relative — comfortably inside 1e-5 — at half
-# HIGHEST's MXU passes.  (Precision.HIGH, XLA's own 3-pass mode, raises
-# NotImplementedError in the Mosaic lowering; this is its manual twin.)
-SPLIT3 = "split3"
-
-
-def _make_dot(precision):
-    """Row-major (m,k)@(k,n) on the MXU under the given precision mode;
-    `precision` is a jax.lax.Precision or the SPLIT3 sentinel."""
-    if precision == SPLIT3:
-        raw = partial(
-            jax.lax.dot_general,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.DEFAULT,
-            preferred_element_type=jnp.float32,
-        )
-
-        def dot(x, b):
-            xh = x.astype(jnp.bfloat16)
-            xl = (x - xh.astype(jnp.float32)).astype(jnp.bfloat16)
-            bh = b.astype(jnp.bfloat16)
-            bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
-            return raw(xh, bh) + raw(xh, bl) + raw(xl, bh)
-
-        return dot
-    return partial(
-        jax.lax.dot_general,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        precision=precision,
-        preferred_element_type=jnp.float32,
-    )
+# residual planes and keeps the three significant cross products with
+# f32 accumulation (see ops.precision.make_dot — the sanctioned
+# precision-resolution site now owns the SPLIT3 sentinel and the dot
+# builder; this module re-exports SPLIT3 for its existing callers).
 
 
 @lru_cache(maxsize=8)
@@ -186,7 +176,14 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
     Shared by every tile-kernel body (the row-blocked tile_fft_grid and
     the row-gridded _tile_fft_rows).  Batch-agnostic: `rows` may span any
     whole number of tiles — every stage reshape carries a leading -1 that
-    absorbs the extra tiles.  Returns (yr, yi) shaped (rows, LANE)."""
+    absorbs the extra tiles.  Returns (yr, yi) shaped (rows, LANE),
+    ALWAYS float32: storage may be bf16 (ops.precision — blocks and
+    tables arrive narrow), but every stage and the MXU tail accumulate
+    in fp32, so the upcast happens here, once, at load."""
+    xr = _f32(xr)
+    xi = _f32(xi)
+    btr = _f32(btr)
+    bti = _f32(bti)
     rows = xr.shape[0]
 
     def cmul(ar, ai, wr, wi):
@@ -202,7 +199,7 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
             # half with level-(l+1) twiddles, then (i, i+1) with
             # level-(l+2) twiddles — table slices per slab position.
             w1r_t, w1i_t, w2r_t, w2i_t, w3r_t, w3i_t = (
-                t[:, :] for t in tw[ti_ : ti_ + 6]
+                _f32(t[:, :]) for t in tw[ti_ : ti_ + 6]
             )
             ti_ += 6
             q = qrows
@@ -233,7 +230,7 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
             xi = jnp.stack([t[1] for t in nxt], axis=1).reshape(rows, LANE)
         elif kind == "r4":
             w1r, w1i, w2r, w2i, w3r, w3i = (
-                t[:, :] for t in tw[ti_ : ti_ + 6]
+                _f32(t[:, :]) for t in tw[ti_ : ti_ + 6]
             )
             ti_ += 6
             xq = xr.reshape(-1, 4, qrows, LANE)
@@ -253,8 +250,8 @@ def _tile_fft_compute(xr, xi, steps, tw, btr, bti, precision):
             xr = jnp.stack((y0r, y1r, y2r, y3r), axis=1).reshape(rows, LANE)
             xi = jnp.stack((y0i, y1i, y2i, y3i), axis=1).reshape(rows, LANE)
         else:
-            wr = tw[ti_][:, :]
-            wi = tw[ti_ + 1][:, :]
+            wr = _f32(tw[ti_][:, :])
+            wi = _f32(tw[ti_ + 1][:, :])
             ti_ += 2
             xr4 = xr.reshape(-1, 2, qrows, LANE)
             xi4 = xi.reshape(-1, 2, qrows, LANE)
@@ -325,8 +322,10 @@ def _tile_fft_kernel(steps, precision, *refs):
     yr, yi = _tile_fft_compute(
         xr, xi, steps, tw, btr_ref[:, :], bti_ref[:, :], precision
     )
-    or_ref[...] = yr.reshape(or_ref.shape)
-    oi_ref[...] = yi.reshape(oi_ref.shape)
+    # write back at the refs' STORAGE dtype (fp32, or bf16 when the
+    # plan's precision mode narrows storage — a no-op cast otherwise)
+    or_ref[...] = yr.reshape(or_ref.shape).astype(or_ref.dtype)
+    oi_ref[...] = yi.reshape(oi_ref.shape).astype(oi_ref.dtype)
 
 
 def _use_interpret() -> bool:
@@ -379,7 +378,8 @@ def rows_plan_feasible(nrows: int, n: int) -> bool:
 
 def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
                   precision=None, tail: int = LANE,
-                  block_tiles: int | None = None):
+                  block_tiles: int | None = None,
+                  storage: str | None = None):
     """Grid the tile kernel over rows: (R, tile//128*...)  Input planes
     shaped (total_rows, 128) with total_rows % (tile/128) == 0; each
     consecutive group of tile/128 rows is one independent tile-point DIF.
@@ -404,6 +404,12 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
     dividing tile) picks the dense-matmul tail size — see
     dif_tail_matrix_t.  256 is the measured sweet spot at n=2^20;
     512 tips the MXU out of hiding.
+
+    `storage` ("float32" default / "bfloat16") is the PLANE AND TABLE
+    storage dtype (ops.precision, docs/PRECISION.md): bf16 storage
+    halves the HBM bytes every block pipeline moves while the kernel
+    body upcasts at load and accumulates in fp32; the returned planes
+    are always float32 (the executor contract).
     """
     from jax.experimental import pallas as pl
 
@@ -411,6 +417,9 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
         interpret = _use_interpret()
     if precision is None:
         precision = SPLIT3
+    storage, st_dt = _storage(storage)
+    xr2d = as_storage(xr2d, storage)
+    xi2d = as_storage(xi2d, storage)
     _check_tail(tail, tile)
 
     trows = tile // LANE
@@ -446,9 +455,10 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
             f"covering the whole array ({total_rows} rows)")
 
     steps, np_tables = _tile_plan(tile, tail)
-    tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr2d)
+    tables = _pvary_like([jnp.asarray(t, st_dt) for t in np_tables],
+                         xr2d)
     btr, bti = _pvary_like(
-        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], xr2d)
+        [jnp.asarray(b, st_dt) for b in dif_tail_matrix_t(tail)], xr2d)
 
     in_specs = [pl.BlockSpec((brows, LANE), lambda i: (i, 0))] * 2
     in_specs += [
@@ -462,12 +472,12 @@ def tile_fft_grid(xr2d, xi2d, tile: int, interpret: bool | None = None,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((brows, LANE), lambda i: (i, 0))] * 2,
         out_shape=[
-            _out_struct((total_rows, LANE), xr2d),
-            _out_struct((total_rows, LANE), xi2d),
+            _out_struct((total_rows, LANE), xr2d, st_dt),
+            _out_struct((total_rows, LANE), xi2d, st_dt),
         ],
         interpret=interpret,
     )(xr2d, xi2d, *tables, btr, bti)
-    return out[0], out[1]
+    return _f32(out[0]), _f32(out[1])
 
 
 def _long_range_kernel(levels: int, *refs):
@@ -485,13 +495,13 @@ def _long_range_kernel(levels: int, *refs):
     tw = refs[2 : 2 + 2 * levels]
     or_ref, oi_ref = refs[2 + 2 * levels], refs[3 + 2 * levels]
 
-    xr = xr_ref[:, :]
-    xi = xi_ref[:, :]
+    xr = _f32(xr_ref[:, :])
+    xi = _f32(xi_ref[:, :])
     rows, cb = xr.shape
     for l in range(levels):
         half = rows >> (l + 1)
-        wr = tw[2 * l][:, :]
-        wi = tw[2 * l + 1][:, :]
+        wr = _f32(tw[2 * l][:, :])
+        wi = _f32(tw[2 * l + 1][:, :])
         xr4 = xr.reshape(-1, 2, half, cb)
         xi4 = xi.reshape(-1, 2, half, cb)
         ar, br = xr4[:, 0], xr4[:, 1]
@@ -502,8 +512,8 @@ def _long_range_kernel(levels: int, *refs):
         ui = dr * wi + di * wr
         xr = jnp.stack((tr, ur), axis=1).reshape(rows, cb)
         xi = jnp.stack((ti, ui), axis=1).reshape(rows, cb)
-    or_ref[:, :] = xr
-    oi_ref[:, :] = xi
+    or_ref[:, :] = xr.astype(or_ref.dtype)
+    oi_ref[:, :] = xi.astype(oi_ref.dtype)
 
 
 def _long_range_kernel_sep(levels: int, R: int, *refs):
@@ -524,18 +534,18 @@ def _long_range_kernel_sep(levels: int, R: int, *refs):
     ar_ref, ai_ref, br_ref, bi_ref = refs[2:6]
     or_ref, oi_ref = refs[6], refs[7]
 
-    xr = xr_ref[...]
-    xi = xi_ref[...]
+    xr = _f32(xr_ref[...])
+    xi = _f32(xi_ref[...])
     rows = xr.shape[0]
     rest = xr.shape[1:]  # (cb,) or (qb, LANE)
     ones = (1,) * len(rest)
     for l in range(levels):
         half = rows >> (l + 1)
         o = R - (R >> l)  # row offset of level l's A entries
-        a_r = ar_ref[...][o : o + half].reshape(half, *ones)
-        a_i = ai_ref[...][o : o + half].reshape(half, *ones)
-        b_r = br_ref[...][l : l + 1]  # (1, *rest)
-        b_i = bi_ref[...][l : l + 1]
+        a_r = _f32(ar_ref[...])[o : o + half].reshape(half, *ones)
+        a_i = _f32(ai_ref[...])[o : o + half].reshape(half, *ones)
+        b_r = _f32(br_ref[...])[l : l + 1]  # (1, *rest)
+        b_i = _f32(bi_ref[...])[l : l + 1]
         wr = a_r * b_r - a_i * b_i  # (half, *rest) outer product
         wi = a_r * b_i + a_i * b_r
         xr4 = xr.reshape(-1, 2, half, *rest)
@@ -548,8 +558,8 @@ def _long_range_kernel_sep(levels: int, R: int, *refs):
         ui = dr * wi + di * wr
         xr = jnp.stack((tr, ur), axis=1).reshape(rows, *rest)
         xi = jnp.stack((ti, ui), axis=1).reshape(rows, *rest)
-    or_ref[...] = xr
-    oi_ref[...] = xi
+    or_ref[...] = xr.astype(or_ref.dtype)
+    oi_ref[...] = xi.astype(oi_ref.dtype)
 
 
 @lru_cache(maxsize=16)
@@ -590,16 +600,23 @@ def long_range_vmem_bytes(R: int, cb: int, separable: bool = False) -> int:
 
 
 def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
-                    separable: bool = False):
+                    separable: bool = False,
+                    storage: str | None = None):
     """First log2(R) DIF stages of an (R, C)-viewed transform as one
     Pallas pass gridded over column blocks of width `cb`.  Dense twiddle
     tables by default (faster on v5e — the pass is VPU-bound);
     separable=True reconstructs twiddles in-kernel from factored A/B
-    tables (fewer HBM reads, more VPU work)."""
+    tables (fewer HBM reads, more VPU work).  `storage` narrows the
+    plane/table storage dtype (ops.precision); the output planes stay
+    at the storage dtype — the composed two-kernel paths hand them
+    straight to the tile kernel."""
     from jax.experimental import pallas as pl
 
     if interpret is None:
         interpret = _use_interpret()
+    storage, st_dt = _storage(storage)
+    xr2d = as_storage(xr2d, storage)
+    xi2d = as_storage(xi2d, storage)
 
     R, C = xr2d.shape
     levels = ilog2(R)
@@ -624,7 +641,8 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
     in_specs = [pl.BlockSpec((R, cb), lambda i: (0, i))] * 2
     if separable:
         ar, ai, br, bi = _pvary_like(
-            [jnp.asarray(t) for t in _long_range_factors(R, C)], xr2d)
+            [jnp.asarray(t, st_dt) for t in _long_range_factors(R, C)],
+            xr2d)
         in_specs += [pl.BlockSpec((R - 1, 1), lambda i: (0, 0))] * 2
         in_specs += [pl.BlockSpec((levels, cb), lambda i: (0, i))] * 2
         kernel = partial(_long_range_kernel_sep, levels, R)
@@ -632,7 +650,8 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
     else:
         n = R * C
         tables = []
-        for l, (wr, wi) in enumerate(twiddle_tables(n)[:levels]):
+        for l, (wr, wi) in enumerate(
+                twiddle_tables(n, dtype=storage)[:levels]):
             half = R >> (l + 1)
             tables.append(jnp.asarray(wr.reshape(half, C)))
             tables.append(jnp.asarray(wi.reshape(half, C)))
@@ -648,8 +667,8 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((R, cb), lambda i: (0, i))] * 2,
         out_shape=[
-            _out_struct((R, C), xr2d),
-            _out_struct((R, C), xi2d),
+            _out_struct((R, C), xr2d, st_dt),
+            _out_struct((R, C), xi2d, st_dt),
         ],
         interpret=interpret,
     )(xr2d, xi2d, *operands)
@@ -659,10 +678,12 @@ def long_range_grid(xr2d, xi2d, cb: int | None = None, interpret=None,
 def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
                           cb: int | None = None, interpret=None,
                           precision=None, separable: bool = False,
-                          tail: int = LANE):
+                          tail: int = LANE, storage: str | None = None):
     """Two-kernel whole-FFT: long-range stages as a column-grid kernel,
     tile-local FFTs as the row-grid kernel — exactly two HBM round trips,
-    no XLA elementwise passes in between."""
+    no XLA elementwise passes in between.  With bf16 `storage` the
+    inter-kernel intermediate is bf16 too, so both trips move half the
+    fp32 bytes (ops.precision)."""
     maybe_fault("tube")  # resilience injection site (docs/RESILIENCE.md)
     n = xr.shape[-1]
     tile = _choose_tile(n, tile)
@@ -676,27 +697,32 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
     if R > 1:
         xr2, xi2 = long_range_grid(
             xr.reshape(R, tile), xi.reshape(R, tile), cb, interpret,
-            separable,
+            separable, storage,
         )
         xr, xi = xr2.reshape(n), xi2.reshape(n)
     yr, yi = tile_fft_grid(  # pifft: noqa[PIF104] (the documented two-trip fallback path: kept as the tuner's always-lowerable baseline — fourstep/fused are the single-pass designs)
         xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret,
-        precision, tail,
+        precision, tail, storage=storage,
     )
     return yr.reshape(n), yi.reshape(n)
 
 
-def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret):
+def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret,
+                   storage: str | None = None):
     """Row-gridded tile kernel on the shared (R, Q, LANE) layout: each of
     the R grid programs finishes one tile-point DIF (shared by the rql
-    and matmul-funnel whole-FFT paths)."""
+    and matmul-funnel whole-FFT paths).  Output planes stay at the
+    storage dtype; the entry points upcast once at their boundary."""
     from jax.experimental import pallas as pl
 
+    storage, st_dt = _storage(storage)
+    x3r = as_storage(x3r, storage)
+    x3i = as_storage(x3i, storage)
     R, Q, _ = x3r.shape
     steps, np_tables = _tile_plan(tile, tail)
-    tables = _pvary_like([jnp.asarray(t) for t in np_tables], x3r)
+    tables = _pvary_like([jnp.asarray(t, st_dt) for t in np_tables], x3r)
     btr, bti = _pvary_like(
-        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], x3r)
+        [jnp.asarray(b, st_dt) for b in dif_tail_matrix_t(tail)], x3r)
     in_specs = [pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2
     in_specs += [pl.BlockSpec(t.shape, lambda j: (0, 0)) for t in tables]
     in_specs += [pl.BlockSpec((tail, tail), lambda j: (0, 0))] * 2
@@ -706,8 +732,8 @@ def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret):
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, Q, LANE), lambda j: (j, 0, 0))] * 2,
         out_shape=[
-            _out_struct((R, Q, LANE), x3r),
-            _out_struct((R, Q, LANE), x3i),
+            _out_struct((R, Q, LANE), x3r, st_dt),
+            _out_struct((R, Q, LANE), x3i, st_dt),
         ],
         interpret=interpret,
     )(x3r, x3i, *tables, btr, bti)
@@ -715,7 +741,8 @@ def _tile_fft_rows(x3r, x3i, tile: int, tail, precision, interpret):
 
 def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
                              cb: int | None = None, interpret=None,
-                             precision=None, tail: int = LANE):
+                             precision=None, tail: int = LANE,
+                             storage: str | None = None):
     """Two-kernel whole-FFT on a shared 3-D (R, Q, LANE) layout.
 
     fft_pi_layout_pallas2 reshapes (R, C) -> (R*C/128, 128) between the
@@ -763,6 +790,9 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
             f"(R*cb must be <= {1 << 18}); {hint}"
         )
     _check_tail(tail, tile)  # before any kernel runs
+    storage, st_dt = _storage(storage)
+    xr = as_storage(xr, storage)
+    xi = as_storage(xi, storage)
     Q = tile // LANE
     qb = cb // LANE
     x3r = xr.reshape(R, Q, LANE)
@@ -771,7 +801,8 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     if R > 1:
         levels = ilog2(R)
         ar, ai, br, bi = _pvary_like(
-            [jnp.asarray(t) for t in _long_range_factors(R, tile)], xr)
+            [jnp.asarray(t, st_dt)
+             for t in _long_range_factors(R, tile)], xr)
         b3r = br.reshape(levels, Q, LANE)
         b3i = bi.reshape(levels, Q, LANE)
         a3r = ar.reshape(R - 1, 1, 1)
@@ -785,8 +816,8 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
             in_specs=in_specs,
             out_specs=[pl.BlockSpec((R, qb, LANE), lambda i: (0, i, 0))] * 2,
             out_shape=[
-                _out_struct((R, Q, LANE), x3r),
-                _out_struct((R, Q, LANE), x3i),
+                _out_struct((R, Q, LANE), x3r, st_dt),
+                _out_struct((R, Q, LANE), x3i, st_dt),
             ],
             interpret=interpret,
         )(x3r, x3i, a3r, a3i, b3r, b3i)
@@ -794,8 +825,8 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
     if precision is None:
         precision = SPLIT3
     yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104] (two-trip by design: the retiling-free ladder fallback where fused/fourstep reject; its intermediate round trip is what the fourstep pipeline removes)
-        x3r, x3i, tile, tail, precision, interpret)
-    return yr.reshape(n), yi.reshape(n)
+        x3r, x3i, tile, tail, precision, interpret, storage)
+    return _f32(yr).reshape(n), _f32(yi).reshape(n)
 
 
 def _fused_fft_kernel(levels, R, QB, qb, steps, precision, *refs):
@@ -829,16 +860,16 @@ def _fused_fft_kernel(levels, R, QB, qb, steps, precision, *refs):
 
     @pl.when(i < QB)
     def _phase_a():
-        xr = xr_ref[...]
-        xi = xi_ref[...]
+        xr = _f32(xr_ref[...])
+        xi = _f32(xi_ref[...])
         rest = xr.shape[1:]
         for l in range(levels):
             half = R >> (l + 1)
             o = R - (R >> l)
-            a_r = ar_ref[...][o:o + half].reshape(half, 1, 1)
-            a_i = ai_ref[...][o:o + half].reshape(half, 1, 1)
-            b_r = br_ref[...][l:l + 1]
-            b_i = bi_ref[...][l:l + 1]
+            a_r = _f32(ar_ref[...])[o:o + half].reshape(half, 1, 1)
+            a_i = _f32(ai_ref[...])[o:o + half].reshape(half, 1, 1)
+            b_r = _f32(br_ref[...])[l:l + 1]
+            b_i = _f32(bi_ref[...])[l:l + 1]
             wr = a_r * b_r - a_i * b_i
             wi = a_r * b_i + a_i * b_r
             xr4 = xr.reshape(-1, 2, half, *rest)
@@ -851,8 +882,10 @@ def _fused_fft_kernel(levels, R, QB, qb, steps, precision, *refs):
             ui = dr * wi + di * wr
             xr = jnp.stack((tr, ur), axis=1).reshape(R, *rest)
             xi = jnp.stack((ti, ui), axis=1).reshape(R, *rest)
-        sr_ref[:, pl.dslice(i * qb, qb), :] = xr
-        si_ref[:, pl.dslice(i * qb, qb), :] = xi
+        # the scratch carry is held at the STORAGE dtype (bf16 halves
+        # its VMEM footprint and the phase-B reads); compute stays f32
+        sr_ref[:, pl.dslice(i * qb, qb), :] = xr.astype(sr_ref.dtype)
+        si_ref[:, pl.dslice(i * qb, qb), :] = xi.astype(si_ref.dtype)
 
     @pl.when(i >= QB)
     def _phase_b():
@@ -862,14 +895,15 @@ def _fused_fft_kernel(levels, R, QB, qb, steps, precision, *refs):
         yr, yi = _tile_fft_compute(
             zr, zi, steps, tw, btr_ref[:, :], bti_ref[:, :], precision
         )
-        or_ref[...] = yr.reshape(or_ref.shape)
-        oi_ref[...] = yi.reshape(oi_ref.shape)
+        or_ref[...] = yr.reshape(or_ref.shape).astype(or_ref.dtype)
+        oi_ref[...] = yi.reshape(oi_ref.shape).astype(oi_ref.dtype)
 
 
 def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
                                qb: int = 32, interpret=None,
                                precision=None, tail: int = 256,
-                               alias_io: bool = False):
+                               alias_io: bool = False,
+                               storage: str | None = None):
     """Whole-FFT in ONE pallas_call with a VMEM-resident scratch carry
     (see _fused_fft_kernel).  Feasible while the n-point re+im scratch
     fits VMEM next to the tile temps: n <= 2^20 (8 MB scratch).  At
@@ -893,8 +927,12 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
     if R < 2:
         # no long-range phase: the plain tile grid IS single-pass
         yr, yi = tile_fft_grid(xr.reshape(-1, LANE), xi.reshape(-1, LANE),
-                               tile, interpret, precision, tail)
+                               tile, interpret, precision, tail,
+                               storage=storage)
         return yr.reshape(n), yi.reshape(n)
+    storage, st_dt = _storage(storage)
+    xr = as_storage(xr, storage)
+    xi = as_storage(xi, storage)
     Q = tile // LANE
     if Q % qb:
         raise ValueError(f"qb={qb} must divide Q={Q}")
@@ -902,9 +940,10 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
     levels = ilog2(R)
 
     steps, np_tables = _tile_plan(tile, tail)
-    tables = [jnp.asarray(t) for t in np_tables]
-    btr, bti = (jnp.asarray(b) for b in dif_tail_matrix_t(tail))
-    ar, ai, br, bi = (jnp.asarray(t) for t in _long_range_factors(R, tile))
+    tables = [jnp.asarray(t, st_dt) for t in np_tables]
+    btr, bti = (jnp.asarray(b, st_dt) for b in dif_tail_matrix_t(tail))
+    ar, ai, br, bi = (jnp.asarray(t, st_dt)
+                      for t in _long_range_factors(R, tile))
     b3r = br.reshape(levels, Q, LANE)
     b3i = bi.reshape(levels, Q, LANE)
     a3r = ar.reshape(R - 1, 1, 1)
@@ -930,10 +969,10 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, Q, LANE), out_row)] * 2,
         out_shape=[
-            _out_struct((R, Q, LANE), xr),
-            _out_struct((R, Q, LANE), xi),
+            _out_struct((R, Q, LANE), xr, st_dt),
+            _out_struct((R, Q, LANE), xi, st_dt),
         ],
-        scratch_shapes=[pltpu.VMEM((R, Q, LANE), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((R, Q, LANE), st_dt)] * 2,
         # alias_io folds the x planes onto the outputs: phase A consumes
         # the inputs, phase B writes the outputs — never the same grid
         # step — and the saved double-buffered block pair moves the
@@ -952,7 +991,7 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x3r, x3i, a3r, a3i, b3r, b3i, *tables, btr, bti)
-    return out[0].reshape(n), out[1].reshape(n)
+    return _f32(out[0]).reshape(n), _f32(out[1]).reshape(n)
 
 
 def _lr_stages(xr, xi, levels, R, tw_for):
@@ -961,7 +1000,11 @@ def _lr_stages(xr, xi, levels, R, tw_for):
     phases A and B1).  `tw_for(l, half)` returns the level-l bottom-half
     twiddle planes broadcastable against (half, *rest): the separable
     closures rebuild them from factored A/B refs, the dense closures
-    slice per-level table blocks."""
+    slice per-level table blocks.  Planes upcast to the f32 COMPUTE
+    dtype here (storage may be bf16 — ops.precision); the caller
+    downcasts at its staging/output write."""
+    xr = _f32(xr)
+    xi = _f32(xi)
     rest = xr.shape[1:]
     for l in range(levels):
         half = R >> (l + 1)
@@ -987,10 +1030,12 @@ def _sep_tw_for(R, ar_ref, ai_ref, br_ref, bi_ref, nrest):
 
     def tw_for(l, half):
         o = R - (R >> l)
-        a_r = ar_ref[...][o:o + half].reshape(half, *ones)
-        a_i = ai_ref[...][o:o + half].reshape(half, *ones)
-        b_r = br_ref[...][l:l + 1].reshape(1, *br_ref.shape[-nrest:])
-        b_i = bi_ref[...][l:l + 1].reshape(1, *bi_ref.shape[-nrest:])
+        a_r = _f32(ar_ref[...])[o:o + half].reshape(half, *ones)
+        a_i = _f32(ai_ref[...])[o:o + half].reshape(half, *ones)
+        b_r = _f32(br_ref[...])[l:l + 1].reshape(
+            1, *br_ref.shape[-nrest:])
+        b_i = _f32(bi_ref[...])[l:l + 1].reshape(
+            1, *bi_ref.shape[-nrest:])
         wr = a_r * b_r - a_i * b_i
         wi = a_r * b_i + a_i * b_r
         return wr, wi
@@ -1071,7 +1116,8 @@ def _fourstep_kernel(levels, R, QB, qb, steps, precision, separable, *refs):
             tw_for = _sep_tw_for(R, ar_ref, ai_ref, br_ref, bi_ref, 2)
         else:
             def tw_for(l, half):
-                return lr_tw[2 * l][...], lr_tw[2 * l + 1][...]
+                return (_f32(lr_tw[2 * l][...]),
+                        _f32(lr_tw[2 * l + 1][...]))
         xr, xi = _lr_stages(xr_ref[...], xi_ref[...], levels, R, tw_for)
 
         s = i % 2
@@ -1083,8 +1129,11 @@ def _fourstep_kernel(levels, R, QB, qb, steps, precision, separable, *refs):
             for plane in (0, 1):
                 write_dma(s, i - 2, plane).wait()
 
-        str_ref[s] = xr
-        sti_ref[s] = xi
+        # staging (and the HBM carry it DMAs to) holds the STORAGE
+        # dtype — with bf16 storage every carry round trip moves half
+        # the fp32 bytes, which is what the roofline meter charges
+        str_ref[s] = xr.astype(str_ref.dtype)
+        sti_ref[s] = xi.astype(sti_ref.dtype)
         for plane in (0, 1):
             write_dma(s, i, plane).start()
 
@@ -1117,8 +1166,8 @@ def _fourstep_kernel(levels, R, QB, qb, steps, precision, separable, *refs):
             rr_ref[s], ri_ref[s], steps, tw,
             btr_ref[:, :], bti_ref[:, :], precision,
         )
-        or_ref[...] = yr.reshape(or_ref.shape)
-        oi_ref[...] = yi.reshape(oi_ref.shape)
+        or_ref[...] = yr.reshape(or_ref.shape).astype(or_ref.dtype)
+        oi_ref[...] = yi.reshape(oi_ref.shape).astype(oi_ref.dtype)
 
 
 def fourstep_vmem_bytes(R: int, cb: int, tile: int, tail: int = 256,
@@ -1179,7 +1228,8 @@ def fourstep_auto_cb(n: int, tile: int, tail: int = 256,
 def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
                                   cb: int | None = None, tail: int = 256,
                                   precision=None, separable: bool = True,
-                                  interpret=None):
+                                  interpret=None,
+                                  storage: str | None = None):
     """Whole-FFT in ONE pallas_call at any n: the four-step pipeline with
     an HBM carry and manual double-buffered DMA (see _fourstep_kernel).
 
@@ -1208,8 +1258,11 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
         # no long-range phase: the plain tile grid IS single-pass
         yr, yi = tile_fft_grid(
             xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret,
-            precision, tail)
+            precision, tail, storage=storage)
         return yr.reshape(n), yi.reshape(n)
+    storage, st_dt = _storage(storage)
+    xr = as_storage(xr, storage)
+    xi = as_storage(xi, storage)
     Q = tile // LANE
     levels = ilog2(R)
     if cb is None:
@@ -1234,9 +1287,9 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
     QB = Q // qb
 
     steps, np_tables = _tile_plan(tile, tail)
-    tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr)
+    tables = _pvary_like([jnp.asarray(t, st_dt) for t in np_tables], xr)
     btr, bti = _pvary_like(
-        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], xr)
+        [jnp.asarray(b, st_dt) for b in dif_tail_matrix_t(tail)], xr)
     x3r = xr.reshape(R, Q, LANE)
     x3i = xi.reshape(R, Q, LANE)
 
@@ -1246,7 +1299,8 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
     in_specs = [pl.BlockSpec((R, qb, LANE), in_col)] * 2
     if separable:
         ar, ai, br, bi = _pvary_like(
-            [jnp.asarray(t) for t in _long_range_factors(R, tile)], xr)
+            [jnp.asarray(t, st_dt)
+             for t in _long_range_factors(R, tile)], xr)
         operands = [ar.reshape(R - 1, 1, 1), ai.reshape(R - 1, 1, 1),
                     br.reshape(levels, Q, LANE),
                     bi.reshape(levels, Q, LANE)]
@@ -1254,7 +1308,8 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
         in_specs += [pl.BlockSpec((levels, qb, LANE), in_col)] * 2
     else:
         lr = []
-        for l, (wr, wi) in enumerate(twiddle_tables(n)[:levels]):
+        for l, (wr, wi) in enumerate(
+                twiddle_tables(n, dtype=storage)[:levels]):
             half = R >> (l + 1)
             lr.append(jnp.asarray(wr.reshape(half, Q, LANE)))
             lr.append(jnp.asarray(wi.reshape(half, Q, LANE)))
@@ -1274,16 +1329,16 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, Q, LANE), out_row)] * 2,
         out_shape=[
-            _out_struct((R, Q, LANE), xr),
-            _out_struct((R, Q, LANE), xi),
+            _out_struct((R, Q, LANE), xr, st_dt),
+            _out_struct((R, Q, LANE), xi, st_dt),
         ],
         scratch_shapes=[
-            pltpu.ANY((R, Q, LANE), jnp.float32),   # HBM carry (re, im)
-            pltpu.ANY((R, Q, LANE), jnp.float32),
-            pltpu.VMEM((2, R, qb, LANE), jnp.float32),  # write staging
-            pltpu.VMEM((2, R, qb, LANE), jnp.float32),
-            pltpu.VMEM((2, Q, LANE), jnp.float32),      # row read slots
-            pltpu.VMEM((2, Q, LANE), jnp.float32),
+            pltpu.ANY((R, Q, LANE), st_dt),   # HBM carry (re, im) — at
+            pltpu.ANY((R, Q, LANE), st_dt),   # the storage dtype
+            pltpu.VMEM((2, R, qb, LANE), st_dt),  # write staging
+            pltpu.VMEM((2, R, qb, LANE), st_dt),
+            pltpu.VMEM((2, Q, LANE), st_dt),      # row read slots
+            pltpu.VMEM((2, Q, LANE), st_dt),
             pltpu.SemaphoreType.DMA((2, 2)),            # [slot, plane]
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
@@ -1293,7 +1348,7 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x3r, x3i, *operands, *tables, btr, bti)
-    return out[0].reshape(n), out[1].reshape(n)
+    return _f32(out[0]).reshape(n), _f32(out[1]).reshape(n)
 
 
 def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
@@ -1415,8 +1470,9 @@ def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
             tw_for = _sep_tw_for(R1, a1r, a1i, b1r, b1i, 2)
         else:
             def tw_for(l, half):
-                return (lrA[2 * l][...].reshape(half, qb1, LANE),
-                        lrA[2 * l + 1][...].reshape(half, qb1, LANE))
+                return (_f32(lrA[2 * l][...]).reshape(half, qb1, LANE),
+                        _f32(lrA[2 * l + 1][...]).reshape(half, qb1,
+                                                          LANE))
         xr = xr_ref[...].reshape(R1, qb1, LANE)
         xi = xi_ref[...].reshape(R1, qb1, LANE)
         xr, xi = _lr_stages(xr, xi, levels1, R1, tw_for)
@@ -1430,8 +1486,10 @@ def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
             for plane in (0, 1):
                 a_write_dma(s, i - 2, plane).wait()
 
-        sAr[s] = xr
-        sAi[s] = xi
+        # staging (and both HBM carries) hold the STORAGE dtype —
+        # bf16 storage halves BOTH carry passes' traffic
+        sAr[s] = xr.astype(sAr.dtype)
+        sAi[s] = xi.astype(sAi.dtype)
         for plane in (0, 1):
             a_write_dma(s, i, plane).start()
 
@@ -1461,7 +1519,7 @@ def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
             tw_for = _sep_tw_for(R2, a2r, a2i, b2r, b2i, 2)
         else:
             def tw_for(l, half):
-                return lrB[2 * l][...], lrB[2 * l + 1][...]
+                return _f32(lrB[2 * l][...]), _f32(lrB[2 * l + 1][...])
         zr, zi = _lr_stages(r1r[s], r1i[s], levels2, R2, tw_for)
 
         @pl.when(sub >= 2)
@@ -1471,8 +1529,8 @@ def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
             for plane in (0, 1):
                 b1_write_dma(s, j, sub - 2, plane).wait()
 
-        s1r[s] = zr
-        s1i[s] = zi
+        s1r[s] = zr.astype(s1r.dtype)
+        s1i[s] = zi.astype(s1i.dtype)
         for plane in (0, 1):
             b1_write_dma(s, j, sub, plane).start()
 
@@ -1513,8 +1571,8 @@ def _sixstep_kernel(levels1, levels2, R1, R2, NQ1, QB2, qb1, qb2, steps,
             r2r[s], r2i[s], steps, tw,
             btr_ref[:, :], bti_ref[:, :], precision,
         )
-        or_ref[...] = yr.reshape(or_ref.shape)
-        oi_ref[...] = yi.reshape(oi_ref.shape)
+        or_ref[...] = yr.reshape(or_ref.shape).astype(or_ref.dtype)
+        oi_ref[...] = yi.reshape(oi_ref.shape).astype(oi_ref.dtype)
 
 
 def sixstep_vmem_bytes(R1: int, cb1: int, R2: int, cb2: int, tile: int,
@@ -1608,7 +1666,8 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
                                  cb1: int | None = None,
                                  cb2: int | None = None, tail: int = 256,
                                  precision=None, separable: bool = True,
-                                 interpret=None):
+                                 interpret=None,
+                                 storage: str | None = None):
     """Whole-FFT in ONE pallas_call at any HBM-resident n: the
     hierarchical six-step (recursive four-step) pipeline with a
     RECURSIVE HBM carry (see _sixstep_kernel).
@@ -1687,10 +1746,13 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
     QB2 = Q // qb2
     P = QB2 + R2
 
+    storage, st_dt = _storage(storage)
+    xr = as_storage(xr, storage)
+    xi = as_storage(xi, storage)
     steps, np_tables = _tile_plan(tile, tail)
-    tables = _pvary_like([jnp.asarray(t) for t in np_tables], xr)
+    tables = _pvary_like([jnp.asarray(t, st_dt) for t in np_tables], xr)
     btr, bti = _pvary_like(
-        [jnp.asarray(b) for b in dif_tail_matrix_t(tail)], xr)
+        [jnp.asarray(b, st_dt) for b in dif_tail_matrix_t(tail)], xr)
     x4r = xr.reshape(R1, R2, Q, LANE)
     x4i = xi.reshape(R1, R2, Q, LANE)
 
@@ -1706,7 +1768,8 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
     operands = []
     if separable:
         a1, a1i_, b1, b1i_ = _pvary_like(
-            [jnp.asarray(t) for t in _long_range_factors(R1, m)], xr)
+            [jnp.asarray(t, st_dt)
+             for t in _long_range_factors(R1, m)], xr)
         operands += [a1.reshape(R1 - 1, 1, 1), a1i_.reshape(R1 - 1, 1, 1),
                      b1.reshape(levels1, R2, Q, LANE),
                      b1i_.reshape(levels1, R2, Q, LANE)]
@@ -1714,7 +1777,8 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
         in_specs += [pl.BlockSpec((levels1, 1, qb1, LANE), in_a)] * 2
     else:
         lr = []
-        for l, (wr, wi) in enumerate(twiddle_tables(n)[:levels1]):
+        for l, (wr, wi) in enumerate(
+                twiddle_tables(n, dtype=storage)[:levels1]):
             half = R1 >> (l + 1)
             lr.append(jnp.asarray(wr.reshape(half, R2, Q, LANE)))
             lr.append(jnp.asarray(wi.reshape(half, R2, Q, LANE)))
@@ -1723,7 +1787,8 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
                      for t in operands[-2 * levels1:]]
     if separable:
         a2, a2i_, b2, b2i_ = _pvary_like(
-            [jnp.asarray(t) for t in _long_range_factors(R2, tile)], xr)
+            [jnp.asarray(t, st_dt)
+             for t in _long_range_factors(R2, tile)], xr)
         operands += [a2.reshape(R2 - 1, 1, 1), a2i_.reshape(R2 - 1, 1, 1),
                      b2.reshape(levels2, Q, LANE),
                      b2i_.reshape(levels2, Q, LANE)]
@@ -1731,7 +1796,8 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
         in_specs += [pl.BlockSpec((levels2, qb2, LANE), in_b1fac)] * 2
     else:
         lr = []
-        for l, (wr, wi) in enumerate(twiddle_tables(m)[:levels2]):
+        for l, (wr, wi) in enumerate(
+                twiddle_tables(m, dtype=storage)[:levels2]):
             half = R2 >> (l + 1)
             lr.append(jnp.asarray(wr.reshape(half, Q, LANE)))
             lr.append(jnp.asarray(wi.reshape(half, Q, LANE)))
@@ -1754,20 +1820,20 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
             in_specs=in_specs,
             out_specs=[pl.BlockSpec((1, 1, Q, LANE), out_row)] * 2,
             out_shape=[
-                _out_struct((R1, R2, Q, LANE), xr),
-                _out_struct((R1, R2, Q, LANE), xi),
+                _out_struct((R1, R2, Q, LANE), xr, st_dt),
+                _out_struct((R1, R2, Q, LANE), xi, st_dt),
             ],
             scratch_shapes=[
-                pltpu.ANY((R1, R2, Q, LANE), jnp.float32),  # carry (re)
-                pltpu.ANY((R1, R2, Q, LANE), jnp.float32),  # carry (im)
-                pltpu.VMEM((2, R1, qb1, LANE), jnp.float32),  # A staging
-                pltpu.VMEM((2, R1, qb1, LANE), jnp.float32),
-                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),  # B1 read
-                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),
-                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),  # B1 staging
-                pltpu.VMEM((2, R2, qb2, LANE), jnp.float32),
-                pltpu.VMEM((2, Q, LANE), jnp.float32),        # B2 rows
-                pltpu.VMEM((2, Q, LANE), jnp.float32),
+                pltpu.ANY((R1, R2, Q, LANE), st_dt),  # carry (re)
+                pltpu.ANY((R1, R2, Q, LANE), st_dt),  # carry (im)
+                pltpu.VMEM((2, R1, qb1, LANE), st_dt),  # A staging
+                pltpu.VMEM((2, R1, qb1, LANE), st_dt),
+                pltpu.VMEM((2, R2, qb2, LANE), st_dt),  # B1 read
+                pltpu.VMEM((2, R2, qb2, LANE), st_dt),
+                pltpu.VMEM((2, R2, qb2, LANE), st_dt),  # B1 staging
+                pltpu.VMEM((2, R2, qb2, LANE), st_dt),
+                pltpu.VMEM((2, Q, LANE), st_dt),        # B2 rows
+                pltpu.VMEM((2, Q, LANE), st_dt),
                 pltpu.SemaphoreType.DMA((2, 2)),  # A write [slot, plane]
                 pltpu.SemaphoreType.DMA((2, 2)),  # B1 read
                 pltpu.SemaphoreType.DMA((2, 2)),  # B1 write
@@ -1779,7 +1845,7 @@ def fft_pi_layout_pallas_sixstep(xr, xi, tile: int | None = None,
                 dimension_semantics=("arbitrary",)),
             interpret=interpret,
         )(x4r, x4i, *operands, *tables, btr, bti)
-    return out[0].reshape(n), out[1].reshape(n)
+    return _f32(out[0]).reshape(n), _f32(out[1]).reshape(n)
 
 
 @lru_cache(maxsize=8)
@@ -2003,7 +2069,8 @@ MAX_ROW_TILE = 1 << 16
 
 def fft_rows_pallas(xr, xi, interpret: bool | None = None, precision=None,
                     tail: int | None = None, natural: bool = True,
-                    block_tiles: int | None = None):
+                    block_tiles: int | None = None,
+                    storage: str | None = None):
     """Natural-order FFT of every length-n row of (..., n) float planes.
 
     The batched analogue of the flagship 1-D path (VERDICT r4 item 2:
@@ -2037,7 +2104,7 @@ def fft_rows_pallas(xr, xi, interpret: bool | None = None, precision=None,
     yr, yi = tile_fft_grid(
         xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile=n,
         interpret=interpret, precision=precision, tail=tail,
-        block_tiles=block_tiles,
+        block_tiles=block_tiles, storage=storage,
     )
     yr = yr.reshape(*lead, n)
     yi = yi.reshape(*lead, n)

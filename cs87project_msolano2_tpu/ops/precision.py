@@ -1,0 +1,289 @@
+"""THE sanctioned precision-resolution site (docs/PRECISION.md).
+
+Every dtype decision the kernel family makes — what the planes and
+twiddle tables are STORED as in VMEM/HBM, what the MXU tail
+ACCUMULATES in, and how much error each combination is allowed — is
+declared here and nowhere else.  The check rule PIF111 enforces that:
+a hard-coded ``astype(jnp.float32)`` / ``astype(jnp.bfloat16)`` in an
+``ops/`` or ``plans/`` hot path outside this module is a finding,
+because a stray cast is exactly how a "bf16-storage" plan quietly
+widens back to fp32 traffic (or a "split3" plan quietly loses the
+error compensation it promised).
+
+The storage-vs-accumulate matrix (one row per PlanKey precision mode):
+
+    mode       storage    accumulate              rel-err budget
+    ---------  ---------  ----------------------  --------------
+    bf16       bfloat16   fp32 (in-kernel upcast,   3e-2
+                          1-pass bf16 MXU tail)
+    default    float32    fp32 (1-pass bf16 tail)   1e-2
+    split3     float32    fp32 (3-pass bf16 error   1e-5
+                          split — see make_dot)
+    highest    float32    fp32 (XLA 6-pass          5e-6
+                          emulation)
+    fp32       float32    fp32 (6-pass emulation    5e-6
+                          — the full-precision
+                          kernel path)
+
+``bf16`` is the bytes-halving mode (ROADMAP item 3): planes and
+twiddle tables live in bfloat16 in VMEM/HBM — HALF the HBM traffic of
+every fp32-storage mode at equal n, which is the whole win on a
+memory-bound kernel family — while every butterfly stage and the MXU
+tail accumulate in float32, so the error is storage quantization, not
+arithmetic.  The budget column is a CONTRACT: the max relative error
+(L2, vs the float64 reference) each mode may show, asserted in tests
+and ``make precision-smoke``, sampled per served batch as the
+``pifft_precision_rel_err`` gauge, and enforced at serve time by the
+degrade chain's quality rung — a mode over its budget is promoted UP
+in precision (resilience.degrade.promote_precision), never silently
+served.
+
+Modes form a loosest-to-tightest promotion chain (PROMOTE_CHAIN); a
+tuning race for a loose-budget key may also race tighter-storage
+candidates (they satisfy the budget trivially and can win at small n
+where cast overhead dominates) — see plans.ladder.precision_race.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: the MXU-tail sentinel: error-compensated 3-pass bf16 split (see
+#: make_dot).  Historically defined in ops.pallas_fft, which re-exports
+#: it; the resolution logic lives here now.
+SPLIT3 = "split3"
+
+#: storage dtype per mode — "bfloat16" is the bytes-halving notch;
+#: everything else stores float32 planes/tables
+STORAGE_DTYPES = {
+    "bf16": "bfloat16",
+    "default": "float32",
+    "split3": "float32",
+    "highest": "float32",
+    "fp32": "float32",
+}
+
+#: bytes per stored plane element
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2}
+
+#: the per-mode error-budget CONTRACT: max L2 relative error vs the
+#: float64 reference (docs/PRECISION.md has the derivation per mode).
+#: Asserted in tests and `make precision-smoke`; enforced per served
+#: batch by the degrade chain's quality rung.
+ERROR_BUDGETS = {
+    "bf16": 3e-2,      # storage quantization across log2(n) stages
+    "default": 1e-2,   # 1-pass bf16 MXU tail (measured ~4e-3)
+    "split3": 1e-5,    # 3-pass error split (measured ~4e-6)
+    "highest": 5e-6,   # XLA 6-pass f32 emulation
+    "fp32": 5e-6,      # same arithmetic, the full-precision path
+}
+
+#: every plan-level precision mode (plans.core re-exports this as the
+#: PlanKey validation set — ONE source of truth)
+PRECISIONS = tuple(STORAGE_DTYPES)
+
+#: quality-direction promotion chain, loosest budget first: a mode over
+#: its budget promotes to the NEXT entry (strictly tighter budget) —
+#: the walk ends at fp32, the full-precision kernel path.  "highest"
+#: is not a rung: it is fp32's twin and already at the top.
+PROMOTE_CHAIN = ("bf16", "default", "split3", "fp32")
+
+#: the env override that injects a budget violation for chaos/smoke
+#: runs (`make precision-smoke` sets it to 0 so every sampled batch
+#: violates and the serve path must walk the chain up to fp32)
+BUDGET_ENV = "PIFFT_PRECISION_BUDGET"
+
+
+def _check_mode(mode: str) -> str:
+    if mode not in STORAGE_DTYPES:
+        raise ValueError(
+            f"unknown precision mode {mode!r} (modes: {PRECISIONS})")
+    return mode
+
+
+def storage_dtype(mode: str) -> str:
+    """The dtype planes and twiddle tables are STORED as for `mode`."""
+    return STORAGE_DTYPES[_check_mode(mode)]
+
+
+def storage_bytes(mode: str) -> int:
+    """Bytes per stored plane element for `mode` — what the roofline
+    traffic model charges (utils.roofline): 2 for bf16 storage, 4 for
+    every fp32-storage mode."""
+    return _DTYPE_BYTES[storage_dtype(mode)]
+
+
+def dtype_bytes(dtype: str) -> int:
+    """Bytes per element of a storage dtype name."""
+    return _DTYPE_BYTES[dtype]
+
+
+#: override values already warned about this process — a junk
+#: PIFFT_PRECISION_BUDGET is announced ONCE, not per sampled batch
+_BUDGET_WARNED: set = set()
+
+
+def error_budget(mode: str) -> float:
+    """The mode's max-relative-error contract.  ``PIFFT_PRECISION_BUDGET``
+    overrides every mode's budget (the smoke/chaos injection knob: set
+    it to 0 and every sampled batch violates, forcing the serve path to
+    walk the promotion chain up to fp32).  The override is VALIDATED —
+    finite and >= 0 — because a NaN would make every `err > budget`
+    comparison False and silently disable enforcement: a rejected
+    value warns once and the committed budget stands (the
+    PIFFT_RENDEZVOUS_DEADLINE_S discipline)."""
+    import math
+    import sys
+
+    _check_mode(mode)
+    env = os.environ.get(BUDGET_ENV, "").strip()
+    if env:
+        try:
+            val = float(env)
+        except ValueError:
+            val = None
+        if val is not None and math.isfinite(val) and val >= 0.0:
+            return val
+        if env not in _BUDGET_WARNED:
+            _BUDGET_WARNED.add(env)
+            print(f"# {BUDGET_ENV}={env!r} is not a finite "
+                  f"non-negative float; override ignored, committed "
+                  f"budgets stand", file=sys.stderr)
+    return ERROR_BUDGETS[mode]
+
+
+#: modes a tuning race for a given requested mode may pin per
+#: candidate: the request is an error-budget FLOOR, so tighter-budget
+#: storage alternatives ride in the same race (fp32 storage satisfies
+#: bf16's loose budget trivially, and can win at small n where the
+#: boundary casts outweigh the halved traffic).  A race NEVER includes
+#: a looser-budget mode than requested — that would break the
+#: contract the key's mode names.
+RACE_ALTERNATES = {"bf16": ("bf16", "split3")}
+
+
+def race_modes(mode: str) -> tuple:
+    """The precision modes the autotuner races for a key requesting
+    `mode`, expected-winner first (plans.ladder expands the candidate
+    ladder by these — precision raced alongside variant/tile/cb)."""
+    return RACE_ALTERNATES.get(_check_mode(mode), (mode,))
+
+
+def promote(mode: str) -> Optional[str]:
+    """The next-tighter mode in the quality chain, or None at (or
+    above) the top — fp32 and highest have nowhere tighter to go."""
+    _check_mode(mode)
+    if mode not in PROMOTE_CHAIN:
+        return None
+    i = PROMOTE_CHAIN.index(mode)
+    return PROMOTE_CHAIN[i + 1] if i + 1 < len(PROMOTE_CHAIN) else None
+
+
+def dot_precision(mode: str):
+    """The kernel-level MXU-tail precision argument for a plan mode:
+    the SPLIT3 sentinel, or a jax.lax.Precision.  Raises ValueError for
+    an unknown mode (the plans.ladder.resolve_precision error path).
+
+    fp32 maps to HIGHEST — fp32 storage with fp32 accumulation via
+    XLA's 6-pass emulation IS the full-precision kernel path (it used
+    to select the jnp stage path instead; the kernel ladder now races
+    it honestly — docs/PRECISION.md).  bf16 maps to DEFAULT: its
+    operands are already storage-quantized, so extra tail passes buy
+    nothing the budget can see, while accumulation stays fp32 via
+    preferred_element_type."""
+    _check_mode(mode)
+    if mode == "split3":
+        return SPLIT3
+    import jax
+
+    if mode in ("highest", "fp32"):
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT  # "default" and "bf16"
+
+
+def make_dot(precision):
+    """Row-major (m,k)@(k,n) on the MXU under the given precision mode;
+    `precision` is a jax.lax.Precision or the SPLIT3 sentinel.
+
+    SPLIT3 decomposes each operand into bf16 hi + lo residual planes
+    and keeps the three significant cross products (x_hi B_hi +
+    x_hi B_lo + x_lo B_hi, f32 accumulation); the dropped x_lo B_lo
+    term is ~2^-18 relative — comfortably inside the 1e-5 budget — at
+    half HIGHEST's MXU passes.  (Precision.HIGH, XLA's own 3-pass
+    mode, raises NotImplementedError in the Mosaic lowering; this is
+    its manual twin.)  The bf16 decomposition casts below are the
+    ALGORITHM, not storage policy — this module is the sanctioned site
+    for exactly that reason (PIF111)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    if precision == SPLIT3:
+        raw = partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32,
+        )
+
+        def dot(x, b):
+            xh = x.astype(jnp.bfloat16)
+            xl = (x - xh.astype(jnp.float32)).astype(jnp.bfloat16)
+            bh = b.astype(jnp.bfloat16)
+            bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
+            return raw(xh, bh) + raw(xh, bl) + raw(xl, bh)
+
+        return dot
+    return partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def jnp_dtype(storage: str):
+    """The jax dtype object for a storage dtype name."""
+    import jax.numpy as jnp
+
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[storage]
+
+
+def as_compute(x):
+    """Upcast a loaded block to the float32 COMPUTE dtype — the one
+    sanctioned in-kernel upcast: storage may be bf16, accumulation
+    never is.  A no-op on fp32 inputs (no extra HLO)."""
+    import jax.numpy as jnp
+
+    if x.dtype == jnp.float32:
+        return x
+    return x.astype(jnp.float32)
+
+
+def as_storage(x, storage: str):
+    """Cast planes/tables to their declared storage dtype — the one
+    sanctioned storage downcast (entry-point boundaries and kernel
+    writes).  A no-op when already there."""
+    dt = jnp_dtype(storage)
+    if x.dtype == dt:
+        return x
+    return x.astype(dt)
+
+
+def rel_err(got_r, got_i, ref_r, ref_i) -> float:
+    """L2 relative error of split-plane output vs a (float64)
+    reference — the budget contract's metric: robust to single-bin
+    noise, comparable across n (a unitary transform preserves it)."""
+    import numpy as np
+
+    gr = np.asarray(got_r, dtype=np.float64)
+    gi = np.asarray(got_i, dtype=np.float64)
+    rr = np.asarray(ref_r, dtype=np.float64)
+    ri = np.asarray(ref_i, dtype=np.float64)
+    num = np.sqrt(np.sum((gr - rr) ** 2 + (gi - ri) ** 2))
+    den = np.sqrt(np.sum(rr ** 2 + ri ** 2))
+    if den == 0.0:
+        return 0.0 if num == 0.0 else float("inf")
+    return float(num / den)

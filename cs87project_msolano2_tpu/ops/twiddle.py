@@ -18,15 +18,48 @@ import numpy as np
 from .bits import ilog2
 
 
+def twiddle_tables(n: int, dtype: str = "float32") \
+        -> tuple[tuple[np.ndarray, np.ndarray], ...]:
+    """((wr, wi), ...) per level, level l sized (n >> l) / 2.
+
+    `dtype` is the STORAGE dtype the tables are rounded to — "float32"
+    (default) or "bfloat16" (the bytes-halving storage mode,
+    ops.precision / docs/PRECISION.md; bf16 tables stream half the HBM
+    bytes into the kernels, and the rounding is charged to the bf16
+    mode's error budget).  Trig always runs in float64 first, so table
+    error is one rounding, never accumulated.
+
+    This thin wrapper normalizes the dtype BEFORE the lru_cache below:
+    ``f(n)`` and ``f(n, dtype="float32")`` must share one cache entry
+    — lru_cache keys on the raw call signature, and a split entry
+    would silently hold the full per-level fp32 table set twice
+    (~8 B/element of duplicate host memory at large n)."""
+    return _twiddle_tables_cached(n, dtype or "float32")
+
+
 @lru_cache(maxsize=64)
-def twiddle_tables(n: int) -> tuple[tuple[np.ndarray, np.ndarray], ...]:
-    """((wr, wi), ...) per level, level l sized (n >> l) / 2, float32."""
+def _twiddle_tables_cached(n: int, dtype: str) \
+        -> tuple[tuple[np.ndarray, np.ndarray], ...]:
+    np_dtype = _np_storage_dtype(dtype)
     levels = []
     for l in range(ilog2(n)):
         L = n >> l
         j = np.arange(L // 2, dtype=np.float64)
         ang = -2.0 * np.pi * j / L
         levels.append(
-            (np.cos(ang).astype(np.float32), np.sin(ang).astype(np.float32))
+            (np.cos(ang).astype(np_dtype), np.sin(ang).astype(np_dtype))
         )
     return tuple(levels)
+
+
+def _np_storage_dtype(dtype: str):
+    """numpy dtype for a storage dtype name; bfloat16 comes from
+    ml_dtypes (shipped with jax), resolved lazily so numpy-only
+    callers never import it."""
+    if dtype == "float32":
+        return np.float32
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    raise ValueError(f"unknown twiddle storage dtype {dtype!r}")

@@ -114,8 +114,12 @@ def tune(key: PlanKey, *, force: bool = False,
 
     results = []
     with spans.span("autotune", cell={"n": key.n, "layout": key.layout},
-                    candidates=len(cands)):
+                    candidates=len(cands), precision=key.precision):
         for variant, params in cands:
+            # precision is a raced axis (docs/PRECISION.md): a pinned
+            # per-candidate mode labels the fate counters so a race
+            # record shows which STORAGE the winner actually beat
+            mode = params.get("precision") or key.precision
             label = f"{variant} {params}"
             try:
                 fn = ladder.build_executor(key, variant, params)
@@ -133,14 +137,15 @@ def tune(key: PlanKey, *, force: bool = False,
                 results.append(CandidateResult(variant, dict(params),
                                                "rejected", None, reason))
                 metrics.inc("pifft_autotune_candidates_total",
-                            status="rejected", kind=fault)
+                            status="rejected", kind=fault,
+                            precision=mode)
                 _log(verbose,
                      f"# plan candidate {label} rejected: {reason}")
                 continue
             results.append(CandidateResult(variant, dict(params),
                                            "timed", ms))
             metrics.inc("pifft_autotune_candidates_total",
-                        status="accepted", kind="timed")
+                        status="accepted", kind="timed", precision=mode)
             _log(verbose, f"# plan candidate {label}: {ms:.4f} ms")
 
     timed = [r for r in results if r.status == "timed"]

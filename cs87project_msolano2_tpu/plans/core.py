@@ -17,7 +17,14 @@ import sys
 from typing import Callable, Optional
 
 LAYOUTS = ("natural", "pi")
-PRECISIONS = ("split3", "highest", "default", "fp32")
+
+# precision is a TUNED plan axis (docs/PRECISION.md): each mode names a
+# storage dtype (fp32 or the bytes-halving bf16), an accumulate
+# discipline (always fp32 in-kernel), and an error-budget contract.
+# The mode table lives in ops.precision — THE sanctioned precision-
+# resolution site (check rule PIF111) — and is re-exported here as the
+# PlanKey validation set.
+from ..ops.precision import PRECISIONS  # noqa: E402,F401
 
 # transform domains (docs/REAL.md): "c2c" is the classic complex
 # transform; "r2c"/"c2r" are the half-spectrum real-input forward and
@@ -28,9 +35,14 @@ DOMAINS = ("c2c", "r2c", "c2r")
 
 # bump when PlanKey/Plan serialization or ladder parameter semantics
 # change incompatibly — stale disk stores are then ignored wholesale
-# (schema 2 added the `domain` field; pre-domain tokens are refused by
-# from_token and skipped-with-a-warn by the disk store loader)
-SCHEMA_VERSION = 2
+# (schema 2 added the `domain` field; schema 3 made precision a TUNED
+# axis: the "bf16" storage mode exists, "fp32" now selects the real
+# kernel path instead of the jnp stage path, and tuned params may
+# carry a per-candidate precision override — a v2 store's winners were
+# raced under the old semantics, so its tokens are refused by
+# from_token and skipped-with-ONE-warn by the disk store loader, never
+# silently served)
+SCHEMA_VERSION = 3
 
 
 def warn(msg: str) -> None:
@@ -89,10 +101,16 @@ class PlanKey:
     layout: "natural" (frequency order; gathers ride inside the plan) or
     "pi" (per-transform bit-reversed — the kernel-native order, gather
     skipped exactly as the reference excludes it from timing).
-    precision: "split3" (default 3-pass bf16 error split, rel err
-    ~4e-6), "highest" (XLA 6-pass f32 emulation), "default" (1-pass
-    bf16), or "fp32" (the all-float32 jnp stage path — no MXU tail at
-    all: the full-precision escape hatch).
+    precision: the tuned storage/accumulate mode (ops.precision,
+    docs/PRECISION.md) — "split3" (default: fp32 storage, 3-pass bf16
+    error-split tail, budget 1e-5), "highest" (fp32 storage, 6-pass
+    emulation), "default" (fp32 storage, 1-pass bf16 tail), "fp32"
+    (fp32 storage AND fp32 accumulate — the full-precision kernel
+    path), or "bf16" (bfloat16 STORAGE for planes/twiddles with fp32
+    in-kernel accumulation — half the HBM bytes of every fp32-storage
+    mode, budget 3e-2).  A tuning race may pin a different in-budget
+    mode per candidate via params["precision"]; the key's mode is the
+    error-budget CONTRACT the plan must serve within.
     domain: "c2c" (complex-to-complex), "r2c" (real forward: real
     planes of length n in, half-spectrum planes of length n//2+1 out),
     or "c2r" (the inverse: half-spectrum in, real signal of length n
@@ -271,9 +289,37 @@ class Plan:
 
         return jax.jit(self.fn, donate_argnums=(0, 1) if donate else ())
 
+    def effective_precision(self) -> str:
+        """The precision mode this plan actually SERVES: a tuning race
+        may have pinned an in-budget mode different from the key's via
+        ``params["precision"]`` (precision is a tuned axis —
+        docs/PRECISION.md), and the degrade chain's quality rung may
+        have promoted it up since.  Falls back to the key's mode."""
+        return self.params.get("precision") or self.key.precision
+
+    def storage_bytes(self) -> int:
+        """Bytes per stored plane element of the path that serves this
+        plan — what the roofline traffic model charges.  The jnp/numpy
+        escape variants and rungs always run fp32 regardless of the
+        requested mode (they have no narrow-storage path)."""
+        from ..ops import precision as prec_mod
+
+        served = self.demotions[-1]["to"] if self.degraded \
+            else self.variant
+        if served in ("jnp", "jnp-fft", "numpy-ref") \
+                or served.startswith("precision:"):
+            # a quality-rung promotion lands on a tighter KERNEL mode;
+            # resolve its storage instead of the variant's
+            if served.startswith("precision:"):
+                return prec_mod.storage_bytes(served.split(":", 1)[1])
+            return 4
+        return prec_mod.storage_bytes(self.effective_precision())
+
     def describe(self) -> dict:
         d = {"variant": self.variant, "params": dict(self.params),
              "source": self.source}
+        if self.effective_precision() != self.key.precision:
+            d["precision"] = self.effective_precision()
         if self.ms is not None:
             d["ms"] = round(self.ms, 4)
         if self.degraded:

@@ -29,9 +29,12 @@ Every entry is (variant, params).  Variants:
 * ``mf``         — the matmul-funnel path (correct and supported, not in
                    the flagship ladder — see bench history in ops).
 * ``jnp``        — the all-float32 XLA stage path (models.fft.
-                   fft_planes): the universal fallback and the "fp32"
-                   precision escape hatch.  Never raced (its unrolled
-                   stages take minutes of compile at large n).
+                   fft_planes): the universal fallback where no kernel
+                   is eligible.  Never raced (its unrolled stages take
+                   minutes of compile at large n).  NOTE: "fp32" is no
+                   longer routed here — it gets the real kernel path
+                   (fp32 storage, fp32 accumulate) and races honestly;
+                   precision is itself a raced axis (docs/PRECISION.md).
 
 The flagship ladder reproduces bench.py's measured table at n=2^20
 (2026-07-31, v5e): fused t16 qb32 unaliased = 78.8-79.3 us (1323-1331
@@ -209,11 +212,28 @@ def candidates(key: PlanKey) -> list:
     out.  Real-domain keys (r2c/c2r) race the HALF-LENGTH c2c ladder:
     the entries are the sub-key's, but build_executor wraps them in
     the pack/Hermitian passes, so the race times the real path it
-    will actually serve."""
+    will actually serve.  PRECISION IS A RACED AXIS (docs/PRECISION.md):
+    for modes with storage alternatives (bf16's fp32-storage sibling),
+    every variant/parameter entry is raced once per mode with the mode
+    pinned in ``params["precision"]`` — expected winner (the narrow
+    storage, half the bytes on a memory-bound family) first — so the
+    tuner measures storage against variant/tile/cb in ONE race and the
+    cache persists whichever precision actually won."""
     if key.domain != "c2c":
         return candidates(c2c_subkey(key))
-    if key.precision == "fp32":
-        return []  # fp32 forces the jnp path; nothing to race
+    cands = _base_candidates(key)
+    from ..ops.precision import race_modes
+
+    modes = race_modes(key.precision)
+    if len(modes) > 1:
+        cands = [(v, dict(p, precision=m))
+                 for m in modes for v, p in cands]
+    return cands
+
+
+def _base_candidates(key: PlanKey) -> list:
+    """The variant/parameter race for a c2c key, before the precision
+    axis is expanded (see candidates)."""
     cands = []
     if _rows_eligible(key):
         # tail=128 measured best for short rows (the S=2 tail's strided
@@ -258,12 +278,12 @@ def static_default(key: PlanKey):
     if key.domain != "c2c":
         return static_default(c2c_subkey(key))
     natural = key.layout == "natural"
-    if key.precision == "fp32":
-        if not natural:
-            raise ValueError(
-                "precision='fp32' runs the jnp stage path, which only "
-                "produces natural order — pi layout needs a kernel plan")
-        return "jnp", {}
+    # NOTE: precision="fp32" takes the SAME dispatch as every other
+    # mode — it used to dead-end on the jnp stage path (refusing every
+    # kernel variant and pi layout outright); it now gets the real
+    # kernel path (fp32 storage, fp32 accumulate via the 6-pass tail)
+    # so the tuner can race it honestly (docs/PRECISION.md).  The jnp
+    # fallback below still serves it where no kernel is eligible.
     if _rows_eligible(key):
         return "rows", {"tail": LANE if key.n <= 8192 else 256}
     if key.batch == () and _pow2(key.n) and key.n > MAX_ROW_TILE:
@@ -301,20 +321,26 @@ def static_default(key: PlanKey):
 
 
 def resolve_precision(precision: str):
-    """Map a PlanKey precision mode to the kernel-level precision
-    argument ("fp32" never reaches a kernel — it selects the jnp
-    variant)."""
-    from ..ops.pallas_fft import SPLIT3
+    """Map a PlanKey precision mode to the kernel-level MXU-tail
+    precision argument — delegated to ops.precision.dot_precision, THE
+    sanctioned precision-resolution site (PIF111): "split3" -> the
+    SPLIT3 sentinel, "highest"/"fp32" -> Precision.HIGHEST (fp32 now
+    reaches the kernels — fp32 storage, fp32 accumulate),
+    "default"/"bf16" -> Precision.DEFAULT (bf16's narrowing lives in
+    STORAGE, resolved separately via resolve_storage).  Raises
+    ValueError for an unknown mode."""
+    from ..ops.precision import dot_precision
 
-    if precision == "split3":
-        return SPLIT3
-    import jax
+    return dot_precision(precision)
 
-    if precision == "highest":
-        return jax.lax.Precision.HIGHEST
-    if precision == "default":
-        return jax.lax.Precision.DEFAULT
-    raise ValueError(f"no kernel precision for mode {precision!r}")
+
+def resolve_storage(precision: str) -> str:
+    """The plane/table STORAGE dtype name for a precision mode
+    ("bfloat16" only for the bytes-halving bf16 mode) — the second
+    half of the sanctioned resolution (docs/PRECISION.md)."""
+    from ..ops.precision import storage_dtype
+
+    return storage_dtype(precision)
 
 
 def build_executor(key: PlanKey, variant: str, params: dict):
@@ -328,7 +354,13 @@ def build_executor(key: PlanKey, variant: str, params: dict):
     the SAME (variant, params) in the O(n) pack/Hermitian passes
     (models.real) — one executor, traceable end to end, so the
     degradation chain and the obs spans see the whole real transform
-    as one unit."""
+    as one unit.
+
+    The precision MODE is ``params["precision"]`` when the tuning race
+    pinned one (precision is a raced axis — see candidates), else the
+    key's mode; it resolves through the sanctioned site into the
+    MXU-tail precision AND the plane/table storage dtype
+    (docs/PRECISION.md — bf16 storage is the bytes-halving notch)."""
     if key.domain != "c2c":
         from ..models import real as real_mod
 
@@ -338,6 +370,7 @@ def build_executor(key: PlanKey, variant: str, params: dict):
         return real_mod.irfft_executor(inner, key.n)
     natural = key.layout == "natural"
     n = key.n
+    mode = params.get("precision") or key.precision
 
     if variant == "jnp":
         if not natural:
@@ -347,7 +380,8 @@ def build_executor(key: PlanKey, variant: str, params: dict):
 
         return fft_planes
 
-    prec = resolve_precision(key.precision)
+    prec = resolve_precision(mode)
+    storage = resolve_storage(mode)
 
     if variant == "rows":
         from ..ops.pallas_fft import fft_rows_pallas
@@ -358,7 +392,8 @@ def build_executor(key: PlanKey, variant: str, params: dict):
         def rows_run(xr, xi):
             return fft_rows_pallas(xr, xi, precision=prec, tail=tail,
                                    natural=natural,
-                                   block_tiles=block_tiles)
+                                   block_tiles=block_tiles,
+                                   storage=storage)
 
         return rows_run
 
@@ -373,31 +408,39 @@ def build_executor(key: PlanKey, variant: str, params: dict):
             return pf.fft_pi_layout_pallas_fused(
                 xr, xi, tile=_p.get("tile"), qb=_p.get("qb", 32),
                 tail=_p.get("tail", 256), precision=prec,
-                alias_io=variant.endswith("alias"))
+                alias_io=variant.endswith("alias"), storage=storage)
     elif variant == "fourstep":
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas_fourstep(
                 xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
                 tail=_p.get("tail", 256), precision=prec,
-                separable=_p.get("separable", True))
+                separable=_p.get("separable", True), storage=storage)
     elif variant == "sixstep":
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas_sixstep(
                 xr, xi, tile=_p.get("tile"), r2=_p.get("r2"),
                 cb1=_p.get("cb1"), cb2=_p.get("cb2"),
                 tail=_p.get("tail", 256), precision=prec,
-                separable=_p.get("separable", True))
+                separable=_p.get("separable", True), storage=storage)
     elif variant == "rql":
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas_rql(
                 xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
-                tail=_p.get("tail", 128), precision=prec)
+                tail=_p.get("tail", 128), precision=prec,
+                storage=storage)
     elif variant == "two-kernel":
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas2(
                 xr, xi, tile=_p.get("tile"), cb=_p.get("cb"),
-                tail=_p.get("tail", 128), precision=prec)
+                tail=_p.get("tail", 128), precision=prec,
+                storage=storage)
     elif variant == "mf":
+        if storage != "float32":
+            # the research-path matmul funnel has no narrow-storage
+            # implementation; a bf16 race entry records this rejection
+            raise ValueError(
+                f"variant 'mf' has no {storage} storage path — fp32 "
+                f"storage only")
         def core(xr, xi, _p=dict(params)):
             return pf.fft_pi_layout_pallas_mf(
                 xr, xi, R=_p.get("R", LANE), cb=_p.get("cb"),

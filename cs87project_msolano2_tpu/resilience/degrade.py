@@ -54,6 +54,18 @@ DEGRADE_CHAIN = ("fourstep", "rql", "jnp-fft", "numpy-ref")
 #: rung shows up in the degrade trail exactly like ``rql`` would.
 COLLECTIVE_FREE_RUNG = "collective_free"
 
+#: the QUALITY-direction rungs (docs/PRECISION.md): unlike every rung
+#: above — which trades performance away to keep serving — a precision
+#: promotion trades performance away to keep the ERROR BUDGET: when a
+#: served batch's sampled relative error exceeds its mode's contract,
+#: the plan promotes UP the mode chain (bf16 -> default -> split3 ->
+#: fp32, loosest storage to full precision), recorded exactly like a
+#: kernel demotion (``degraded: true``, a demotion record with
+#: ``direction: "up"``, the warn line, the event, the counter) — a
+#: plan serving tighter-and-slower than it was tuned for is never
+#: mistaken for the healthy tuned one.  See promote_precision.
+PRECISION_RUNG_PREFIX = "precision:"
+
 #: parameters for the rql rung: auto tile/cb (always lowerable at any
 #: feasible n) and the short-tile-safe tail
 _RQL_PARAMS = {"tile": None, "cb": None, "tail": 128}
@@ -255,6 +267,66 @@ def _note_demotion(plan, from_variant: str, rung: str,
     # session-visible trail lives on the memoized plan, the warn line,
     # and the bench record's degraded tags.
     cache.memoize(plan)
+
+
+def promote_precision(plan, observed_err: float,
+                      budget: float) -> "str | None":
+    """Walk the plan ONE rung UP the precision chain — the degrade
+    subsystem's first quality-direction rung (docs/PRECISION.md).
+
+    Called when a sampled served batch's relative error `observed_err`
+    exceeded `budget` (the plan's current mode's contract,
+    ops.precision.error_budget).  The plan's served mode
+    (``params["precision"]``, falling back to the key's) moves to the
+    next TIGHTER mode (bf16 -> default -> split3 -> fp32); the cached
+    executor is dropped so the next ``plan.fn`` rebuilds at the
+    promoted mode; and the step is recorded as a demotion — degraded
+    stays true, the record carries ``direction: "up"`` and the rung
+    name ``precision:<mode>`` — because a plan no longer serving what
+    it was tuned as must never read as healthy, even when the move
+    bought accuracy rather than survival.  Returns the promoted mode,
+    or None when already at the top (fp32/highest: nothing tighter
+    exists — the caller serves the result tagged, the honest best).
+
+    Like every demotion the record lands in the IN-PROCESS cache only:
+    a budget violation is a property of this session's traffic, and
+    persisting it would taint future sessions (see _note_demotion)."""
+    from ..ops import precision as prec_mod
+    from ..plans import cache
+    from ..plans.core import warn
+
+    mode = plan.effective_precision()
+    nxt = prec_mod.promote(mode)
+    if nxt is None:
+        warn(f"precision budget violated at the top of the chain "
+             f"({mode}: rel err {observed_err:.3e} > budget "
+             f"{budget:.1e}) — nothing tighter to promote to; serving "
+             f"tagged degraded")
+        return None
+    rung = f"{PRECISION_RUNG_PREFIX}{nxt}"
+    record = {
+        "from": mode,
+        "to": rung,
+        "kind": "quality",
+        "direction": "up",
+        "reason": (f"rel err {observed_err:.3e} > budget {budget:.1e} "
+                   f"for mode {mode!r}"),
+    }
+    plan.degraded = True
+    plan.demotions.append(record)
+    plan.params = dict(plan.params, precision=nxt)
+    plan._fn = None  # rebuild the executor at the promoted mode
+    from ..obs import events, metrics
+
+    metrics.inc("pifft_demotions_total", to=rung)
+    events.emit("demotion",
+                cell={"n": plan.key.n, "variant": plan.variant},
+                **record)
+    warn(f"plan PROMOTED {mode} -> {nxt} (precision, UP) for "
+         f"{plan.key.token()} ({record['reason']}) — accuracy is "
+         f"restored; the tuned bytes-halving is not")
+    cache.memoize(plan)
+    return nxt
 
 
 def note_collective_escape(label: str, exc: BaseException,

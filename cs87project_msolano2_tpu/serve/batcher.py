@@ -183,6 +183,16 @@ class BatchRunner:
                       bucket=bucket, rung=rung or "plan") as sp:
                 outcome = self._invoke(group, bucket, rung, sxr, sxi,
                                        degrade)
+                if rung is None and planes:
+                    # the error-budget contract (docs/PRECISION.md):
+                    # sample one request row of every served batch
+                    # against the float64 reference; a violation walks
+                    # the plan UP the precision chain AND recomputes
+                    # the batch at the promoted mode, tagged on the
+                    # outcome (the fallback rungs are fp32 numpy/jnp —
+                    # nothing to sample there)
+                    self._enforce_precision(group, bucket, outcome,
+                                            planes[0], sxr, sxi)
                 sp.set(variant=outcome.plan_variant,
                        degraded=outcome.degraded)
         finally:
@@ -194,6 +204,131 @@ class BatchRunner:
         metrics.observe("pifft_serve_batch_size", size,
                         shape=group.label())
         return outcome
+
+    # ------------------------------------------- precision contract
+
+    @staticmethod
+    def _reference(group: GroupKey, sample):
+        """(ref_r, ref_i) float64 oracle planes for one request of this
+        group, in the group's own layout — or None for combinations
+        with no cheap oracle (inverse real domains)."""
+        xr = np.asarray(sample[0], dtype=np.float64)
+        xi = np.asarray(sample[1], dtype=np.float64)
+        if group.domain == "r2c":
+            if group.inverse:
+                return None
+            y = np.fft.rfft(xr)
+        elif group.domain == "c2r":
+            if group.inverse:
+                return None
+            y = np.fft.irfft(xr + 1j * xi, n=group.n)
+            return y, np.zeros_like(y)
+        elif group.inverse:
+            y = np.fft.ifft(xr + 1j * xi)
+        else:
+            y = np.fft.fft(xr + 1j * xi)
+        ref_r, ref_i = y.real, y.imag
+        if group.layout == "pi":
+            # pi[i] = natural[bitrev(i)]: put the oracle in the
+            # layout the kernel actually answers in
+            from ..ops.bits import bit_reverse_indices
+
+            idx = bit_reverse_indices(group.n)
+            ref_r, ref_i = ref_r[idx], ref_i[idx]
+        return ref_r, ref_i
+
+    def _sample_err(self, plan, group: GroupKey, sample, ref) -> float:
+        """Relative error of ONE re-run request row under the plan's
+        CURRENT executor — used to re-check after a promotion."""
+        from ..ops import precision as prec_mod
+
+        xr = np.asarray(sample[0])[None, :]
+        xi = np.asarray(sample[1])[None, :]
+        if group.inverse:
+            yr, yi = plan.fn(xr, -xi)  # the conj trick (plans.core)
+            got_r = np.asarray(yr)[0] / np.float32(group.n)
+            got_i = -np.asarray(yi)[0] / np.float32(group.n)
+        else:
+            yr, yi = plan.fn(xr, xi)
+            got_r, got_i = np.asarray(yr)[0], np.asarray(yi)[0]
+        return prec_mod.rel_err(got_r, got_i, ref[0], ref[1])
+
+    def _enforce_precision(self, group: GroupKey, bucket: int,
+                           outcome: BatchOutcome, sample,
+                           sxr, sxi) -> None:
+        """Sample the served batch's first request against the float64
+        reference, publish the ``pifft_precision_rel_err`` gauge, and
+        on a budget violation walk the plan UP the precision chain
+        (resilience.degrade.promote_precision) — re-checking the
+        sample at each promoted mode — until the budget holds or the
+        chain tops out at fp32, then RE-RUN the whole staged batch at
+        the promoted mode so the responses carry the tightest-mode
+        data, not the violating planes.  Every step is tagged on the
+        outcome (and so on every response the batch carried): a batch
+        that violated its contract is served at the tightest mode
+        available, marked degraded, never silently."""
+        from ..ops import precision as prec_mod
+        from ..resilience.degrade import promote_precision
+
+        ck = (group, bucket, None)
+        cached = self._callables.get(ck)
+        if cached is None:
+            return
+        _fn, plan = cached
+        if outcome.plan_variant in SERVE_FALLBACK_RUNGS:
+            # the batch was served by a fault-fallback rung (jnp-fft /
+            # numpy-ref): those run fp32 reference paths — sampling
+            # would publish the gauge under the TUNED mode's label
+            # while measuring the rung, and a promotion would re-run
+            # the very kernel that just faulted
+            return
+        ref = self._reference(group, sample)
+        if ref is None:
+            return
+        got_r = np.asarray(outcome.yr)[0]
+        got_i = np.asarray(outcome.yi)[0]
+        err = prec_mod.rel_err(got_r, got_i, ref[0], ref[1])
+        mode = plan.effective_precision()
+        budget = prec_mod.error_budget(mode)
+        metrics.set_gauge("pifft_precision_rel_err", err,
+                          shape=group.label(), mode=mode)
+        promoted = False
+        while err > budget:
+            nxt = promote_precision(plan, err, budget)
+            outcome.degraded = True
+            if nxt is None:
+                break  # top of the chain: serve tagged, nothing tighter
+            promoted = True
+            outcome.degrade.append(f"precision:{nxt}")
+            # the jitted callable bakes the old executor: drop it so
+            # the recompute below (and this group's next batch) builds
+            # at the promoted mode
+            self._callables.pop(ck, None)
+            err = self._sample_err(plan, group, sample, ref)
+            mode = nxt
+            budget = prec_mod.error_budget(mode)
+            metrics.set_gauge("pifft_precision_rel_err", err,
+                              shape=group.label(), mode=mode)
+        if promoted:
+            # the responses must carry the promoted-mode data — the
+            # staged planes are still live (released by run()'s
+            # finally AFTER this check), so one re-invocation replaces
+            # the violating planes batch-wide.  A fault here must not
+            # kill a batch that already holds a (tagged, violating)
+            # answer: keep the original planes and say so.
+            from ..plans.core import warn
+
+            try:
+                fn, _plan = self._callable(group, bucket, None)
+                yr, yi = fn(sxr, sxi)
+            except Exception as e:
+                warn(f"promoted-mode recompute failed for "
+                     f"{group.label()} ({type(e).__name__}: "
+                     f"{str(e)[:120]}); serving the tagged "
+                     f"violating-mode planes")
+                return
+            outcome.yr = np.asarray(yr)
+            outcome.yi = np.asarray(yi)
 
     def _invoke(self, group, bucket, rung, sxr, sxi,
                 degrade) -> BatchOutcome:

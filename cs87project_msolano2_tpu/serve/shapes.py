@@ -10,6 +10,8 @@ JSONL file, one shape per line:
     {"n": 1048576, "batch": [], "layout": "pi", "precision": "split3"}
     {"n": 4096}                  # defaults: batch=(), natural, split3, c2c
     {"n": 4096, "domain": "r2c"}  # half-spectrum real shape (docs/REAL.md)
+    {"n": 4096, "precision": "bf16"}  # bytes-halving bf16 storage
+                                      # (docs/PRECISION.md)
 
 ``pifft plan warm --shapes FILE`` warms the whole set in one call
 (instead of one ``plan warm`` invocation per shape), and

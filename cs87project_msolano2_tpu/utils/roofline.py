@@ -84,32 +84,40 @@ def hbm_peak_bytes_per_s(device_kind: str) -> Optional[float]:
     return best[1] * 1e9 if best else None
 
 
-def fft_min_hbm_bytes(n: int, domain: str = "c2c") -> int:
-    """The floor any n-point float32-plane FFT must move through HBM.
+def fft_min_hbm_bytes(n: int, domain: str = "c2c",
+                      storage_bytes: int = 4) -> int:
+    """The floor any n-point plane FFT must move through HBM, DTYPE-
+    AWARE (docs/PRECISION.md): `storage_bytes` is the per-element
+    storage width of the plan's precision mode (4 for every
+    fp32-storage mode, 2 for bf16 storage — ops.precision).
 
-    c2c: one read and one write of the re+im planes (4 B x 2 planes x
-    2 directions = 16 B/element).  The half-spectrum real domains
-    (r2c/c2r — docs/REAL.md) move HALF that at the same n: the real
-    side is ONE plane of n floats (4n B) and the spectral side two
-    planes of ~n/2 bins (~4n B), so 8 B/element total — the whole
-    point of the domain-aware plan ladder, and the halving the
-    ``make rfft-smoke`` gate asserts against the bytes meter.
-    Twiddle/table traffic is excluded — it is implementation choice,
-    which is exactly what the utilization figure should penalize."""
+    c2c: one read and one write of the re+im planes (storage_bytes x
+    2 planes x 2 directions = 4*storage_bytes B/element — 16 B at
+    fp32, 8 B at bf16).  The half-spectrum real domains (r2c/c2r —
+    docs/REAL.md) move HALF that at the same n: the real side is ONE
+    plane of n values and the spectral side two planes of ~n/2 bins,
+    so 2*storage_bytes B/element total.  The two halvings COMPOSE: an
+    r2c bf16 cell floors at 4 B/element, a quarter of fp32 c2c — each
+    gated by its own smoke (rfft-smoke, precision-smoke) from the
+    METERED counter.  Twiddle/table traffic is excluded — it is
+    implementation choice, which is exactly what the utilization
+    figure should penalize."""
     if domain in ("r2c", "c2r"):
-        return 8 * n
-    return 16 * n
+        return 2 * storage_bytes * n
+    return 4 * storage_bytes * n
 
 
 def fft_hbm_bytes(n: int, carry_passes: int = 0,
-                  domain: str = "c2c") -> int:
+                  domain: str = "c2c", storage_bytes: int = 4) -> int:
     """The traffic an n-point transform with `carry_passes` materialized
-    intermediates actually moves: the per-domain floor plus one full
-    write+read round trip of the planes per carry pass.  A real-domain
-    carry rides the PACKED n/2 complex planes (16 B x n/2 = 8n B), so
-    the halving holds pass for pass.  This — not the floor — is what
-    the bytes-moved meter charges."""
-    return fft_min_hbm_bytes(n, domain) * (1 + carry_passes)
+    intermediates actually moves: the per-domain per-dtype floor plus
+    one full write+read round trip of the planes per carry pass.  The
+    carries ride the STORAGE dtype too (the fourstep/sixstep HBM
+    carries are declared at it — ops/pallas_fft.py), so the bf16
+    halving holds pass for pass, exactly like the r2c one.  This — not
+    the floor — is what the bytes-moved meter charges."""
+    return fft_min_hbm_bytes(n, domain, storage_bytes) \
+        * (1 + carry_passes)
 
 
 def roofline_ceiling(carry_passes: Optional[int]) -> Optional[float]:
@@ -124,29 +132,37 @@ def roofline_ceiling(carry_passes: Optional[int]) -> Optional[float]:
 
 def roofline_utilization(n: int, ms: float, device_kind: str,
                          carry_passes: int = 0,
-                         domain: str = "c2c") -> Optional[float]:
+                         domain: str = "c2c",
+                         storage_bytes: int = 4) -> Optional[float]:
     """Achieved fraction of the HBM roofline for an n-point transform
     measured at `ms` per call, charging the minimum traffic of the
-    transform's DOMAIN (see fft_min_hbm_bytes — the real domains'
-    floor is half the c2c one) so the figure reads against the
-    1/(1+p) ceiling of the path's declared carry passes.  None when
-    the device peak is unknown or the measurement is degenerate."""
+    transform's DOMAIN and STORAGE dtype (see fft_min_hbm_bytes — the
+    real domains' floor is half the c2c one, bf16 storage half the
+    fp32 one) so the figure reads against the 1/(1+p) ceiling of the
+    path's declared carry passes.  None when the device peak is
+    unknown or the measurement is degenerate."""
     from ..obs import metrics
 
     if ms is not None and ms > 0.0:
         # observability: the bytes-moved meter charges the PLAN-DECLARED
-        # traffic (floor + carry round trips) of the DOMAIN actually
-        # served, so a run's total data motion — carries included, the
-        # r2c halving included — is queryable; the floor-only counter
-        # is kept for cross-round comparability
+        # traffic (floor + carry round trips) of the DOMAIN and STORAGE
+        # actually served, so a run's total data motion — carries
+        # included, the r2c and bf16 halvings included — is queryable;
+        # the floor-only counter is kept for cross-round comparability
         metrics.inc("pifft_hbm_min_bytes_total",
-                    fft_min_hbm_bytes(n, domain))
+                    fft_min_hbm_bytes(n, domain, storage_bytes))
         metrics.inc("pifft_hbm_bytes_total",
-                    fft_hbm_bytes(n, carry_passes, domain))
+                    fft_hbm_bytes(n, carry_passes, domain,
+                                  storage_bytes))
     peak = hbm_peak_bytes_per_s(device_kind)
     if peak is None or ms is None or ms <= 0.0:
         return None
-    util = fft_min_hbm_bytes(n, domain) / (ms * 1e-3) / peak
+    util = fft_min_hbm_bytes(n, domain, storage_bytes) \
+        / (ms * 1e-3) / peak
+    # the storage label keeps a bf16 cell from overwriting its fp32
+    # sibling's reading at the same {domain, n} — the same collision
+    # the domain label resolved when r2c rows landed beside c2c
     metrics.set_gauge("pifft_roofline_util", util, domain=domain,
-                      n=f"2^{max(n, 1).bit_length() - 1}")
+                      n=f"2^{max(n, 1).bit_length() - 1}",
+                      storage=f"{storage_bytes}B")
     return util

@@ -92,11 +92,16 @@ def test_pi_layout_requires_kernel_eligible_shape():
         plans.plan_for((7, 96), layout="pi")  # n < 128: no kernel path
 
 
-def test_fp32_escape_hatch():
+def test_fp32_gets_the_kernel_path():
+    # the old fp32 dead end (jnp stage path, pi layout refused) is
+    # fixed (docs/PRECISION.md): fp32 = fp32 storage + fp32 accumulate
+    # ON the kernel ladder, so it serves rows here and supports pi
     plan = plans.plan_for((512,), precision="fp32")
-    assert plan.variant == "jnp"
-    with pytest.raises(ValueError):
-        plans.plan_for((512,), layout="pi", precision="fp32")
+    assert plan.variant == "rows"
+    pi = plans.plan_for((4096,), layout="pi", precision="fp32")
+    assert pi.variant == "rows"
+    # the jnp stage path still serves shapes with no eligible kernel
+    assert plans.plan_for((96,), precision="fp32").variant == "jnp"
 
 
 # --------------------------------------------------------------- cache

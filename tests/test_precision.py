@@ -1,0 +1,575 @@
+"""Mixed precision as a tuned plan axis (docs/PRECISION.md): the mode
+matrix and budgets, bf16 storage parity across kernel variants, the
+fp32 kernel-path fix, the precision race and its cached winner, the
+dtype-aware roofline/meter halving, the serve-side budget contract
+with the degrade chain's quality-direction (UP) rung, PlanKey v2->v3
+store migration, the analyze-loader precision backfill, and the
+PIF111 check rule."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import check, plans
+from cs87project_msolano2_tpu.ops import precision as prec
+from cs87project_msolano2_tpu.plans import cache as plan_cache
+from cs87project_msolano2_tpu.plans import ladder
+from cs87project_msolano2_tpu.plans.core import SCHEMA_VERSION, Plan
+
+
+@pytest.fixture(autouse=True)
+def fresh_memory_cache():
+    plan_cache.clear(memory=True, disk=False)
+    yield
+    plan_cache.clear(memory=True, disk=False)
+
+
+def planes(n, seed=0, batch=()):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(batch + (n,)).astype(np.float32),
+            rng.standard_normal(batch + (n,)).astype(np.float32))
+
+
+def ref_fft(xr, xi):
+    y = np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128))
+    return y.real, y.imag
+
+
+# ------------------------------------------------------ the mode table
+
+
+def test_mode_table_is_consistent():
+    assert set(prec.PRECISIONS) == set(prec.STORAGE_DTYPES) \
+        == set(prec.ERROR_BUDGETS)
+    assert prec.storage_dtype("bf16") == "bfloat16"
+    assert prec.storage_bytes("bf16") == 2
+    for mode in ("split3", "highest", "default", "fp32"):
+        assert prec.storage_dtype(mode) == "float32"
+        assert prec.storage_bytes(mode) == 4
+    # the promote chain is strictly budget-tightening
+    budgets = [prec.ERROR_BUDGETS[m] for m in prec.PROMOTE_CHAIN]
+    assert budgets == sorted(budgets, reverse=True)
+    assert len(set(budgets)) == len(budgets)
+
+
+def test_promote_chain():
+    assert prec.promote("bf16") == "default"
+    assert prec.promote("default") == "split3"
+    assert prec.promote("split3") == "fp32"
+    assert prec.promote("fp32") is None
+    assert prec.promote("highest") is None  # fp32's twin: already top
+    with pytest.raises(ValueError, match="unknown precision"):
+        prec.promote("fp8")
+
+
+def test_budget_env_override(monkeypatch):
+    monkeypatch.setenv(prec.BUDGET_ENV, "0")
+    assert prec.error_budget("bf16") == 0.0
+    monkeypatch.setenv(prec.BUDGET_ENV, "junk")
+    assert prec.error_budget("bf16") == prec.ERROR_BUDGETS["bf16"]
+    monkeypatch.delenv(prec.BUDGET_ENV)
+    assert prec.error_budget("split3") == prec.ERROR_BUDGETS["split3"]
+
+
+def test_plan_key_accepts_bf16_and_refuses_unknown():
+    key = plans.make_key(1024, precision="bf16")
+    assert key.precision == "bf16"
+    with pytest.raises(ValueError, match="precision"):
+        plans.make_key(1024, precision="fp8")
+
+
+# ----------------------------------------- resolution (the fp32 fix)
+
+
+def test_resolve_precision_all_modes_and_error_path():
+    import jax
+
+    from cs87project_msolano2_tpu.ops.pallas_fft import SPLIT3
+
+    assert ladder.resolve_precision("split3") == SPLIT3
+    assert ladder.resolve_precision("highest") is \
+        jax.lax.Precision.HIGHEST
+    # the fp32 dead end is fixed: it reaches the kernels as the
+    # full-precision tail, not a refusal
+    assert ladder.resolve_precision("fp32") is jax.lax.Precision.HIGHEST
+    assert ladder.resolve_precision("default") is \
+        jax.lax.Precision.DEFAULT
+    assert ladder.resolve_precision("bf16") is jax.lax.Precision.DEFAULT
+    for bogus in ("fp8", "", "float32"):
+        with pytest.raises(ValueError, match="unknown precision"):
+            ladder.resolve_precision(bogus)
+    assert ladder.resolve_storage("bf16") == "bfloat16"
+    assert ladder.resolve_storage("fp32") == "float32"
+    with pytest.raises(ValueError, match="unknown precision"):
+        ladder.resolve_storage("fp8")
+
+
+def test_fp32_gets_the_real_kernel_path():
+    """precision='fp32' used to refuse every kernel variant and land
+    silently on the jnp stage path (and raise for pi layout); it now
+    serves and races the real kernels — fp32 storage, fp32
+    accumulate."""
+    key = plans.make_key(512, precision="fp32")
+    assert ladder.static_default(key)[0] == "rows"
+    assert ladder.candidates(key)  # raced honestly, no longer []
+    # pi layout works now: the kernel path exists
+    pi_key = plans.make_key(4096, layout="pi", precision="fp32")
+    assert ladder.static_default(pi_key)[0] == "rows"
+    # the jnp fallback still serves where no kernel is eligible
+    odd = plans.make_key(96, precision="fp32")
+    assert ladder.static_default(odd)[0] == "jnp"
+    # and the numbers are full-precision
+    xr, xi = planes(512, seed=1)
+    yr, yi = plans.get_plan(key).execute(xr, xi)
+    rr, ri = ref_fft(xr, xi)
+    assert prec.rel_err(yr, yi, rr, ri) <= prec.error_budget("fp32")
+
+
+# ------------------------------------------------- budgets (parity)
+
+
+@pytest.mark.parametrize("mode", ["split3", "highest", "default",
+                                  "fp32", "bf16"])
+@pytest.mark.parametrize("n", [1 << 10, 1 << 13])
+def test_error_budget_contract_holds(mode, n):
+    """The committed per-mode budget (max L2 rel err vs the float64
+    reference) holds on the kernel path each mode actually serves."""
+    xr, xi = planes(n, seed=2)
+    plan = plans.plan(n, layout="natural", precision=mode)
+    yr, yi = plan.execute(xr, xi)
+    rr, ri = ref_fft(xr, xi)
+    assert prec.rel_err(yr, yi, rr, ri) <= prec.error_budget(mode)
+
+
+def test_bf16_storage_is_actually_narrow_but_output_is_f32():
+    """bf16 mode stores narrow (the kernels see bf16 blocks — parity
+    degrades to quantization scale, proving the storage really
+    narrowed) while the executor contract stays float32 planes."""
+    import jax.numpy as jnp
+
+    xr, xi = planes(4096, seed=3)
+    p16 = plans.plan(4096, layout="natural", precision="bf16")
+    p32 = plans.plan(4096, layout="natural", precision="split3")
+    yr16, yi16 = p16.execute(xr, xi)
+    yr32, yi32 = p32.execute(xr, xi)
+    assert yr16.dtype == jnp.float32 and yi16.dtype == jnp.float32
+    rr, ri = ref_fft(xr, xi)
+    e16 = prec.rel_err(yr16, yi16, rr, ri)
+    e32 = prec.rel_err(yr32, yi32, rr, ri)
+    assert e32 < 1e-5
+    assert 1e-4 < e16 <= prec.error_budget("bf16")  # narrow, in budget
+
+
+@pytest.mark.parametrize("variant_kwargs", [
+    ("fourstep", dict(tile=1024, tail=128)),
+    ("sixstep", dict(tile=256, tail=128)),
+    ("fused", dict(tile=1024, qb=2)),
+])
+def test_bf16_storage_carry_kernels_parity(variant_kwargs):
+    """The single-pass carry kernels (fused VMEM carry, fourstep and
+    sixstep HBM carries) run their carries AT the bf16 storage dtype
+    and stay inside the budget."""
+    from cs87project_msolano2_tpu.ops import pallas_fft as pf
+    from cs87project_msolano2_tpu.utils.verify import (
+        pi_layout_to_natural,
+    )
+
+    variant, kwargs = variant_kwargs
+    fn = {"fourstep": pf.fft_pi_layout_pallas_fourstep,
+          "sixstep": pf.fft_pi_layout_pallas_sixstep,
+          "fused": pf.fft_pi_layout_pallas_fused}[variant]
+    n = 1 << 12
+    xr, xi = planes(n, seed=4)
+    yr, yi = fn(xr, xi, storage="bfloat16", **kwargs)
+    got = pi_layout_to_natural(np.asarray(yr) + 1j * np.asarray(yi))
+    rr, ri = ref_fft(xr, xi)
+    assert prec.rel_err(got.real, got.imag, rr, ri) \
+        <= prec.error_budget("bf16")
+
+
+# ------------------------------------------------ the precision race
+
+
+def test_bf16_candidates_race_both_storages_pinned():
+    key = plans.make_key(4096, layout="pi", precision="bf16")
+    cands = ladder.candidates(key)
+    modes = [p.get("precision") for _, p in cands]
+    assert set(modes) == {"bf16", "split3"}
+    assert modes[0] == "bf16"  # expected winner (half the bytes) first
+    # fp32-storage keys race only themselves: a looser mode must never
+    # ride into a tighter-budget race
+    for mode in ("split3", "fp32", "highest"):
+        k = plans.make_key(4096, layout="pi", precision=mode)
+        assert all("precision" not in p
+                   for _, p in ladder.candidates(k))
+
+
+def test_tuned_winner_pins_precision_and_cache_persists_it(
+        tmp_path, monkeypatch):
+    """The autotuner races precision alongside variant/params; the
+    winner's pinned mode lands in params, the disk store, and the
+    reloaded plan's effective precision."""
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = plans.make_key(4096, layout="pi", precision="bf16",
+                         device_kind="TPU test-kind")
+
+    def timer(fn, k):
+        # deterministic: make the FIRST bf16 candidate the winner
+        timer.calls += 1
+        return 0.1 if timer.calls == 1 else 1.0 + timer.calls
+
+    timer.calls = 0
+    plan = plans.tune(key, timer=timer, allow_offline=True)
+    assert plan.params.get("precision") == "bf16"
+    assert plan.effective_precision() == "bf16"
+    assert plan.storage_bytes() == 2
+    # the race record carries both storages' fates
+    raced = {r.params.get("precision") for r in plan.tuning}
+    assert raced == {"bf16", "split3"}
+    # a fresh process (cleared memory level) reloads the pinned winner
+    plan_cache.clear(memory=True, disk=False)
+    hit = plan_cache.lookup(key)
+    assert hit is not None and hit.params.get("precision") == "bf16"
+
+
+# ------------------------------------- dtype-aware roofline + meter
+
+
+def test_roofline_floors_compose_domain_and_storage():
+    from cs87project_msolano2_tpu.utils.roofline import (
+        fft_hbm_bytes,
+        fft_min_hbm_bytes,
+    )
+
+    n = 1 << 13
+    assert fft_min_hbm_bytes(n) == 16 * n
+    assert fft_min_hbm_bytes(n, storage_bytes=2) == 8 * n
+    assert fft_min_hbm_bytes(n, "r2c", storage_bytes=2) == 4 * n
+    # the halving holds per carry pass, both axes
+    assert fft_hbm_bytes(n, 2, storage_bytes=2) * 2 \
+        == fft_hbm_bytes(n, 2, storage_bytes=4)
+
+
+def test_metered_bytes_halve_for_bf16(monkeypatch):
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.obs import metrics
+    from cs87project_msolano2_tpu.utils.roofline import (
+        roofline_utilization,
+    )
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    try:
+        n = 1 << 13
+
+        def delta(sb):
+            before = metrics.counter_value("pifft_hbm_bytes_total")
+            roofline_utilization(n, 1.0, "TPU v5e", 0,
+                                 storage_bytes=sb)
+            return metrics.counter_value("pifft_hbm_bytes_total") \
+                - before
+
+        assert delta(2) * 2 == delta(4)
+    finally:
+        if owned:
+            obs.disable()
+
+
+def test_plan_storage_bytes_falls_back_to_fp32_on_escape_rungs():
+    key = plans.make_key(1024, precision="bf16")
+    plan = Plan(key=key, variant="rows", params={}, source="static")
+    assert plan.storage_bytes() == 2
+    plan.degraded = True
+    plan.demotions.append({"from": "rows", "to": "jnp-fft",
+                           "kind": "capacity", "reason": "test"})
+    assert plan.storage_bytes() == 4  # the escape rungs run fp32
+
+
+# ----------------------------- the quality rung: promote UP on budget
+
+
+def test_promote_precision_walks_up_and_records():
+    from cs87project_msolano2_tpu.resilience.degrade import (
+        promote_precision,
+    )
+
+    key = plans.make_key(1024, precision="bf16")
+    plan = Plan(key=key, variant="rows", params={"tail": 128},
+                source="static")
+    assert promote_precision(plan, 0.5, 3e-2) == "default"
+    assert plan.degraded is True
+    assert plan.effective_precision() == "default"
+    rec = plan.demotions[-1]
+    assert rec["direction"] == "up" and rec["to"] == "precision:default"
+    assert rec["kind"] == "quality" and "budget" in rec["reason"]
+    assert promote_precision(plan, 0.5, 1e-2) == "split3"
+    assert promote_precision(plan, 0.5, 1e-5) == "fp32"
+    # top of the chain: nothing tighter — serve tagged
+    assert promote_precision(plan, 0.5, 5e-6) is None
+    assert plan.effective_precision() == "fp32"
+    assert [r["to"] for r in plan.demotions] == [
+        "precision:default", "precision:split3", "precision:fp32"]
+
+
+def test_serve_batch_budget_violation_walks_to_fp32(monkeypatch):
+    """The acceptance walk: with the budget override injecting a
+    violation, ONE served bf16 batch promotes the plan rung by rung to
+    fp32 — degraded:true and the precision trail on the OUTCOME (and
+    so on every response), the demotion records on the plan, the
+    rel-err gauge published — and the group's next batch serves at
+    fp32 without re-violating the (restored) budget."""
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.obs import metrics
+    from cs87project_msolano2_tpu.serve.batcher import (
+        BatchRunner,
+        GroupKey,
+    )
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    try:
+        monkeypatch.setenv(prec.BUDGET_ENV, "0")
+        runner = BatchRunner()
+        group = GroupKey(n=1024, precision="bf16")
+        xr, xi = planes(1024, seed=5)
+        out = runner.run(group, [(xr, xi)])
+        assert out.degraded is True
+        assert out.degrade == ["precision:default", "precision:split3",
+                               "precision:fp32"]
+        # the batch was RECOMPUTED at the promoted mode: the responses
+        # carry fp32-accuracy data, not the violating bf16 planes
+        rr, ri = ref_fft(xr, xi)
+        assert prec.rel_err(out.yr[0], out.yi[0], rr, ri) \
+            <= prec.ERROR_BUDGETS["fp32"]
+        plan = plans.plan_for((1, 1024), precision="bf16")
+        assert plan.degraded and plan.effective_precision() == "fp32"
+        assert all(r["direction"] == "up" for r in plan.demotions)
+        gauges = [k for k in metrics.snapshot()["gauges"]
+                  if k.startswith("pifft_precision_rel_err")]
+        assert gauges
+        # restore the real budgets: the promoted (fp32) plan now serves
+        # WITHIN budget — sticky-degraded tags remain, no new promotion
+        monkeypatch.delenv(prec.BUDGET_ENV)
+        out2 = runner.run(group, [planes(1024, seed=6)])
+        assert out2.degraded is True  # sticky, like kernel demotions
+        assert len(plan.demotions) == 3  # but no FURTHER promotion
+    finally:
+        if owned:
+            obs.disable()
+
+
+def test_serve_batch_within_budget_stays_healthy():
+    from cs87project_msolano2_tpu.serve.batcher import (
+        BatchRunner,
+        GroupKey,
+    )
+
+    runner = BatchRunner()
+    out = runner.run(GroupKey(n=1024, precision="bf16"),
+                     [planes(1024, seed=7)])
+    assert out.degraded is False and out.degrade == []
+
+
+# ------------------------------------ PlanKey v2 -> v3 store migration
+
+
+def test_v2_token_refused_and_v3_round_trips():
+    key = plans.make_key(1024, layout="pi", precision="bf16",
+                         device_kind="TPU test-kind")
+    assert plans.PlanKey.from_token(key.token()) == key
+    assert json.loads(key.token())["v"] == 3
+    v2 = json.dumps({
+        "v": 2, "device_kind": "TPU test-kind", "n": 1024,
+        "batch": [], "layout": "pi", "dtype": "float32",
+        "precision": "fp32", "domain": "c2c"},
+        sort_keys=True, separators=(",", ":"))
+    with pytest.raises(ValueError, match="schema"):
+        plans.PlanKey.from_token(v2)
+
+
+def test_v2_tokens_in_v3_store_warn_once_no_silent_wipe(
+        tmp_path, monkeypatch, capsys):
+    """The PR 10 migration discipline extended to v2->v3: a
+    current-header store carrying hand-written v2 tokens (whose fp32
+    winners were raced under the OLD semantics) serves every v3
+    entry, skips the v2 ones with ONE plans.warn per process, keeps
+    them through merge-writes, and `plan show` survives."""
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = plans.make_key(4096, (16,), device_kind="TPU test-kind",
+                         precision="bf16")
+    plan_cache.store(Plan(key=key, variant="rows",
+                          params={"tail": 256, "precision": "bf16"},
+                          source="tuned", ms=0.4))
+    path = plan_cache.store_path(key.device_kind)
+    with open(path) as fh:
+        data = json.load(fh)
+    v2_token = json.dumps({
+        "v": SCHEMA_VERSION - 1, "device_kind": "TPU test-kind",
+        "n": 2048, "batch": [], "layout": "pi", "dtype": "float32",
+        "precision": "fp32", "domain": "c2c"},
+        sort_keys=True, separators=(",", ":"))
+    data["plans"][v2_token] = {"variant": "jnp", "params": {}}
+    with open(path, "w") as fh:
+        json.dump(data, fh)
+    plan_cache.clear(memory=True, disk=False)
+    plan_cache._STALE_WARNED.clear()
+    hit = plan_cache.lookup(key)
+    assert hit is not None and hit.params.get("precision") == "bf16"
+    err = capsys.readouterr().err
+    assert err.count("stale-schema") == 1
+    # warn-once per process
+    plan_cache.clear(memory=True, disk=False)
+    assert plan_cache.lookup(key) is not None
+    assert "stale-schema" not in capsys.readouterr().err
+    # a merge-write carries the stale token through verbatim (no wipe)
+    other = plans.make_key(512, device_kind="TPU test-kind")
+    plan_cache.store(Plan(key=other, variant="rows", params={},
+                          source="tuned", ms=0.1))
+    with open(path) as fh:
+        assert v2_token in json.load(fh)["plans"]
+    # and the precision-aware `plan show` survives the stale token
+    from cs87project_msolano2_tpu.cli import main
+
+    monkeypatch.setattr(plans, "current_device_kind",
+                        lambda: "TPU test-kind")
+    assert main(["plan", "show"]) == 0
+    out = capsys.readouterr().out
+    assert "bf16" in out and "bfloat16" in out
+
+
+# --------------------------------------- analyze loader backfill
+
+
+def test_loader_precision_field_and_backfill():
+    from cs87project_msolano2_tpu.analyze.loader import (
+        BenchRound,
+        Fingerprint,
+        Sample,
+        bench_samples,
+        load_bench_round,
+    )
+
+    assert Sample(source="bench", metric="x", value=1.0).precision \
+        == "split3"
+    rnd = BenchRound(index=7, path="x.json", metrics={
+        "n2^13_gflops": 2.5,
+        "rfft2^13_gflops": 1.2,
+        "bf16_2^13_gflops": 3.1,
+        "bf16_2^13_hbm_bytes": 65536.0,
+    }, fingerprint=Fingerprint())
+    by_metric = {s.metric: s for s in bench_samples(rnd)}
+    assert by_metric["n2^13_gflops"].precision == "split3"
+    assert by_metric["rfft2^13_gflops"].precision == "split3"
+    assert by_metric["rfft2^13_gflops"].domain == "r2c"
+    s = by_metric["bf16_2^13_gflops"]
+    assert s.precision == "bf16" and s.n == 1 << 13 \
+        and s.domain == "c2c"
+    assert by_metric["bf16_2^13_hbm_bytes"].precision == "bf16"
+    # the committed pre-precision trajectory backfills split3
+    committed = load_bench_round(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_r01.json"))
+    assert committed.metrics
+    assert all(s.precision == "split3"
+               for s in bench_samples(committed))
+
+
+# --------------------------------------------------- bench + cli rows
+
+
+def test_bench_precision_row_smoke():
+    import bench
+
+    row = bench.measure_precision_row(13, "bf16", smoke=True)
+    assert row["bf16_2^13_precision"] == "bf16"
+    assert row["bf16_2^13_ms"] > 0
+    assert row["bf16_2^13_parity_relerr"] <= prec.error_budget("bf16")
+    assert row["bf16_2^13_plan"]["variant"]
+
+
+def test_cli_plan_warm_accepts_bf16_offline_refusal(capsys):
+    from cs87project_msolano2_tpu.cli import main
+
+    assert main(["plan", "warm", "-n", "2^10",
+                 "--precision", "bf16"]) == 2
+    assert "offline" in capsys.readouterr().err
+
+
+# --------------------------------------------------------- PIF111
+
+
+OPS_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(check.__file__))), "ops", "snippet.py")
+PLANS_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(check.__file__))), "plans", "snippet.py")
+SANCTIONED_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(check.__file__))), "ops", "precision.py")
+
+HOT_CAST = """
+import jax.numpy as jnp
+
+def kernel_body(x):
+    a = x.astype(jnp.float32)
+    b = x.astype(jnp.bfloat16)
+    c = x.astype("bfloat16")
+    return a, b, c
+"""
+
+
+def test_pif111_flags_hard_coded_jnp_casts_in_ops_and_plans():
+    for path in (OPS_PATH, PLANS_PATH):
+        found = check.check_source(path, HOT_CAST, rules=["PIF111"])
+        assert len(found) == 3, [f.message for f in found]
+        assert all(f.rule == "PIF111" for f in found)
+        assert "sanctioned" in found[0].message
+    # import-alias form resolves through the import map too
+    aliased = """
+from jax.numpy import bfloat16 as half
+
+def f(x):
+    return x.astype(half)
+"""
+    assert len(check.check_source(OPS_PATH, aliased,
+                                  rules=["PIF111"])) == 1
+
+
+def test_pif111_negative_scope_and_noqa():
+    ok = """
+import numpy as np
+import jax.numpy as jnp
+
+def tables(t, ref, dt):
+    host = t.astype(np.float32)       # host-side table rounding: out
+    var = t.astype(dt)                # dtype-variable: resolved cast
+    ref_w = t.astype(ref.dtype)       # ref-dtype write-back
+    con = jnp.zeros((4,), jnp.float32)  # constructor, not a cast
+    esc = t.astype(jnp.float32)  # pifft: noqa[PIF111]
+    return host, var, ref_w, con, esc
+"""
+    assert check.check_source(OPS_PATH, ok, rules=["PIF111"]) == []
+    # include-scoped: the same casts outside ops//plans/ pass
+    assert check.check_source("/repo/models/m.py", HOT_CAST,
+                              rules=["PIF111"]) == []
+    assert check.check_source("/repo/serve/s.py", HOT_CAST,
+                              rules=["PIF111"]) == []
+    # the sanctioned site is exempt — it IS where casts live
+    assert check.check_source(SANCTIONED_PATH, HOT_CAST,
+                              rules=["PIF111"]) == []
+
+
+def test_pif111_shipped_packages_are_clean():
+    """ops/ and plans/ as committed must satisfy the rule with no
+    suppressions beyond their own noqa — the check-baseline stays
+    empty."""
+    from cs87project_msolano2_tpu.check import engine
+
+    pkg = os.path.dirname(os.path.dirname(
+        os.path.abspath(check.__file__)))
+    findings = list(engine.check_paths(
+        [os.path.join(pkg, "ops"), os.path.join(pkg, "plans")],
+        rules=["PIF111"]))
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
