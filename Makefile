@@ -7,8 +7,8 @@
 SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
-        bench-chaos serve-smoke serve-slo rfft-smoke precision-smoke \
-        multichip-smoke \
+        bench-chaos serve-smoke serve-slo serve-mesh-smoke rfft-smoke \
+        precision-smoke multichip-smoke \
         replicate run-experiments run-experiments-and-analyze-results \
         analyze analyze-datasets analyze-smoke check lint
 
@@ -144,6 +144,37 @@ serve-smoke:
 # smoke-sized here — drop --smoke for the real tier on hardware
 serve-slo:
 	PIFFT_PLAN_CACHE=off python3 bench.py --serve-load --smoke
+
+# the CI mesh-serving check (docs/SERVING.md, mesh section): a virtual
+# 8-device CPU mesh under open-loop load with a MID-RUN DEVICE KILL
+# (the device<K> injection site) and a journaled warm-handoff drain.
+# The in-process gate fails unless zero requests were dropped, every
+# response verifies against numpy, re-routed requests carry a
+# failover:* trail, consensus ran before the re-route, shape affinity
+# held (asserted from the placement counter), utilization stayed in
+# the spread bound, the pre/post-kill p99 pair is recorded, and the
+# drained device's successor serves without re-tuning.  The bench run
+# then emits the serve_mesh row set (per-device utilization + the p99
+# split) in the BENCH round format analyze/loader parses.
+serve-mesh-smoke:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  serve --mesh-smoke && \
+	PIFFT_PLAN_CACHE=off python3 bench.py --serve-mesh --smoke \
+	  | tee /tmp/pifft-serve-mesh.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-serve-mesh.json')); \
+	  rows = r['serve_mesh']; \
+	  kill = [x for x in rows if x.get('row') == 'kill'][0]; \
+	  assert kill['failed'] == 0, kill; \
+	  assert kill['failover_tagged'] >= 1, kill; \
+	  assert kill['p99_pre_kill_ms'] is not None, kill; \
+	  assert kill['p99_post_kill_ms'] is not None, kill; \
+	  devs = [x for x in rows if x.get('row') == 'device']; \
+	  assert len(devs) == 8 and sum(1 for d in devs if d['served'] > 0) >= 6, devs; \
+	  assert r['metric'] == 'serve_mesh_p99_post_kill_ms', r['metric']; \
+	  print('# serve mesh rows ok: kill on %s, p99 %s -> %s ms, %d devices served' \
+	        % (kill['killed_device'], kill['p99_pre_kill_ms'], \
+	           kill['p99_post_kill_ms'], sum(1 for d in devs if d['served'] > 0)))"
 
 # the CI half-spectrum check (docs/REAL.md): rfft parity vs numpy
 # across sizes, then the bench smoke with the obs meter armed — the

@@ -585,7 +585,9 @@ def serve_load_main(args) -> int:
 
     asyncio.run(run_all())
 
-    completed = [r for r in rows if "p99_ms" in r]
+    # latency fields are always present (stable row schema); a cell
+    # with no completions reports them None
+    completed = [r for r in rows if r.get("p99_ms") is not None]
     record = {
         "metric": "serve_slo_p99_ms",
         "value": max((r["p99_ms"] for r in completed), default=None),
@@ -610,6 +612,76 @@ def serve_load_main(args) -> int:
             export.write_chrome_trace(args.trace_out)
     emit_record(record)
     return 0
+
+
+def serve_mesh_main(args) -> int:
+    """``--serve-mesh``: the mesh chaos SLO row set (docs/SERVING.md).
+
+    Drives the open-loop chaos load (serve/loadgen.py,
+    ``run_mesh_chaos_load``) against a warmed virtual device mesh with
+    a MID-RUN DEVICE KILL, and emits ONE BENCH-round JSON line whose
+    headline is the post-kill p99 and whose ``serve_mesh`` row set
+    carries per-device utilization plus the pre/post-kill p99 split —
+    the rows ``analyze.loader`` parses so ``pifft analyze gate`` can
+    hold a floor on post-kill p99 across rounds.  The kill is the
+    point: the record tags ``degraded`` and exits 0 — re-routing under
+    failure is the behavior being measured, not an error."""
+    import asyncio
+
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.analyze.records import (
+        emit_record,
+        env_fingerprint,
+    )
+    from cs87project_msolano2_tpu.serve import MeshConfig, MeshDispatcher
+    from cs87project_msolano2_tpu.serve.cli import MESH_SMOKE_SPECS
+    from cs87project_msolano2_tpu.serve.loadgen import (
+        mesh_report_rows,
+        run_mesh_chaos_load,
+    )
+
+    smoke = args.smoke
+    rps = (args.load_rps or [120.0 if smoke else 400.0])[0]
+    duration = args.load_duration or (1.2 if smoke else 5.0)
+    cfg = MeshConfig(devices=8, max_batch=2, max_wait_ms=5.0,
+                     queue_depth=64)
+    specs = list(MESH_SMOKE_SPECS)
+
+    async def run():
+        async with MeshDispatcher(cfg, specs) as mesh:
+            return await run_mesh_chaos_load(mesh, specs, rps=rps,
+                                             duration_s=duration,
+                                             kill_at_frac=0.5)
+
+    report = asyncio.run(run())
+    rows = mesh_report_rows(report)
+    record = {
+        "metric": "serve_mesh_p99_post_kill_ms",
+        "value": report["p99_post_kill_ms"],
+        "unit": "ms",
+        "serve_mesh": rows,
+        "env": env_fingerprint(smoke=smoke),
+    }
+    if smoke:
+        record["smoke"] = True
+    if report["failover_tagged"] or report["failed"] \
+            or report["degraded"]:
+        record["degraded"] = True
+    if report["problems"]:
+        # a wrong ANSWER (unlike a killed device) is a real failure:
+        # report it in the record and the exit code
+        record["problems"] = report["problems"]
+    if obs.enabled():
+        record["run"] = obs.run_id()
+        from cs87project_msolano2_tpu.obs import export, metrics
+
+        obs.emit("env", **record["env"])
+        obs.emit("metrics", snapshot=metrics.snapshot())
+        obs.flush()
+        if args.trace_out:
+            export.write_chrome_trace(args.trace_out)
+    emit_record(record)
+    return 1 if report["problems"] else 0
 
 
 def measure_sixstep_smoke(n: int) -> dict:
@@ -695,6 +767,12 @@ def main(argv=None) -> int:
                          "(default: the tier's standard ladder)")
     ap.add_argument("--load-duration", type=float, default=None,
                     metavar="S", help="serve-load: seconds per cell")
+    ap.add_argument("--serve-mesh", action="store_true",
+                    help="run the mesh chaos SLO suite: open-loop "
+                         "load over a virtual 8-device mesh with a "
+                         "mid-run device kill; emits the serve_mesh "
+                         "row set (per-device utilization, "
+                         "pre/post-kill p99 — docs/SERVING.md)")
     args = ap.parse_args(argv)
 
     from cs87project_msolano2_tpu import obs
@@ -706,6 +784,8 @@ def main(argv=None) -> int:
 
     if args.serve_load:
         return serve_load_main(args)
+    if args.serve_mesh:
+        return serve_mesh_main(args)
 
     n = SMOKE_N if args.smoke else N
     logns = SMOKE_LARGE_LOGNS if args.smoke else LARGE_LOGNS

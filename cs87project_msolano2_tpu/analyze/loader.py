@@ -114,6 +114,10 @@ class Sample:
     degraded: bool = False
     domain: str = "c2c"
     precision: str = "split3"
+    #: mesh-serving rows (docs/SERVING.md): per-device ``serve_mesh``
+    #: samples carry the device id they were measured on; every other
+    #: sample (and every pre-mesh committed round) stays None
+    device: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -126,6 +130,10 @@ class BenchRound:
     fingerprint: Fingerprint
     rc: Optional[int] = None
     note: Optional[str] = None
+    #: the raw ``serve_mesh`` row set when the round carries one
+    #: (``bench.py --serve-mesh`` — docs/SERVING.md): per-device
+    #: utilization rows plus the kill row; empty for every other round
+    serve_mesh_rows: list = dataclasses.field(default_factory=list)
 
     def metric_names(self) -> list:
         return sorted(self.metrics)
@@ -237,6 +245,17 @@ def _numeric(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
+def _mesh_device_utils(mesh_rows) -> list:
+    """``(device, utilization)`` pairs from a serve_mesh row set —
+    THE one filter both the replicated metric (values) and the
+    device-tagged samples (ids) are derived from, so they can never
+    fall out of step and mis-attribute a device's utilization."""
+    return [(r.get("device"), float(r["utilization"]))
+            for r in mesh_rows
+            if r.get("row") == "device"
+            and _numeric(r.get("utilization"))]
+
+
 def _round_index(doc: dict, path: str) -> int:
     idx = doc.get("n")
     if isinstance(idx, int):
@@ -271,6 +290,22 @@ def load_bench_round(path: str) -> BenchRound:
         elif isinstance(val, list) and val and all(_numeric(v)
                                                   for v in val):
             metrics[key] = [float(v) for v in val]
+    # the serve_mesh row set (docs/SERVING.md): per-device utilization
+    # becomes ONE replicated metric (the balance distribution) and the
+    # kill row's p99 split becomes scalar metrics — the fields a
+    # future `analyze gate` holds floors on (post-kill p99)
+    mesh_rows = parsed.get("serve_mesh")
+    mesh_rows = [r for r in mesh_rows if isinstance(r, dict)] \
+        if isinstance(mesh_rows, list) else []
+    utils = _mesh_device_utils(mesh_rows)
+    if utils:
+        metrics["serve_mesh_utilization"] = [u for _d, u in utils]
+    for r in mesh_rows:
+        if r.get("row") != "kill":
+            continue
+        for key in ("p99_pre_kill_ms", "p99_post_kill_ms"):
+            if _numeric(r.get(key)):
+                metrics[f"serve_mesh_{key}"] = float(r[key])
     # fingerprint: the stamped env when present, else backfill from the
     # record's smoke flag and the platform banner in the captured tail
     env = parsed.get("env") if isinstance(parsed.get("env"), dict) \
@@ -286,7 +321,8 @@ def load_bench_round(path: str) -> BenchRound:
                       rc=doc.get("rc") if isinstance(doc.get("rc"), int)
                       else None,
                       note=doc.get("note") if isinstance(doc.get("note"),
-                                                         str) else None)
+                                                         str) else None,
+                      serve_mesh_rows=mesh_rows)
 
 
 def load_bench_rounds(paths) -> list:
@@ -316,6 +352,18 @@ def bench_samples(rnd: BenchRound) -> list:
     "split3"; replicated metrics flatten with rep indices)."""
     out = []
     for name, val in rnd.metrics.items():
+        if name == "serve_mesh_utilization":
+            # per-device rows: keep the device identity on each sample
+            # (the replicated metric itself still feeds the gate) —
+            # ids and values come from the SAME pair list, so they
+            # cannot skew against each other
+            pairs = _mesh_device_utils(rnd.serve_mesh_rows)
+            for rep, (device, v) in enumerate(pairs):
+                out.append(Sample(
+                    source="bench", metric=name, value=v, rep=rep,
+                    round_index=rnd.index,
+                    fingerprint=rnd.fingerprint, device=device))
+            continue
         domain = "c2c"
         precision = "split3"
         m = _LOGN_METRIC.match(name)

@@ -468,8 +468,13 @@ class BlockingCallInAsyncServePath(Rule):
         # loop discipline is the serving package's, not the project's.
         # Anchored on a path SEGMENT (matched against the absolute
         # path, which always has a leading separator): a checkout
-        # under e.g. ~/fft-serve/ must not drag the whole tree in
-        "paths": ("*/serve/*",),
+        # under e.g. ~/fft-serve/ must not drag the whole tree in.
+        # The mesh routing path (serve/mesh.py, serve/router.py) is
+        # named EXPLICITLY besides the package glob: a blocking call
+        # in the placement/failover path stalls every device's queue
+        # at once, so those files must stay in scope even if the
+        # package glob is ever narrowed
+        "paths": ("*/serve/*", "*/serve/mesh.py", "*/serve/router.py"),
         "blocking_calls": ("time.sleep", "socket.create_connection",
                            "subprocess.run", "subprocess.call",
                            "subprocess.check_call",

@@ -278,6 +278,13 @@ _KIND_PAYLOAD = {
     "collective_heartbeat": ("label", "waits", "deadline_s"),
     "collective_abandoned": ("label", "waits", "deadline_s"),
     "fallback_consensus": ("label", "epoch", "agreed"),
+    # the mesh-serving kinds (docs/SERVING.md): a placement names its
+    # device and why, a device death its fault kind, a failover how
+    # many requests moved, a handoff who inherited the warm cache
+    "serve_placement": ("device", "shape", "reason"),
+    "serve_device_failed": ("device", "kind"),
+    "serve_failover": ("device", "requests"),
+    "serve_handoff": ("device", "successor", "shape"),
 }
 
 

@@ -63,6 +63,15 @@ KNOWN_SITES = {
              "serving path's fallback rungs stay clean, so chaos "
              "degrades the service instead of killing it — "
              "docs/SERVING.md)",
+    "device": "serve/mesh.py per-device batch execution — ONE SITE PER "
+              "MESH DEVICE, named device<K> (device0, device1, ...): "
+              "PIFFT_FAULT=device3:permanent kills mesh device 3 "
+              "mid-batch, device*:... strikes any device, and a stall "
+              "spec wedges the device until the batch supervisor "
+              "aborts it; either way the mesh marks the device dead "
+              "through consensus and re-routes its queued and "
+              "in-flight requests to survivors (docs/SERVING.md, "
+              "failover)",
 }
 
 KINDS = ("transient", "capacity", "permanent", "timeout", "stall")

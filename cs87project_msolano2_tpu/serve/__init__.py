@@ -30,6 +30,14 @@ turns CONCURRENT REQUESTS into BATCHED KERNEL INVOCATIONS:
                    ``bench.py --serve-load``.
 * ``protocol``   — the length-prefixed JSON socket front behind
                    ``pifft serve``.
+* ``mesh``       — per-device worker pools behind the same front
+                   (``MeshDispatcher``): shape-affinity routing,
+                   priority admission + tenant quotas, self-healing
+                   device failover with consensus re-routing, and
+                   warm-cache handoff on planned drain.
+* ``router``     — the placement (warmth + least-loaded) and
+                   admission (priority classes, per-tenant quota)
+                   policies the mesh runs on.
 
 Check rule PIF107 (docs/CHECKS.md) polices this package: no blocking
 ``time.sleep``/sync I/O inside its async paths — all waiting funnels
@@ -41,6 +49,7 @@ from __future__ import annotations
 from .batcher import BatchRunner, GroupKey, batch_bucket  # noqa: F401
 from .buffers import BufferPool  # noqa: F401
 from .dispatcher import (  # noqa: F401
+    PRIORITIES,
     Dispatcher,
     DispatcherClosed,
     QueueFull,
@@ -51,5 +60,22 @@ from .dispatcher import (  # noqa: F401
     ServeError,
     ShapeNotServed,
 )
+from .mesh import (  # noqa: F401
+    DeviceFailure,
+    MeshConfig,
+    MeshDevice,
+    MeshDispatcher,
+)
+from .router import (  # noqa: F401
+    AdmissionController,
+    NoDeviceAvailable,
+    QuotaExceeded,
+    Router,
+)
 from .shapes import ShapeSpec, load_shapes, warm  # noqa: F401
-from .slo import LatencyStats, format_summary, percentile  # noqa: F401
+from .slo import (  # noqa: F401
+    LatencyStats,
+    format_summary,
+    percentile,
+    percentile_or_none,
+)

@@ -109,6 +109,30 @@ class BatchRunner:
         self.pool = pool or BufferPool()
         self._callables: dict = {}
 
+    def cached_groups(self) -> set:
+        """GroupKeys with a compiled callable in this runner — the
+        hottest warmth signal the mesh router reads (docs/SERVING.md):
+        a group cached here serves its next batch with zero trace or
+        plan-resolution cost."""
+        return {key[0] for key in self._callables}
+
+    def adopt_callables(self, other: "BatchRunner",
+                        group: Optional[GroupKey] = None) -> int:
+        """Warm-cache handoff (docs/SERVING.md, drain): copy `other`'s
+        compiled callables — all of them, or one `group`'s — into this
+        runner without displacing anything already here.  The jitted
+        executables are process-global, so a drained device's compile
+        investment moves to its successor instead of dying with it.
+        Returns how many entries were adopted."""
+        adopted = 0
+        for key, val in list(other._callables.items()):
+            if group is not None and key[0] != group:
+                continue
+            if key not in self._callables:
+                self._callables[key] = val
+                adopted += 1
+        return adopted
+
     # ---------------------------------------------------- callables
 
     def _plan_for(self, group: GroupKey, bucket: int):

@@ -79,3 +79,12 @@ class BufferPool:
             pooled = sum(len(v) for v in self._free.values())
             return {"hits": self.hits, "misses": self.misses,
                     "pooled": pooled}
+
+    def pooled_shapes(self) -> set:
+        """Shapes with at least one pooled buffer — the staging-side
+        warmth signal the mesh router reads (docs/SERVING.md): a
+        device whose pool holds a ``(bucket, width)`` pair for a group
+        has staged that group before."""
+        with self._lock:
+            return {shape for (shape, _dt), free in self._free.items()
+                    if free}

@@ -1,8 +1,22 @@
-"""`pifft serve` — run the serving front door, or its offline smoke.
+"""`pifft serve` — run the serving front door, or its offline smokes.
 
 Server mode binds the length-prefixed JSON socket front
 (:mod:`.protocol`) on ``--host``/``--port``, warms ``--shapes`` at
-startup, and serves until interrupted.
+startup, and serves until interrupted.  ``--devices N`` puts the
+:class:`~.mesh.MeshDispatcher` behind the same socket: per-device
+worker pools, shape-affinity routing, priority admission, and
+self-healing failover (docs/SERVING.md, mesh section).
+
+``--mesh-smoke`` is the mesh CI gate (``make serve-mesh-smoke``): a
+virtual 8-device CPU mesh warmed with an 8-shape set, driven by the
+open-loop chaos load with a MID-RUN DEVICE KILL, then a planned
+journaled drain — and the run FAILS unless zero requests were
+dropped, every response verifies against numpy, the re-routed
+requests carry a ``failover:*`` trail, consensus was reached before
+the re-route, utilization stayed within the spread bound, the
+pre/post-kill p99 pair is recorded, shape affinity held (the
+placement counter), and the drained device's successor serves its
+groups without re-tuning.
 
 ``--smoke`` is the CI gate (``make serve-smoke``): an in-process
 dispatcher on this host's backend (CPU in CI) is hit with k concurrent
@@ -42,6 +56,22 @@ SMOKE_SPECS = (ShapeSpec(n=4096), ShapeSpec(n=1024),
                ShapeSpec(n=2048, layout="pi"),
                ShapeSpec(n=1024, domain="r2c"))
 
+#: the mesh smoke's served set: 8 equal-cost groups (one warmed per
+#: virtual device) so the utilization-spread bound is meaningful —
+#: same n, natural/pi layouts crossed with the fp32-storage precision
+#: modes (bf16 is excluded here: its looser budget would mask a
+#: wrong-rows bug the spread run exists to catch)
+MESH_SMOKE_SPECS = tuple(
+    ShapeSpec(n=512, layout=lay, precision=p)
+    for lay in ("natural", "pi")
+    for p in ("split3", "default", "fp32", "highest"))
+
+#: utilization balance bound the mesh smoke asserts: no serving
+#: device may be busier than this multiple of the mean (the post-kill
+#: survivor legitimately carries the dead device's group, so the
+#: bound is loose enough for 2x plus jitter)
+MESH_UTIL_SPREAD = 3.0
+
 
 def _build_config(args) -> ServeConfig:
     cfg = ServeConfig()
@@ -66,6 +96,18 @@ def serve_main(argv) -> int:
                     help="in-process CI smoke: concurrent mixed-shape "
                          "requests, coalescing + schema assertions, "
                          "per-shape p50/p99 report")
+    ap.add_argument("--mesh-smoke", action="store_true",
+                    help="in-process mesh CI gate: virtual device "
+                         "mesh under open-loop load with a mid-run "
+                         "device kill and a journaled drain "
+                         "(make serve-mesh-smoke)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="serve on a device mesh of this size "
+                         "(MeshDispatcher; mesh-smoke default 8)")
+    ap.add_argument("--mesh-rps", type=float, default=120.0,
+                    help="mesh-smoke: offered load (requests/s)")
+    ap.add_argument("--mesh-duration", type=float, default=1.2,
+                    help="mesh-smoke: seconds of offered load")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8571)
     ap.add_argument("--shapes", default=None, metavar="FILE",
@@ -94,12 +136,20 @@ def serve_main(argv) -> int:
     else:
         specs = list(SMOKE_SPECS) if args.smoke else []
 
+    if args.mesh_smoke:
+        return _mesh_smoke(cfg, specs or list(MESH_SMOKE_SPECS), args)
     if args.smoke:
         return _smoke(cfg, specs, args)
 
     from .protocol import serve_socket
 
-    dispatcher = Dispatcher(cfg, specs)
+    if args.devices and args.devices > 1:
+        from .mesh import MeshConfig, MeshDispatcher
+
+        mesh_cfg = MeshConfig(**vars(cfg), devices=args.devices)
+        dispatcher = MeshDispatcher(mesh_cfg, specs)
+    else:
+        dispatcher = Dispatcher(cfg, specs)
 
     async def main():
         async with dispatcher:
@@ -244,7 +294,7 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
             "events": len(snapshot),
             "schema_invalid_events": bad_events,
             "stats": summary,
-            "buffers": d.runner.pool.stats(),
+            "buffers": d.buffer_stats(),
             "problems": problems,
         }, indent=1, sort_keys=True))
     else:
@@ -252,10 +302,238 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
         print(f"# serve smoke: {k} concurrent {label} requests -> "
               f"{batches} kernel invocation(s); "
               f"{len(snapshot)} event(s), {bad_events} schema-invalid; "
-              f"buffers {d.runner.pool.stats()}")
+              f"buffers {d.buffer_stats()}")
         for p in problems:
             print(f"# FAIL: {p}", file=sys.stderr)
     if problems:
         return 1
     print("# serve smoke ok", file=sys.stderr)
+    return 0
+
+
+def _mesh_smoke(cfg: ServeConfig, specs, args) -> int:
+    """The ``make serve-mesh-smoke`` gate (module docstring): run the
+    chaos load + journaled drain on a virtual mesh and assert the
+    whole acceptance list in-process."""
+    import os
+    import tempfile
+
+    from .. import obs
+    from ..obs import events as obs_events
+    from ..obs import metrics
+    from ..resilience.journal import load_records
+    from .loadgen import (
+        _group_for,
+        run_mesh_chaos_load,
+        verify_response,
+    )
+    from .mesh import MeshConfig, MeshDispatcher
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+
+    mesh_cfg = MeshConfig(**vars(cfg),
+                          devices=args.devices or 8)
+    if args.max_batch is None:
+        mesh_cfg.max_batch = 2   # small buckets: few compiled programs
+    if args.max_wait_ms is None:
+        mesh_cfg.max_wait_ms = 5.0
+    journal_fd, journal_path = tempfile.mkstemp(
+        prefix="pifft-mesh-drain-", suffix=".jsonl")
+    os.close(journal_fd)
+    os.unlink(journal_path)  # the drain creates it; start clean
+
+    problems: list = []
+    rng = np.random.default_rng(7)
+
+    async def main():
+        async with MeshDispatcher(mesh_cfg, specs) as mesh:
+            # --- shape affinity: a warmed group's repeat traffic
+            # lands on the SAME device (asserted from the placement
+            # counter, not a side channel)
+            g0 = _group_for(specs[0])
+            home = mesh.router.route(g0, record=False)
+            xr = rng.standard_normal(specs[0].n).astype(np.float32)
+            xi = rng.standard_normal(specs[0].n).astype(np.float32)
+            for _ in range(2):
+                resp = await mesh.submit(
+                    xr, xi, layout=specs[0].layout,
+                    precision=specs[0].precision,
+                    domain=specs[0].domain)
+                if resp.device != home.id:
+                    problems.append(
+                        f"affinity broken: warmed {g0.label()} served "
+                        f"by {resp.device}, warm home is {home.id}")
+            affine = metrics.counter_value(
+                "pifft_serve_placement_total", device=home.id,
+                reason="affinity")
+            if affine < 2:
+                problems.append(
+                    f"placement counter shows {affine} affinity "
+                    f"placements on {home.id}, want >= 2")
+
+            # --- the chaos load with the mid-run device kill
+            report = await run_mesh_chaos_load(
+                mesh, specs, rps=args.mesh_rps,
+                duration_s=args.mesh_duration, kill_at_frac=0.5)
+            problems.extend(report["problems"])
+            if report["failed"]:
+                problems.append(
+                    f"{report['failed']} request(s) DROPPED (failed "
+                    f"beyond backpressure) — the mesh owes zero")
+            if report["killed_device"] is None:
+                problems.append("the mid-run kill never armed")
+            elif mesh.device(report["killed_device"]).state != "dead":
+                problems.append(
+                    f"killed device {report['killed_device']} is "
+                    f"{mesh.device(report['killed_device']).state}, "
+                    f"not dead")
+            if report["failover_tagged"] < 1:
+                problems.append(
+                    "no response carries a failover:* degrade trail — "
+                    "the re-route was never exercised")
+            if report["p99_pre_kill_ms"] is None \
+                    or report["p99_post_kill_ms"] is None:
+                problems.append(
+                    f"pre/post-kill p99 missing: "
+                    f"{report['p99_pre_kill_ms']} / "
+                    f"{report['p99_post_kill_ms']}")
+            served = [d for d in report["utilization"].values()
+                      if d["served"] > 0]
+            if len(served) < mesh_cfg.devices - 2:
+                problems.append(
+                    f"only {len(served)}/{mesh_cfg.devices} devices "
+                    f"served traffic — the warm spread did not hold")
+            busys = [d["busy_s"] for d in served]
+            if busys and max(busys) > MESH_UTIL_SPREAD \
+                    * (sum(busys) / len(busys)):
+                problems.append(
+                    f"utilization spread violated: max busy "
+                    f"{max(busys):.4f}s > {MESH_UTIL_SPREAD} x mean "
+                    f"{sum(busys) / len(busys):.4f}s")
+
+            # --- planned drain with journaled warm-cache handoff
+            victim_id = report["killed_device"]
+            drain_dev = next(
+                (d for d in mesh.devices
+                 if d.state == "healthy" and d.warm_groups), None)
+            if drain_dev is None:
+                # a structured FAIL, not a bare StopIteration (which
+                # asyncio would surface as a RuntimeError): with no
+                # healthy warmed survivor there is nothing to drain —
+                # itself a gate failure on any mesh bigger than 1
+                problems.append(
+                    "no healthy warmed device left to drain — the "
+                    "kill emptied the mesh")
+                return report, {"handoffs": [], "journal": None}, \
+                    mesh.utilization(), victim_id
+            drain_group = sorted(drain_dev.warm_groups,
+                                 key=lambda g: g.label())[0]
+            drain_report = await mesh.drain_device(
+                drain_dev.id, journal_path=journal_path)
+            if not drain_report["handoffs"]:
+                problems.append(
+                    f"drain of {drain_dev.id} handed off nothing")
+            successors = {h["group"]: h["successor"]
+                          for h in drain_report["handoffs"]}
+            spec = next(s for s in specs
+                        if _group_for(s) == drain_group)
+            dxr = rng.standard_normal(spec.n).astype(np.float32)
+            dxi = rng.standard_normal(spec.n).astype(np.float32)
+            resp = await mesh.submit(dxr, dxi, layout=spec.layout,
+                                     precision=spec.precision,
+                                     domain=spec.domain)
+            want = successors.get(drain_group.label())
+            if resp.device != want:
+                problems.append(
+                    f"post-drain {drain_group.label()} served by "
+                    f"{resp.device}, handoff successor is {want}")
+            if resp.degraded:
+                problems.append(
+                    f"post-drain response degraded ({resp.degrade}) — "
+                    f"a planned drain must not cost quality")
+            problem = verify_response(spec.n, spec.layout, spec.domain,
+                                      False, spec.precision, dxr, dxi,
+                                      resp)
+            if problem:
+                problems.append(f"post-drain {problem}")
+            return report, drain_report, mesh.utilization(), victim_id
+
+    try:
+        report, drain_report, util, _victim = asyncio.run(main())
+
+        # --- the journal must carry the drain (kill-mid-drain resume
+        # relies on it): handoff cells plus the completion marker
+        records, dropped = load_records(journal_path)
+        cells = {r.get("cell", "") for r in records}
+        if not any(c.startswith("handoff:") for c in cells):
+            problems.append(f"drain journal {journal_path} holds no "
+                            f"handoff cells ({sorted(cells)})")
+        if not any(c.startswith("drained:") for c in cells):
+            problems.append("drain journal lacks the drained: "
+                            "completion marker")
+        if dropped:
+            problems.append(f"drain journal has {dropped} corrupt "
+                            f"line(s)")
+        # --- consensus ran before the re-route, and every event is
+        # schema-valid
+        snapshot = obs_events.snapshot()
+        consensus = [r for r in snapshot
+                     if r.get("kind") == "fallback_consensus"
+                     and str(r.get("payload", {}).get("label", ""))
+                     .startswith("serve-mesh:")]
+        if not consensus:
+            problems.append("no serve-mesh fallback_consensus event — "
+                            "the failover skipped the PR-8 consensus "
+                            "path")
+        bad_events = 0
+        for rec in snapshot:
+            for p in obs_events.validate_event(rec):
+                bad_events += 1
+                problems.append(f"event seq={rec.get('seq')}: {p}")
+    finally:
+        # the gate must not leak process-global state or tmp files —
+        # even when the run itself blew up: the obs disarm and the
+        # journal cleanup cannot depend on a clean pass
+        if owned:
+            obs.disable()
+        try:
+            os.unlink(journal_path)
+        except OSError:
+            pass
+
+    out = {
+        "ok": not problems,
+        "devices": mesh_cfg.devices,
+        "report": {k: v for k, v in report.items()
+                   if k != "utilization"},
+        "utilization": util,
+        "drain": drain_report,
+        "journal_cells": sorted(cells),
+        "consensus_events": len(consensus),
+        "events": len(snapshot),
+        "schema_invalid_events": bad_events,
+        "problems": problems,
+    }
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        print(f"# serve mesh smoke: {report['requests']} arrivals at "
+              f"{report['offered_rps']} rps over "
+              f"{mesh_cfg.devices} devices; "
+              f"{report['completed']} completed, "
+              f"{report['rejected']} rejected, "
+              f"{report['failed']} failed; kill at "
+              f"t={report['t_kill_s']}s on {report['killed_device']} "
+              f"({report['failover_tagged']} failover-tagged); p99 "
+              f"{report['p99_pre_kill_ms']} -> "
+              f"{report['p99_post_kill_ms']} ms; drain handed "
+              f"{len(drain_report['handoffs'])} group(s) "
+              f"(journal {drain_report['journal']})")
+        for p in problems:
+            print(f"# FAIL: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("# serve mesh smoke ok", file=sys.stderr)
     return 0

@@ -64,6 +64,18 @@ from .slo import LatencyStats
 #: worker-queue shutdown sentinel
 _CLOSE = object()
 
+#: admission priority classes, weakest first.  Priorities layer on the
+#: bounded-queue backpressure (docs/SERVING.md): each class may fill
+#: its group's queue only up to its ceiling fraction of
+#: ``queue_depth``, so LOW-priority load sheds FIRST as pressure
+#: builds while high-priority traffic keeps its full headroom; and a
+#: rejection's ``retry_after_ms`` is scaled per class, so shed
+#: low-priority clients back off harder than the high-priority ones
+#: the server wants back soonest.
+PRIORITIES = ("low", "normal", "high")
+PRIORITY_ADMIT_FILL = {"low": 0.5, "normal": 1.0, "high": 1.0}
+PRIORITY_RETRY_SCALE = {"low": 4.0, "normal": 1.0, "high": 0.5}
+
 
 class ServeError(Exception):
     """Base of the structured serving errors: everything a caller (or
@@ -139,6 +151,14 @@ class Request:
     xi: np.ndarray
     t_submit: float
     future: asyncio.Future
+    #: admission class (PRIORITIES) and tenant identity — recorded on
+    #: every request; the mesh dispatcher's admission acts on them
+    priority: str = "normal"
+    tenant: str = "default"
+    #: per-REQUEST degradation trail (e.g. ``failover:<device>`` when a
+    #: mesh re-routes it off a dead device) — merged into the response's
+    #: degrade trail on delivery, on top of whatever the batch earned
+    trail: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -155,6 +175,9 @@ class Response:
     plan_variant: str
     degraded: bool = False
     degrade: list = dataclasses.field(default_factory=list)
+    #: which mesh device served the batch (None on the single-device
+    #: dispatcher — docs/SERVING.md, mesh section)
+    device: Optional[str] = None
 
     def to_record(self, arrays: bool = False) -> dict:
         rec = {
@@ -167,6 +190,8 @@ class Response:
         }
         if self.degrade:
             rec["degrade"] = list(self.degrade)
+        if self.device is not None:
+            rec["device"] = self.device
         if arrays:
             rec["yr"] = np.asarray(self.yr, np.float64).tolist()
             rec["yi"] = np.asarray(self.yi, np.float64).tolist()
@@ -216,39 +241,48 @@ class Dispatcher:
 
     async def close(self) -> None:
         """Stop accepting, drain every queue, join the workers.
-        Requests admitted before close are served; later submits raise
-        :class:`DispatcherClosed`."""
+        Requests admitted before close are served (the workers keep
+        draining past the shutdown sentinel until their queues are
+        empty); later submits raise :class:`DispatcherClosed`.  Any
+        request a racing submit still managed to slip behind an
+        exiting worker gets a structured :class:`DispatcherClosed`
+        rejection — a shutdown must never orphan a future."""
         self._closing = True
         for q in self._queues.values():
             q.put_nowait(_CLOSE)
         if self._workers:
             await asyncio.gather(*self._workers.values(),
                                  return_exceptions=True)
+        for q in self._queues.values():
+            while True:
+                try:
+                    item = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if item is _CLOSE or item.future.done():
+                    continue
+                item.future.set_exception(DispatcherClosed(
+                    "dispatcher shut down while the request was queued"))
+
+    async def drain(self) -> None:
+        """Alias for :meth:`close`: serve everything admitted, then
+        stop (the name the ops runbooks use)."""
+        await self.close()
 
     # ----------------------------------------------------- admission
 
-    async def submit(self, xr, xi=None, layout: str = "natural",
-                     precision: Optional[str] = None,
-                     inverse: bool = False,
-                     domain: str = "c2c") -> Response:
-        """Serve one n-point transform of float planes ``(n,)``.
-        Raises a :class:`ServeError` subclass — never hangs — when the
-        request cannot be admitted or no rung could serve it.
-
-        `domain` picks the transform family (docs/REAL.md): "c2c"
-        (default — both planes required), "r2c" (real forward: `xr` is
-        the length-n real signal, `xi` may be omitted and must
-        otherwise be zeros — a nonzero imaginary plane on a
-        declared-real request would be silently dropped, which is a
-        wrong answer, so it is refused instead), or "c2r" (the
-        inverse: the planes carry the n//2+1 half-spectrum bins and
-        the response is the length-n real signal)."""
-        if self._closing:
-            raise DispatcherClosed("dispatcher is shut down")
+    def _validated(self, xr, xi, layout: str, precision: Optional[str],
+                   inverse: bool, domain: str, priority: str) -> tuple:
+        """Shared request validation (single-device and mesh
+        dispatchers): returns ``(xr, xi, group)`` float32 planes plus
+        the coalescing key, or raises a structured
+        :class:`ServeError`."""
         from ..plans.core import DOMAINS
 
         if domain not in DOMAINS:
             raise ServeError(f"domain={domain!r} not in {DOMAINS}")
+        if priority not in PRIORITIES:
+            raise ServeError(f"priority={priority!r} not in {PRIORITIES}")
         xr = np.asarray(xr, np.float32)
         if xi is None:
             if domain != "r2c":
@@ -289,27 +323,77 @@ class Dispatcher:
         group = GroupKey(n=n, layout=layout,
                          precision=precision or "split3",
                          inverse=inverse, domain=domain)
+        return xr, xi, group
+
+    def _check_served(self, group: GroupKey) -> None:
+        """Strict-shape refusal (shared with the mesh dispatcher)."""
         if self.config.strict_shapes and \
-                (n, layout, group.precision, domain) not in self._served:
+                (group.n, group.layout, group.precision,
+                 group.domain) not in self._served:
             raise ShapeNotServed(
                 f"shape {group.label()} is not in the warmed set "
                 f"({len(self.specs)} shape(s)); add it to the shape "
                 f"file or serve without strict_shapes")
+
+    def _admit(self, group: GroupKey, q, priority: str) -> None:
+        """Class-aware bounded admission: each priority class may fill
+        the group's queue only to its ceiling (PRIORITY_ADMIT_FILL ×
+        ``queue_depth``), so low-priority load sheds first under
+        pressure, with its ``retry_after_ms`` scaled to back off
+        harder.  Raises :class:`QueueFull`; never waits."""
+        cap = max(1, int(self.config.queue_depth
+                         * PRIORITY_ADMIT_FILL[priority]))
+        if q.qsize() < cap:
+            return
+        label = group.label()
+        self.stats.record_rejected(label)
+        metrics.inc("pifft_serve_rejected_total", shape=label)
+        if cap < self.config.queue_depth:
+            # shed below the hard bound: the class ceiling did it
+            metrics.inc("pifft_serve_shed_total", priority=priority)
+        retry_ms = self._retry_after_ms(group, q, priority)
+        events.emit("serve_reject", cell={"n": group.n}, shape=label,
+                    depth=q.qsize(), retry_after_ms=retry_ms,
+                    priority=priority)
+        raise QueueFull(
+            f"queue for {label} is at the {priority}-class depth "
+            f"{cap}/{self.config.queue_depth}; retry in ~{retry_ms} ms",
+            retry_after_ms=retry_ms)
+
+    async def submit(self, xr, xi=None, layout: str = "natural",
+                     precision: Optional[str] = None,
+                     inverse: bool = False,
+                     domain: str = "c2c",
+                     priority: str = "normal",
+                     tenant: str = "default") -> Response:
+        """Serve one n-point transform of float planes ``(n,)``.
+        Raises a :class:`ServeError` subclass — never hangs — when the
+        request cannot be admitted or no rung could serve it.
+
+        `domain` picks the transform family (docs/REAL.md): "c2c"
+        (default — both planes required), "r2c" (real forward: `xr` is
+        the length-n real signal, `xi` may be omitted and must
+        otherwise be zeros — a nonzero imaginary plane on a
+        declared-real request would be silently dropped, which is a
+        wrong answer, so it is refused instead), or "c2r" (the
+        inverse: the planes carry the n//2+1 half-spectrum bins and
+        the response is the length-n real signal).
+
+        `priority` is the admission class (PRIORITIES): low-priority
+        load sheds first under pressure with a harder retry backoff.
+        `tenant` names the quota bucket; the mesh dispatcher enforces
+        per-tenant quotas on it (docs/SERVING.md)."""
+        if self._closing:
+            raise DispatcherClosed("dispatcher is shut down")
+        xr, xi, group = self._validated(xr, xi, layout, precision,
+                                        inverse, domain, priority)
+        self._check_served(group)
         q = self._ensure_worker(group)
-        if q.qsize() >= self.config.queue_depth:
-            label = group.label()
-            self.stats.record_rejected(label)
-            metrics.inc("pifft_serve_rejected_total", shape=label)
-            retry_ms = self._retry_after_ms(group, q)
-            events.emit("serve_reject", cell={"n": n}, shape=label,
-                        depth=q.qsize(), retry_after_ms=retry_ms)
-            raise QueueFull(
-                f"queue for {label} is at depth "
-                f"{self.config.queue_depth}; retry in ~{retry_ms} ms",
-                retry_after_ms=retry_ms)
+        self._admit(group, q, priority)
         req = Request(rid=next(self._rid), group=group, xr=xr, xi=xi,
                       t_submit=clock(),
-                      future=asyncio.get_running_loop().create_future())
+                      future=asyncio.get_running_loop().create_future(),
+                      priority=priority, tenant=tenant)
         metrics.inc("pifft_serve_requests_total", shape=group.label())
         q.put_nowait(req)
         return await req.future
@@ -325,9 +409,16 @@ class Dispatcher:
                 .create_task(self._worker(group, q))
         return q
 
-    def _retry_after_ms(self, group: GroupKey, q) -> float:
+    def _retry_after_ms(self, group: GroupKey, q,
+                        priority: str = "normal") -> float:
         ema = self._ema_ms.get(group, self.config.max_wait_ms)
-        return round(max(1.0, ema * (q.qsize() + 1)), 3)
+        scale = PRIORITY_RETRY_SCALE.get(priority, 1.0)
+        return round(max(1.0, ema * (q.qsize() + 1) * scale), 3)
+
+    def buffer_stats(self) -> dict:
+        """Staging-pool reuse stats (the wire ``stats`` op; the mesh
+        dispatcher aggregates its per-device pools here)."""
+        return self.runner.pool.stats()
 
     def _admission(self, group: GroupKey, q) -> tuple:
         """(window_s, forced_rung, level_tag) for the batch about to be
@@ -351,28 +442,41 @@ class Dispatcher:
         except asyncio.TimeoutError:
             return None
 
-    async def _worker(self, group: GroupKey, q) -> None:
+    async def _worker(self, group: GroupKey, q, device=None) -> None:
         closing = False
-        while not closing:
-            req = await q.get()
+        while True:
+            if closing:
+                # past the shutdown sentinel: serve what is already
+                # queued (admitted before close), then exit — a
+                # request behind the sentinel must complete, never
+                # orphan its future
+                try:
+                    req = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            else:
+                req = await q.get()
             if req is _CLOSE:
-                break
+                closing = True
+                continue
             batch = [req]
             window_s, rung, level = self._admission(group, q)
+            if closing:
+                window_s = 0.0  # shutting down: ship what's here
             deadline = clock() + window_s
             while len(batch) < self.config.max_batch:
                 try:
                     nxt = q.get_nowait()
                 except asyncio.QueueEmpty:
                     remaining = deadline - clock()
-                    if remaining <= 0:
+                    if remaining <= 0 or closing:
                         break
                     nxt = await self._wait_for_request(q, remaining)
                     if nxt is None:
                         break
                 if nxt is _CLOSE:
                     closing = True
-                    break
+                    continue  # keep collecting what is already queued
                 batch.append(nxt)
             if level is not None:
                 metrics.inc("pifft_serve_admission_degrade_total",
@@ -380,17 +484,34 @@ class Dispatcher:
                 events.emit("serve_degrade", cell={"n": group.n},
                             shape=group.label(), level=level,
                             depth=q.qsize())
-            await self._run_batch(group, batch, rung, level)
+            await self._run_batch(group, batch, rung, level, device)
 
-    async def _run_batch(self, group: GroupKey, batch, rung, level):
+    def _is_device_failure(self, exc: Exception) -> bool:
+        """Hook: exceptions the batch path must NOT absorb into
+        per-request failures because they indict the DEVICE, not the
+        batch (the mesh dispatcher overrides — docs/SERVING.md,
+        failover)."""
+        return False
+
+    async def _invoke_batch(self, group: GroupKey, batch, rung,
+                            device=None):
+        """One coalesced kernel invocation in the executor (the event
+        loop keeps admitting mid-kernel)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None,
+            functools.partial(self.runner.run, group,
+                              [(r.xr, r.xi) for r in batch], rung))
+
+    async def _run_batch(self, group: GroupKey, batch, rung, level,
+                         device=None):
         label = group.label()
         t_start = clock()
         try:
-            outcome = await asyncio.get_running_loop().run_in_executor(
-                None,
-                functools.partial(self.runner.run, group,
-                                  [(r.xr, r.xi) for r in batch], rung))
+            outcome = await self._invoke_batch(group, batch, rung,
+                                               device)
         except Exception as e:
+            if self._is_device_failure(e):
+                raise  # the mesh's failover path owns these
             kind = classify(e).value
             events.emit("serve_error", cell={"n": group.n}, shape=label,
                         kind=kind, size=len(batch),
@@ -403,6 +524,14 @@ class Dispatcher:
                         f"({kind} {type(e).__name__}: {str(e)[:200]})",
                         kind=kind))
             return
+        self._deliver(group, batch, outcome, t_start, rung, level,
+                      device)
+
+    def _deliver(self, group: GroupKey, batch, outcome, t_start, rung,
+                 level, device=None):
+        """Build and resolve the per-request responses for one served
+        batch (shared by the single-device and mesh dispatchers)."""
+        label = group.label()
         self.stats.record_batch(label)
         # EMA of per-request service time feeds QueueFull.retry_after
         batch_ms = (clock() - t_start) * 1e3 / len(batch)
@@ -414,8 +543,12 @@ class Dispatcher:
         # level needs adding here
         tags = ([level] if level and rung is None else []) \
             + list(outcome.degrade)
-        degraded = outcome.degraded or bool(tags)
+        device_id = getattr(device, "id", None)
         for i, r in enumerate(batch):
+            # the batch tags plus this request's OWN trail (failover
+            # re-routes tag the request, not the batch it lands in)
+            rtags = list(r.trail) + list(tags)
+            degraded = outcome.degraded or bool(rtags)
             queue_s = t_start - r.t_submit
             resp = Response(
                 rid=r.rid, yr=outcome.yr[i], yi=outcome.yi[i],
@@ -423,7 +556,7 @@ class Dispatcher:
                 compute_ms=outcome.compute_s * 1e3,
                 batch_size=outcome.size,
                 plan_variant=outcome.plan_variant,
-                degraded=degraded, degrade=list(tags))
+                degraded=degraded, degrade=rtags, device=device_id)
             self.stats.record(label, queue_s, outcome.compute_s,
                               degraded=degraded)
             metrics.observe("pifft_serve_queue_wait_seconds", queue_s,
@@ -435,7 +568,8 @@ class Dispatcher:
                         queue_wait_ms=round(queue_s * 1e3, 4),
                         compute_ms=round(outcome.compute_s * 1e3, 4),
                         batch_size=outcome.size, degraded=degraded,
-                        **({"degrade": list(tags)} if tags else {}))
+                        **({"degrade": rtags} if rtags else {}),
+                        **({"device": device_id} if device_id else {}))
             if not r.future.done():
                 r.future.set_result(resp)
         metrics.observe("pifft_serve_compute_seconds", outcome.compute_s,

@@ -8,44 +8,111 @@ achieved: completed throughput, client-observed p50/p99, the
 queue-wait vs compute split from the responses, and how many arrivals
 were rejected (backpressure) or served degraded.
 
-``bench.py --serve-load`` drives this over the served shape set and
-emits the rows in the BENCH round record format.
+Row schema is STABLE: every latency field is present in every row,
+``None`` where the cell has no population to report (a cell where
+every arrival was rejected still rolls up — the summary must never
+crash on the saturation it exists to measure).
+
+``bench.py --serve-load`` drives this over the served shape set;
+:func:`run_mesh_chaos_load` is the mesh tier
+(``bench.py --serve-mesh`` / ``pifft serve --mesh-smoke``,
+docs/SERVING.md): round-robin open-loop load over a shape set spread
+across a :class:`~.mesh.MeshDispatcher`, with a MID-RUN DEVICE KILL
+through the ``device<K>`` injection site and the pre/post-kill p99
+split the ``serve_mesh`` bench rows carry.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from typing import Optional
 
 import numpy as np
 
 from ..obs.spans import clock
+from .batcher import GroupKey
 from .dispatcher import Dispatcher, QueueFull, ServeError
-from .slo import percentile
+from .slo import percentile_or_none
+
+
+def verify_response(n: int, layout: str, domain: str, inverse: bool,
+                    precision: str, xr, xi, resp) -> Optional[str]:
+    """Problem string, or None: one served response checked against
+    its domain's ``numpy.fft`` oracle (pi-layout answers are mapped
+    back to natural order first; the tolerance is the precision
+    mode's error budget, docs/PRECISION.md).  Shared by the serve
+    smokes and the mesh chaos driver — a coalesced, padded, re-routed
+    path that returns the wrong rows must FAIL, not just look slow."""
+    from ..ops.precision import error_budget
+    from ..utils import verify
+
+    got_r = np.asarray(resp.yr, np.float64)
+    got_i = np.asarray(resp.yi, np.float64)
+    xr64 = np.asarray(xr, np.float64)
+    xi64 = np.asarray(xi, np.float64) if xi is not None else None
+    if domain == "r2c":
+        if got_r.shape[-1] != n // 2 + 1:
+            return (f"response {resp.rid}: r2c answer is "
+                    f"{got_r.shape[-1]} bins, want {n // 2 + 1} "
+                    f"(half-spectrum)")
+        ref = np.fft.rfft(xr64)
+        got = got_r + 1j * got_i
+    elif domain == "c2r":
+        ref = np.fft.irfft(xr64 + 1j * xi64, n=n)
+        got = got_r
+    else:
+        z = xr64 + 1j * xi64
+        ref = np.fft.ifft(z) if inverse else np.fft.fft(z)
+        got = got_r + 1j * got_i
+        if layout == "pi":
+            got = verify.pi_layout_to_natural(got)
+    err = verify.rel_err(got, ref)
+    tol = max(1e-4, error_budget(precision))
+    if err > tol:
+        return (f"response {resp.rid} wrong: rel err {err:.3e} > "
+                f"{tol:.0e} vs numpy {domain}"
+                f"{':inv' if inverse else ''} ({precision} budget)")
+    return None
 
 
 async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
                            duration_s: float, layout: str = "natural",
                            precision: Optional[str] = None,
-                           seed: int = 0) -> dict:
+                           seed: int = 0, domain: str = "c2c",
+                           inverse: bool = False,
+                           priority: str = "normal",
+                           tenant: str = "default") -> dict:
     """One (shape, offered-rps) cell: fire ``rps * duration_s``
     arrivals on the open-loop schedule, await them all, and roll up
     the SLO row.  Rejections and failures are counted, never raised —
     a load test's job is to record the service's behavior at
     saturation, not to die of it."""
     rng = np.random.default_rng(seed)
-    xr = rng.standard_normal(n).astype(np.float32)
-    xi = rng.standard_normal(n).astype(np.float32)
+    if domain == "c2r":
+        spec = np.fft.rfft(rng.standard_normal(n))
+        xr = spec.real.astype(np.float32)
+        xi = spec.imag.astype(np.float32)
+    else:
+        xr = rng.standard_normal(n).astype(np.float32)
+        xi = np.zeros_like(xr) if domain == "r2c" \
+            else rng.standard_normal(n).astype(np.float32)
 
     ok: list = []          # (client_total_s, response)
     rejected: list = []    # QueueFull errors (structured backpressure)
     failed: list = []      # ServeError beyond backpressure
 
+    t_start = clock()
+
     async def one():
         t0 = clock()
         try:
             resp = await dispatcher.submit(xr, xi, layout=layout,
-                                           precision=precision)
+                                           precision=precision,
+                                           inverse=inverse,
+                                           domain=domain,
+                                           priority=priority,
+                                           tenant=tenant)
         except QueueFull as e:
             rejected.append(e)
             return
@@ -55,7 +122,6 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
         ok.append((clock() - t0, resp))
 
     total = max(1, int(rps * duration_s))
-    t_start = clock()
     tasks = []
     for i in range(total):
         delay = (t_start + i / rps) - clock()
@@ -65,7 +131,15 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
     await asyncio.gather(*tasks)
     elapsed = max(clock() - t_start, 1e-9)
 
-    row = {
+    totals = [t for t, _ in ok]
+    queues = [r.queue_wait_ms for _, r in ok]
+    computes = [r.compute_ms for _, r in ok]
+
+    def ms(values, q, scale=1.0):
+        v = percentile_or_none(values, q)
+        return round(v * scale, 4) if v is not None else None
+
+    return {
         "shape": f"n2^{n.bit_length() - 1}:{layout}",
         "n": n,
         "offered_rps": round(rps, 1),
@@ -76,20 +150,182 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
         "failed": len(failed),
         "achieved_rps": round(len(ok) / elapsed, 1),
         "degraded": sum(1 for _, r in ok if r.degraded),
+        # stable schema: every latency field present, None when the
+        # population is empty (e.g. every arrival rejected)
+        "p50_ms": ms(totals, 50, 1e3),
+        "p99_ms": ms(totals, 99, 1e3),
+        "queue_p50_ms": ms(queues, 50),
+        "queue_p99_ms": ms(queues, 99),
+        "compute_p50_ms": ms(computes, 50),
+        "compute_p99_ms": ms(computes, 99),
+        "retry_after_p50_ms": ms([e.retry_after_ms for e in rejected],
+                                 50),
     }
-    if ok:
-        totals = [t for t, _ in ok]
-        queues = [r.queue_wait_ms for _, r in ok]
-        computes = [r.compute_ms for _, r in ok]
-        row.update({
-            "p50_ms": round(percentile(totals, 50) * 1e3, 4),
-            "p99_ms": round(percentile(totals, 99) * 1e3, 4),
-            "queue_p50_ms": round(percentile(queues, 50), 4),
-            "queue_p99_ms": round(percentile(queues, 99), 4),
-            "compute_p50_ms": round(percentile(computes, 50), 4),
-            "compute_p99_ms": round(percentile(computes, 99), 4),
-        })
-    if rejected:
-        row["retry_after_p50_ms"] = round(
-            percentile([e.retry_after_ms for e in rejected], 50), 3)
-    return row
+
+
+# ------------------------------------------------------- mesh chaos
+
+
+def _group_for(spec) -> GroupKey:
+    return GroupKey(n=spec.n, layout=spec.layout,
+                    precision=spec.precision, domain=spec.domain)
+
+
+async def run_mesh_chaos_load(mesh, specs, rps: float,
+                              duration_s: float,
+                              kill_at_frac: Optional[float] = 0.5,
+                              kill_kind: str = "permanent",
+                              seed: int = 0,
+                              prime: bool = True) -> dict:
+    """The mesh acceptance drive (docs/SERVING.md): open-loop arrivals
+    round-robin over `specs` against a warmed
+    :class:`~.mesh.MeshDispatcher`, with a mid-run device kill.
+
+    At ``kill_at_frac`` of the arrival schedule the CURRENT router
+    choice for ``specs[0]``'s group — the device provably about to
+    receive traffic — is armed with a one-shot ``device<K>`` fault
+    (`kill_kind`), so the kill strikes mid-batch on a loaded device,
+    not a conveniently idle one.  Every completed response is verified
+    against its numpy oracle, and the client-observed p99 is split at
+    the kill time: the ``p99_pre_kill_ms`` / ``p99_post_kill_ms`` pair
+    the ``serve_mesh`` bench rows carry.
+
+    Returns the full report; it ASSERTS nothing — the smoke gates and
+    tests own the assertions."""
+    from ..resilience.inject import inject
+
+    rng = np.random.default_rng(seed)
+    inputs = []
+    for spec in specs:
+        if spec.domain == "c2r":
+            sp = np.fft.rfft(rng.standard_normal(spec.n))
+            inputs.append((sp.real.astype(np.float32),
+                           sp.imag.astype(np.float32)))
+        elif spec.domain == "r2c":
+            inputs.append((rng.standard_normal(spec.n)
+                           .astype(np.float32), None))
+        else:
+            inputs.append((rng.standard_normal(spec.n)
+                           .astype(np.float32),
+                           rng.standard_normal(spec.n)
+                           .astype(np.float32)))
+
+    if prime:
+        # pay each group's trace/compile cost BEFORE the measured
+        # schedule opens (the warmup pass every SLO run owes itself):
+        # without it the pre-kill window is all compile latency and
+        # the pre/post p99 split measures XLA, not the failover
+        for si, spec in enumerate(specs):
+            xr, xi = inputs[si]
+            await mesh.submit(xr, xi, layout=spec.layout,
+                              precision=spec.precision,
+                              domain=spec.domain)
+
+    ok: list = []        # (t_done_rel_s, total_s, spec_idx, resp)
+    rejected: list = []
+    failed: list = []
+    killed = {"device": None, "t_rel_s": None}
+    t_start = clock()
+
+    async def one(i: int):
+        si = i % len(specs)
+        spec = specs[si]
+        xr, xi = inputs[si]
+        t0 = clock()
+        try:
+            resp = await mesh.submit(xr, xi, layout=spec.layout,
+                                     precision=spec.precision,
+                                     domain=spec.domain)
+        except QueueFull as e:
+            rejected.append(e)
+            return
+        except ServeError as e:
+            failed.append(e)
+            return
+        ok.append((clock() - t_start, clock() - t0, si, resp))
+
+    total = max(1, int(rps * duration_s))
+    kill_i = int(total * kill_at_frac) if kill_at_frac is not None \
+        else None
+    tasks = []
+    with contextlib.ExitStack() as stack:
+        for i in range(total):
+            delay = (t_start + i / rps) - clock()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if kill_i is not None and i == kill_i:
+                victim = mesh.router.route(_group_for(specs[0]),
+                                           record=False)
+                stack.enter_context(
+                    inject(victim.site, kill_kind, count=1))
+                killed["device"] = victim.id
+                killed["t_rel_s"] = round(clock() - t_start, 6)
+            tasks.append(asyncio.ensure_future(one(i)))
+        await asyncio.gather(*tasks)
+    elapsed = max(clock() - t_start, 1e-9)
+
+    problems = []
+    for _t, _tot, si, resp in ok:
+        spec = specs[si]
+        xr, xi = inputs[si]
+        problem = verify_response(spec.n, spec.layout, spec.domain,
+                                  False, spec.precision, xr, xi, resp)
+        if problem:
+            problems.append(problem)
+            if len(problems) >= 5:
+                break
+
+    t_kill = killed["t_rel_s"]
+    pre = [tot for t, tot, _si, _r in ok
+           if t_kill is None or t <= t_kill]
+    post = [tot for t, tot, _si, _r in ok
+            if t_kill is not None and t > t_kill]
+    failover_tagged = sum(
+        1 for _t, _tot, _si, r in ok
+        if any(str(tag).startswith("failover:") for tag in r.degrade))
+
+    def p99_ms(values):
+        v = percentile_or_none(values, 99)
+        return round(v * 1e3, 4) if v is not None else None
+
+    return {
+        "devices": len(mesh.devices),
+        "shapes": [_group_for(s).label() for s in specs],
+        "offered_rps": round(rps, 1),
+        "duration_s": round(elapsed, 4),
+        "requests": total,
+        "completed": len(ok),
+        "rejected": len(rejected),
+        "failed": len(failed),
+        "degraded": sum(1 for *_x, r in ok if r.degraded),
+        "failover_tagged": failover_tagged,
+        "killed_device": killed["device"],
+        "t_kill_s": t_kill,
+        "p99_pre_kill_ms": p99_ms(pre),
+        "p99_post_kill_ms": p99_ms(post),
+        "utilization": mesh.utilization(),
+        "problems": problems,
+    }
+
+
+def mesh_report_rows(report: dict) -> list:
+    """The ``serve_mesh`` BENCH row set from one chaos-load report:
+    one ``row="device"`` entry per mesh device (utilization balance)
+    plus one ``row="kill"`` entry with the pre/post-kill p99 split —
+    the shape ``analyze.loader`` parses (docs/ANALYSIS.md)."""
+    rows = []
+    for dev in report["utilization"].values():
+        rows.append({"row": "device", **dev})
+    rows.append({
+        "row": "kill",
+        "killed_device": report["killed_device"],
+        "t_kill_s": report["t_kill_s"],
+        "p99_pre_kill_ms": report["p99_pre_kill_ms"],
+        "p99_post_kill_ms": report["p99_post_kill_ms"],
+        "requests": report["requests"],
+        "completed": report["completed"],
+        "rejected": report["rejected"],
+        "failed": report["failed"],
+        "failover_tagged": report["failover_tagged"],
+    })
+    return rows

@@ -1,0 +1,566 @@
+"""Mesh-scale serving: per-device worker pools behind one front door
+(docs/SERVING.md, mesh section).
+
+The paper's thesis — P processors, zero inter-processor communication
+— means a device mesh needs no cross-device dataflow to serve FFTs:
+each request runs whole on ONE device, so the mesh problem is pure
+placement + failure handling.  :class:`MeshDispatcher` keeps the
+single-device :class:`~.dispatcher.Dispatcher` contract (same
+``submit``, same structured errors, same socket front) and adds:
+
+* **per-device worker pools** — every :class:`MeshDevice` owns its own
+  :class:`~.batcher.BatchRunner` + :class:`~.buffers.BufferPool` and
+  per-group bounded queues; a batch never spans devices.
+* **shape-affinity routing** (:mod:`.router`) — requests land where
+  the GroupKey's plan/executor and staging buffers are already warm,
+  least-loaded tie-break, every placement counted
+  (``pifft_serve_placement_total{device,reason}``).
+* **priority admission + tenant quotas** (:mod:`.router`) — the class
+  tables shed low-priority load first; per-tenant outstanding-request
+  quotas stop one tenant's burst from filling the mesh.
+* **self-healing failover** — a device failing (the ``device<K>``
+  injection sites — docs/RESILIENCE.md) or stalling (the PR-8
+  supervisor, when ``batch_deadline_s`` arms it) mid-batch is marked
+  dead through the multihost CONSENSUS path
+  (``parallel.multihost.agree_on_fallback`` — every host switches
+  together, docs/MULTICHIP.md) and its queued *and* in-flight-unacked
+  requests re-route to survivors with ``failover:<device>`` on their
+  degrade trail.  Zero dropped requests: every admitted future
+  resolves with a response or a structured error.
+* **warm-cache handoff on planned drain** — :meth:`drain_device`
+  pushes the draining device's compiled executors and warm groups to
+  a successor BEFORE the queue moves, journaling each step
+  (:class:`~..resilience.journal.Journal`) so a kill mid-drain
+  resumes instead of restarting.
+
+The mesh is VIRTUAL on CPU (the tier-1/smoke path: 8 in-process
+devices sharing the host backend, exactly like the multichip dryruns'
+forced host platform) and maps 1:1 onto real accelerators where
+``jax.devices()`` offers them — the placement/failover logic is
+device-agnostic by construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import functools
+import threading
+from typing import Optional
+
+from ..obs import events, metrics
+from ..obs.spans import clock
+from ..plans.core import warn
+from ..resilience import CollectiveAborted, CollectiveTimeout, classify
+from ..resilience.inject import maybe_fault
+from ..resilience.journal import Journal
+from ..resilience.watchdog import supervise_collective
+from .batcher import BatchRunner, GroupKey
+from .buffers import BufferPool
+from .dispatcher import (
+    _CLOSE,
+    Dispatcher,
+    DispatcherClosed,
+    Request,
+    ServeConfig,
+    ServeError,
+)
+from .router import (
+    AdmissionController,
+    NoDeviceAvailable,
+    QuotaExceeded,
+    Router,
+)
+
+
+class DeviceFailure(RuntimeError):
+    """A mesh device (not the batch it was running) died: raised out
+    of the per-device injection probe so the failover path — not the
+    batcher's kernel-fallback rungs — owns it."""
+
+    def __init__(self, device_id: str, cause: Exception):
+        super().__init__(
+            f"device {device_id} failed ({type(cause).__name__}: "
+            f"{str(cause)[:200]})")
+        self.device_id = device_id
+        self.cause = cause
+
+
+@dataclasses.dataclass
+class MeshConfig(ServeConfig):
+    """Mesh knobs on top of the dispatcher's (docs/SERVING.md)."""
+
+    devices: int = 8              # virtual (CPU) or physical device count
+    tenant_quota: Optional[int] = None   # max outstanding per tenant
+    #: arm the PR-8 collective supervisor around every device batch:
+    #: a batch overrunning `batch_deadline_s` × `batch_abort_waits`
+    #: is aborted (CollectiveAborted) and handled as a device stall —
+    #: None (default) leaves batches unsupervised (no per-batch
+    #: supervisor thread on the hot path).  The supervisor cannot
+    #: tell a cold compile from a stall, so set the deadline above
+    #: worst-case compile time or prime the mesh first (the field is
+    #: read per batch, so it can be armed after warmup)
+    batch_deadline_s: Optional[float] = None
+    batch_abort_waits: int = 1
+    #: journal path for warm-handoff drains (drain_device's default)
+    handoff_journal: Optional[str] = None
+
+
+class MeshDevice:
+    """One mesh member: its own runner/pool/queues, health state, and
+    occupancy accounting.  States: ``healthy`` (serving) →
+    ``draining`` (handoff in progress, router skips it) → ``drained``
+    (clean exit), or → ``dead`` (failover evacuated it)."""
+
+    def __init__(self, index: int, prefix: str = "vdev"):
+        self.index = index
+        self.id = f"{prefix}{index}"
+        #: fault-injection site (docs/RESILIENCE.md): arm
+        #: ``PIFFT_FAULT=device3:permanent`` to kill device 3,
+        #: ``device*:...`` to strike any device
+        self.site = f"device{index}"
+        self.state = "healthy"
+        self.runner = BatchRunner(BufferPool())
+        self.queues: dict = {}     # GroupKey -> asyncio.Queue
+        self.workers: dict = {}    # GroupKey -> worker task
+        self.inflight: dict = {}   # batch token -> [Request] (un-acked)
+        self.warm_groups: set = set()
+        self.busy_s = 0.0
+        #: busy_s accumulates from executor threads — two groups'
+        #: batches can finish on this device simultaneously, and a
+        #: lost += would skew the utilization rows the balance gate
+        #: reads
+        self._busy_lock = threading.Lock()
+        self.served = 0
+        #: the failover consensus, shared by every batch that dies on
+        #: this device: the FIRST failure handler runs it, the rest
+        #: await the same future so no re-route happens before the
+        #: hosts agreed (set only once state flips to "dead")
+        self.consensus: Optional[asyncio.Future] = None
+
+    def load(self) -> int:
+        """Placement load: queued + in-flight-unacked requests."""
+        queued = sum(q.qsize() for q in self.queues.values())
+        return queued + sum(len(b) for b in self.inflight.values())
+
+    def warmth(self, group: GroupKey) -> int:
+        """The router's affinity signal, read from the real
+        plan-cache/buffer state (docs/SERVING.md): 3 = compiled
+        executor cached here (hot), 2 = plan warmed/handed here,
+        1 = staging buffers pooled for the group's input width (a
+        WEAK signal — the pool is keyed by shape, so same-width
+        sibling groups alias; it must never outrank an explicit warm
+        assignment), 0 = cold."""
+        if group in self.runner.cached_groups():
+            return 3
+        if group in self.warm_groups:
+            return 2
+        width = group.input_width()
+        if any(len(shape) == 2 and shape[1] == width
+               for shape in self.runner.pool.pooled_shapes()):
+            return 1
+        return 0
+
+    def describe(self) -> dict:
+        return {"device": self.id, "state": self.state,
+                "served": self.served, "load": self.load(),
+                "busy_s": round(self.busy_s, 6),
+                "warm_groups": sorted(g.label()
+                                      for g in self.warm_groups)}
+
+
+class MeshDispatcher(Dispatcher):
+    """The mesh front door: same caller contract as
+    :class:`~.dispatcher.Dispatcher`, but admission routes to one of
+    ``config.devices`` per-device worker pools (module docstring)."""
+
+    def __init__(self, config: Optional[MeshConfig] = None,
+                 shape_specs=None):
+        config = config or MeshConfig()
+        super().__init__(config, shape_specs)
+        count = max(1, int(config.devices))
+        self.devices = [MeshDevice(i) for i in range(count)]
+        self.router = Router(self.devices)
+        self.admission = AdmissionController(quota=config.tenant_quota)
+        self.t_open = clock()
+
+    # ----------------------------------------------------- lifecycle
+
+    def warm(self, force: bool = False) -> list:
+        """Warm the served shape set ROUND-ROBIN across the mesh: each
+        spec's plan is resolved once (the process-global plan cache)
+        and its serving warmth assigned to one device — the initial
+        affinity map the router spreads load by."""
+        from . import shapes as shapes_mod
+
+        out = []
+        for i, spec in enumerate(self.specs):
+            device = self.devices[i % len(self.devices)]
+            out.extend(shapes_mod.warm([spec], force=force))
+            group = GroupKey(n=spec.n, layout=spec.layout,
+                             precision=spec.precision,
+                             domain=spec.domain)
+            device.warm_groups.add(group)
+            events.emit("serve_warm_assignment", device=device.id,
+                        shape=group.label())
+        return out
+
+    def device(self, device_id: str) -> MeshDevice:
+        for d in self.devices:
+            if d.id == device_id:
+                return d
+        raise ServeError(f"unknown mesh device {device_id!r} "
+                         f"({[d.id for d in self.devices]})")
+
+    def buffer_stats(self) -> dict:
+        """Aggregated staging-pool stats across the mesh."""
+        agg = {"hits": 0, "misses": 0, "pooled": 0}
+        for d in self.devices:
+            for key, val in d.runner.pool.stats().items():
+                agg[key] += val
+        return agg
+
+    def utilization(self) -> dict:
+        """Per-device occupancy since the mesh opened: busy compute
+        seconds over wall time — the balance row set the mesh smoke
+        bounds (docs/SERVING.md)."""
+        wall = max(clock() - self.t_open, 1e-9)
+        return {
+            d.id: {"device": d.id, "state": d.state,
+                   "served": d.served, "busy_s": round(d.busy_s, 6),
+                   "utilization": round(min(d.busy_s / wall, 1.0), 6)}
+            for d in self.devices
+        }
+
+    # ----------------------------------------------------- admission
+
+    async def submit(self, xr, xi=None, layout: str = "natural",
+                     precision: Optional[str] = None,
+                     inverse: bool = False,
+                     domain: str = "c2c",
+                     priority: str = "normal",
+                     tenant: str = "default"):
+        """:meth:`Dispatcher.submit`, mesh-routed: validation and the
+        class-aware bounded admission are the shared base logic; the
+        queue is the ROUTED device's, and the tenant-quota layer runs
+        before enqueue (released when the response future resolves,
+        whatever it resolves to)."""
+        if self._closing:
+            raise DispatcherClosed("dispatcher is shut down")
+        xr, xi, group = self._validated(xr, xi, layout, precision,
+                                        inverse, domain, priority)
+        self._check_served(group)
+        # choose first, RECORD only after admission passes: a shed
+        # request must not inflate the placement counter the
+        # affinity assertions read
+        device, why, warmth, load = self.router.choose(group)
+        q = self._ensure_device_worker(device, group)
+        self._admit(group, q, priority)
+        try:
+            self.admission.charge(
+                tenant, self._retry_after_ms(group, q, priority))
+        except QuotaExceeded:
+            # a quota shed is a rejection like any other: the SLO
+            # stats and the rejected counter must agree with what the
+            # client saw
+            label = group.label()
+            self.stats.record_rejected(label)
+            metrics.inc("pifft_serve_rejected_total", shape=label)
+            raise
+        self.router.record_placement(device, group, why, warmth, load)
+        req = Request(rid=next(self._rid), group=group, xr=xr, xi=xi,
+                      t_submit=clock(),
+                      future=asyncio.get_running_loop().create_future(),
+                      priority=priority, tenant=tenant)
+        req.future.add_done_callback(
+            lambda _f, t=tenant: self.admission.release(t))
+        metrics.inc("pifft_serve_requests_total", shape=group.label())
+        q.put_nowait(req)
+        return await req.future
+
+    def _ensure_device_worker(self, device: MeshDevice,
+                              group: GroupKey) -> asyncio.Queue:
+        q = device.queues.get(group)
+        if q is None:
+            q = device.queues[group] = asyncio.Queue()
+            task = asyncio.get_running_loop().create_task(
+                self._worker(group, q, device))
+            device.workers[group] = task
+            # register under the base maps too, so close()'s
+            # sentinel fan-out and the orphan sweep cover the mesh
+            self._queues[(device.id, group)] = q
+            self._workers[(device.id, group)] = task
+        return q
+
+    # ------------------------------------------------------ execution
+
+    def _is_device_failure(self, exc: Exception) -> bool:
+        return isinstance(exc, (DeviceFailure, CollectiveAborted,
+                                CollectiveTimeout))
+
+    async def _invoke_batch(self, group: GroupKey, batch, rung,
+                            device=None):
+        """One batch on `device`: the per-device injection probe fires
+        first (a fault there is the DEVICE dying, not the kernel —
+        the batcher's fallback rungs never see it), then the device's
+        own runner executes.  With ``batch_deadline_s`` set the whole
+        call runs under the PR-8 supervisor, so a stalled device is
+        ABORTED (CollectiveAborted) instead of wedging its worker —
+        the r05 lesson applied to serving (docs/MULTICHIP.md)."""
+        planes = [(r.xr, r.xi) for r in batch]
+        cfg = self.config
+
+        def execute():
+            try:
+                maybe_fault(device.site)
+            except Exception as e:
+                # the probe imitates the device dying under the batch:
+                # classification happens in the failover handler
+                raise DeviceFailure(device.id, e) from e
+            t0 = clock()
+            try:
+                return device.runner.run(group, planes, rung)
+            finally:
+                dt = clock() - t0
+                with device._busy_lock:
+                    device.busy_s += dt
+
+        if cfg.batch_deadline_s:
+            def supervised():
+                result, _report = supervise_collective(
+                    execute, label=f"serve:{device.id}",
+                    deadline_s=cfg.batch_deadline_s,
+                    abort_waits=cfg.batch_abort_waits)
+                return result
+
+            call = supervised
+        else:
+            call = execute
+        return await asyncio.get_running_loop().run_in_executor(
+            None, call)
+
+    async def _run_batch(self, group: GroupKey, batch, rung, level,
+                         device=None):
+        if device.state == "dead":
+            # the device died under a sibling group's batch while this
+            # one waited its worker's turn: evacuate, don't execute —
+            # behind the same consensus the killing handler ran
+            if device.consensus is not None:
+                await device.consensus
+            await self._reroute(list(batch), device, reason="failover")
+            return
+        token = object()
+        device.inflight[token] = list(batch)
+        try:
+            await super()._run_batch(group, batch, rung, level, device)
+        except Exception as e:
+            if not self._is_device_failure(e):
+                raise
+            unacked = device.inflight.pop(token, list(batch))
+            await self._handle_device_failure(device, unacked, e)
+            return
+        finally:
+            device.inflight.pop(token, None)
+        device.served += len(batch)
+
+    # ------------------------------------------------------- failover
+
+    async def _handle_device_failure(self, device: MeshDevice, batch,
+                                     exc: Exception) -> None:
+        """The self-healing path: mark the device dead (once), reach
+        multihost consensus BEFORE any re-route (all hosts switch
+        together — the PR-8 discipline), then move the dead device's
+        queued AND in-flight-unacked requests to survivors, failover-
+        tagged.  Concurrent failures on the SAME device (two groups'
+        batches dying together) share ONE consensus: the first
+        handler runs it, the rest await the same future — nobody
+        re-routes ahead of the agreement.  Zero dropped requests:
+        every evacuated future is re-enqueued or structurally
+        failed."""
+        loop = asyncio.get_running_loop()
+        if device.state == "dead":
+            stranded = []
+        else:
+            stranded = self._mark_dead(device, exc)
+            from ..parallel import multihost
+
+            device.consensus = loop.create_future()
+            try:
+                epoch = await loop.run_in_executor(
+                    None,
+                    functools.partial(
+                        multihost.agree_on_fallback,
+                        f"serve-mesh:{device.id}",
+                        reason=f"{type(exc).__name__}: "
+                               f"{str(exc)[:200]}"))
+            except Exception as e:
+                # a failed consensus (HostDesyncError) cannot be
+                # allowed to strand the requests: re-route locally
+                # and SAY so — on a single host there is nothing to
+                # split, and a multihost operator sees the
+                # fallback_consensus agreed=false event it already
+                # emitted
+                warn(f"serve-mesh consensus for {device.id} failed "
+                     f"({type(e).__name__}: {str(e)[:120]}); "
+                     f"re-routing locally")
+                epoch = None
+            device.consensus.set_result(epoch)
+        epoch = await device.consensus if device.consensus is not None \
+            else None
+        await self._reroute(list(batch) + stranded, device,
+                            reason="failover", epoch=epoch)
+
+    def _mark_dead(self, device: MeshDevice, exc: Exception) -> list:
+        """Synchronous state flip (atomic on the event loop): mark the
+        device dead, strand its queued requests for re-routing, wake
+        its workers to exit.  Returns the stranded requests."""
+        device.state = "dead"
+        kind = classify(exc).value
+        metrics.inc("pifft_serve_device_failures_total",
+                    device=device.id, kind=kind)
+        events.emit("serve_device_failed", device=device.id, kind=kind,
+                    error=f"{type(exc).__name__}: {str(exc)[:200]}")
+        warn(f"mesh device {device.id} FAILED ({kind} "
+             f"{type(exc).__name__}: {str(exc)[:120]}); re-routing its "
+             f"queue to survivors")
+        return self._evacuate_queues(device)
+
+    @staticmethod
+    def _evacuate_queues(device: MeshDevice) -> list:
+        """Strand every queued request off `device` and wake its
+        workers to exit (one sentinel per queue) — the shared sweep
+        behind both the failover and the planned drain."""
+        stranded = []
+        for q in device.queues.values():
+            while True:
+                try:
+                    item = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if item is not _CLOSE:
+                    stranded.append(item)
+            q.put_nowait(_CLOSE)
+        return stranded
+
+    async def _reroute(self, requests, from_device: MeshDevice,
+                       reason: str, epoch=None,
+                       tag: bool = True) -> None:
+        """Move admitted requests off `from_device` onto survivors.
+        ``tag=True`` (failover) marks each request's degrade trail;
+        a planned drain moves them untagged — the successor serves at
+        full quality.  Admitted requests are NOT re-admitted (their
+        slot moves with them); with no survivor left the future gets
+        a structured :class:`NoDeviceAvailable`."""
+        if not requests:
+            return
+        moved = stranded = 0
+        for req in requests:
+            if req.future.done():
+                continue
+            if tag:
+                req.trail.append(f"{reason}:{from_device.id}")
+            try:
+                target = self.router.route(req.group,
+                                           exclude={from_device.id},
+                                           reason=reason)
+            except NoDeviceAvailable as e:
+                req.future.set_exception(e)
+                stranded += 1
+                continue
+            q = self._ensure_device_worker(target, req.group)
+            q.put_nowait(req)
+            moved += 1
+        if tag and (moved or stranded):
+            # count what actually MOVED — already-resolved futures and
+            # no-survivor failures must not inflate the failover
+            # metric the observability story leans on
+            if moved:
+                metrics.inc("pifft_serve_failover_total",
+                            value=float(moved), device=from_device.id)
+            events.emit("serve_failover", device=from_device.id,
+                        requests=moved,
+                        **({"stranded": stranded} if stranded else {}),
+                        **({"epoch": epoch} if epoch is not None
+                           else {}),
+                        reason=reason)
+
+    # ---------------------------------------------------------- drain
+
+    async def drain_device(self, device_id: str,
+                           journal_path: Optional[str] = None) -> dict:
+        """Planned drain with WARM-CACHE HANDOFF (docs/SERVING.md):
+
+        1. mark the device ``draining`` (the router stops placing);
+        2. push every warm group's tuned plan entries — the compiled
+           executors and warmth marks — to a successor, journaling
+           each handoff BEFORE the queue moves (a kill mid-drain
+           resumes: journaled groups are not re-handed);
+        3. move the queued requests to the successors (untagged — a
+           planned move is not degradation);
+        4. let in-flight batches finish and the workers join;
+        5. mark ``drained`` and journal completion.
+
+        Returns the drain report.  `journal_path` defaults to
+        ``config.handoff_journal``; with neither set the drain runs
+        unjournaled (tests and ad-hoc ops)."""
+        device = self.device(device_id)
+        if device.state not in ("healthy", "draining"):
+            raise ServeError(f"device {device_id} is {device.state}; "
+                             f"only a healthy/draining device drains")
+        loop = asyncio.get_running_loop()
+        device.state = "draining"
+        path = journal_path or self.config.handoff_journal
+        journal = Journal(path) if path else None
+        if journal is not None:
+            # journal I/O is sync file I/O: keep it off the event loop
+            await loop.run_in_executor(None, journal.load)
+        report = {"device": device.id, "handoffs": [], "resumed": 0,
+                  "moved": 0, "journal": path}
+        groups = device.warm_groups | device.runner.cached_groups()
+        for group in sorted(groups, key=lambda g: g.label()):
+            cell = f"handoff:{device.id}:{group.label()}"
+            if journal is not None and journal.has(cell):
+                report["resumed"] += 1
+                continue
+            successor = self.router.route(group,
+                                          exclude={device.id},
+                                          reason="handoff")
+            adopted = successor.runner.adopt_callables(device.runner,
+                                                      group)
+            successor.warm_groups.add(group)
+            metrics.inc("pifft_serve_handoff_total", device=device.id)
+            events.emit("serve_handoff", device=device.id,
+                        successor=successor.id, shape=group.label(),
+                        adopted=adopted)
+            if journal is not None:
+                await loop.run_in_executor(
+                    None, functools.partial(
+                        journal.record, cell,
+                        {"successor": successor.id,
+                         "adopted": adopted}))
+            report["handoffs"].append({"group": group.label(),
+                                       "successor": successor.id,
+                                       "adopted": adopted})
+        # the queue moves AFTER the caches: the successor is warm by
+        # the time the first moved request reaches it
+        moved = self._evacuate_queues(device)
+        report["moved"] = len(moved)
+        await self._reroute(moved, device, reason="handoff", tag=False)
+        if device.workers:
+            await asyncio.gather(*device.workers.values(),
+                                 return_exceptions=True)
+        device.state = "drained"
+        if journal is not None:
+            await loop.run_in_executor(
+                None, functools.partial(journal.record,
+                                        f"drained:{device.id}",
+                                        {"moved": len(moved)}))
+        events.emit("serve_drain_complete", device=device.id,
+                    handoffs=len(report["handoffs"]),
+                    resumed=report["resumed"], moved=len(moved))
+        warn(f"mesh device {device.id} drained: "
+             f"{len(report['handoffs'])} group(s) handed off"
+             + (f" ({report['resumed']} resumed from journal)"
+                if report["resumed"] else "")
+             + f", {len(moved)} queued request(s) moved")
+        return report

@@ -4,12 +4,15 @@ One frame = a 4-byte big-endian length + a UTF-8 JSON body.  Requests:
 
     {"op": "fft", "id": 7, "xr": [...], "xi": [...],
      "layout": "natural", "precision": "split3", "inverse": false,
-     "domain": "c2c"}
+     "domain": "c2c", "priority": "normal", "tenant": "acme"}
     {"op": "stats"}
     {"op": "ping"}
 
 ``domain`` is optional (default "c2c"); ``"r2c"`` requests may omit
 ``xi`` entirely — the input is real by declaration (docs/REAL.md).
+``priority`` (low/normal/high) and ``tenant`` feed the admission
+classes and per-tenant quotas (docs/SERVING.md, mesh section); both
+default to the unprivileged values when omitted.
 
 Responses mirror :meth:`~.dispatcher.Response.to_record` (with the
 result planes as ``yr``/``yi`` float lists) on success, or
@@ -82,7 +85,7 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
     if op == "stats":
         return {"id": rid, "ok": True,
                 "stats": dispatcher.stats.summary(),
-                "buffers": dispatcher.runner.pool.stats()}
+                "buffers": dispatcher.buffer_stats()}
     if op != "fft":
         return {"id": rid, "ok": False,
                 "error": {"type": "bad_request",
@@ -95,7 +98,9 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
             layout=msg.get("layout", "natural"),
             precision=msg.get("precision"),
             inverse=bool(msg.get("inverse", False)),
-            domain=msg.get("domain", "c2c"))
+            domain=msg.get("domain", "c2c"),
+            priority=msg.get("priority") or "normal",
+            tenant=msg.get("tenant") or "default")
     except ServeError as e:
         return {"id": rid, "ok": False, "error": e.to_record()}
     rec = resp.to_record(arrays=True)
@@ -103,14 +108,61 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
     return rec
 
 
+#: the client-went-away family: a write/drain dying of one of these is
+#: the CLIENT's disconnect, not a server fault — the handler closes
+#: that one connection with a warn event and the accept loop (and the
+#: sibling connections it serves) never sees it
+_DISCONNECTS = (ConnectionResetError, BrokenPipeError,
+                ConnectionAbortedError)
+
+
 async def handle_connection(dispatcher: Dispatcher, reader,
                             writer) -> None:
     """One client connection: frames in, frames out, until EOF.
     Requests on one connection are served CONCURRENTLY (a queue-full
     rejection must not wait behind a coalescing window), with writes
-    serialized through a lock."""
+    serialized through a lock.  A client disconnecting mid-write
+    (``ConnectionResetError``/``BrokenPipeError`` out of ``drain()``)
+    closes THIS connection with a ``serve_conn_lost`` warn event —
+    it never propagates into the accept loop."""
     write_lock = asyncio.Lock()
     pending = set()
+    # once the peer is gone every further write on this connection is
+    # pointless: remember it so in-flight repliers stop trying
+    lost = asyncio.Event()
+
+    def _note_lost(e: Exception) -> None:
+        if lost.is_set():
+            return
+        lost.set()
+        from ..obs import events, metrics
+        from ..plans.core import warn
+
+        peer = None
+        try:
+            peer = writer.get_extra_info("peername")
+        except Exception:  # pragma: no cover - transport gone entirely  # pifft: noqa[PIF501]
+            pass
+        metrics.inc("pifft_serve_conn_lost_total")
+        events.emit("serve_conn_lost", peer=str(peer),
+                    error=f"{type(e).__name__}: {str(e)[:200]}")
+        warn(f"serve: client {peer} disconnected mid-write "
+             f"({type(e).__name__}); closing that connection")
+
+    async def write_reply(reply) -> bool:
+        """Serialized frame write; False once the peer is gone."""
+        if lost.is_set():
+            return False
+        async with write_lock:
+            if lost.is_set():
+                return False
+            try:
+                writer.write(encode_frame(reply))
+                await writer.drain()
+            except _DISCONNECTS as e:
+                _note_lost(e)
+                return False
+        return True
 
     async def serve_one(msg):
         try:
@@ -123,21 +175,20 @@ async def handle_connection(dispatcher: Dispatcher, reader,
                                "kind": classify(e).value,
                                "message":
                                    f"{type(e).__name__}: {str(e)[:200]}"}}
-        async with write_lock:
-            writer.write(encode_frame(reply))
-            await writer.drain()
+        await write_reply(reply)
 
     try:
-        while True:
+        while not lost.is_set():
             try:
                 msg = await read_frame(reader)
+            except _DISCONNECTS as e:
+                _note_lost(e)
+                break
             except (ValueError, json.JSONDecodeError) as e:
-                async with write_lock:
-                    writer.write(encode_frame(
-                        {"ok": False,
-                         "error": {"type": "bad_frame",
-                                   "message": str(e)[:200]}}))
-                    await writer.drain()
+                await write_reply(
+                    {"ok": False,
+                     "error": {"type": "bad_frame",
+                               "message": str(e)[:200]}})
                 break  # framing is lost; the connection cannot recover
             if msg is None:
                 break
@@ -147,7 +198,10 @@ async def handle_connection(dispatcher: Dispatcher, reader,
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
     finally:
-        writer.close()
+        try:
+            writer.close()
+        except _DISCONNECTS as e:  # pragma: no cover - already gone
+            _note_lost(e)
 
 
 async def serve_socket(dispatcher: Dispatcher, host: str = "127.0.0.1",

@@ -1,0 +1,169 @@
+"""Mesh routing and admission: where a request lands, and whether it
+gets in at all (docs/SERVING.md, mesh section).
+
+**Shape-affinity routing.**  The plan cache is a placement signal: a
+device that has already compiled (or been warmed/handed) a GroupKey's
+executor serves that group's next batch with zero trace cost, so the
+router sends requests where the group is already WARM.  Warmth is read
+from the existing per-device plan/executor and buffer state — never a
+side channel:
+
+* ``3`` (hot)   — the device's :class:`~.batcher.BatchRunner` holds a
+  compiled callable for the group (``cached_groups()``);
+* ``2`` (warm)  — the group was warmed onto (or handed to) the device
+  (``warm_groups``);
+* ``1`` (tepid) — the device's :class:`~.buffers.BufferPool` still
+  pools a staging pair of the group's input width (weak: same-width
+  sibling groups alias, so this never outranks an explicit warmth);
+* ``0`` (cold)  — nothing.
+
+Ties (same warmth) break to the LEAST-LOADED device (queued +
+in-flight), then the lowest index for determinism.  Every placement is
+emitted as a ``serve_placement`` event and counted in
+``pifft_serve_placement_total{device,reason}`` — the counter the mesh
+smoke asserts affinity on.
+
+**Priority admission** rides the class tables in
+:mod:`.dispatcher` (``PRIORITY_ADMIT_FILL`` / ``PRIORITY_RETRY_SCALE``:
+low sheds first, backs off hardest).  This module adds the
+**multi-tenant quota** layer: :class:`AdmissionController` bounds each
+tenant's OUTSTANDING requests (queued + in-flight, released when the
+response future resolves), so one tenant's burst cannot occupy every
+queue slot in the mesh — the rejection is a structured
+:class:`QuotaExceeded` (a :class:`~.dispatcher.QueueFull` subclass, so
+clients treat it as backpressure) naming the tenant and its limit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..obs import events, metrics
+from .batcher import GroupKey
+from .dispatcher import QueueFull, ServeError
+
+
+class NoDeviceAvailable(ServeError):
+    """Every mesh device is dead or draining: nothing can serve the
+    request.  Structured — the caller learns the mesh is gone, it is
+    never silently dropped."""
+
+    code = "no_device_available"
+
+
+class QuotaExceeded(QueueFull):
+    """Per-tenant quota admission rejection: the tenant already has its
+    quota of outstanding requests in the mesh.  A ``QueueFull``
+    subclass — backpressure with a retry hint — that additionally
+    names the tenant and limit."""
+
+    code = "tenant_quota"
+
+    def __init__(self, msg: str, retry_after_ms: float, tenant: str,
+                 quota: int):
+        super().__init__(msg, retry_after_ms)
+        self.tenant = tenant
+        self.quota = quota
+
+    def extras(self) -> dict:
+        return {**super().extras(), "tenant": self.tenant,
+                "quota": self.quota}
+
+
+class Router:
+    """Shape-affinity placement over a list of
+    :class:`~.mesh.MeshDevice` (docs/SERVING.md)."""
+
+    def __init__(self, devices):
+        self.devices = list(devices)
+
+    def candidates(self, exclude=()) -> list:
+        return [d for d in self.devices
+                if d.state == "healthy" and d.id not in exclude]
+
+    def choose(self, group: GroupKey, exclude=(),
+               reason: Optional[str] = None) -> tuple:
+        """``(device, why, warmth, load)`` for this group's next batch
+        — the decision WITHOUT the recording, so admission can still
+        reject the request before a placement is counted.  One pass:
+        warmth and load are read once per device (warmth rebuilds the
+        runner/pool views and takes the pool lock, so the hot path
+        must not evaluate it twice)."""
+        pool = self.candidates(exclude)
+        if not pool:
+            raise NoDeviceAvailable(
+                f"no healthy device for {group.label()}: "
+                f"{len(self.devices)} device(s), none serving")
+        scored = [(-d.warmth(group), d.load(), d.index, d)
+                  for d in pool]
+        neg_warmth, load, _idx, device = min(scored)
+        why = reason or ("affinity" if -neg_warmth > 0
+                         else "least_loaded")
+        return device, why, -neg_warmth, load
+
+    def record_placement(self, device, group: GroupKey, why: str,
+                         warmth: int, load: int) -> None:
+        """Count + emit one ADMITTED placement (the counter the mesh
+        smoke asserts affinity on — a rejected request must not
+        inflate it)."""
+        metrics.inc("pifft_serve_placement_total", device=device.id,
+                    reason=why)
+        events.emit("serve_placement", cell={"n": group.n},
+                    device=device.id, shape=group.label(),
+                    reason=why, warmth=warmth, load=load)
+
+    def route(self, group: GroupKey, exclude=(),
+              reason: Optional[str] = None, record: bool = True):
+        """The device this group's next batch should land on.
+
+        `exclude` removes devices by id (the failover path excludes
+        the dead device it is evacuating).  `reason` overrides the
+        recorded placement reason (``failover`` / ``handoff``);
+        otherwise it is ``affinity`` when warmth decided, else
+        ``least_loaded``.  ``record=False`` previews the choice
+        without emitting the placement event/counter (the chaos
+        driver picks its victim that way)."""
+        device, why, warmth, load = self.choose(group, exclude, reason)
+        if record:
+            self.record_placement(device, group, why, warmth, load)
+        return device
+
+
+class AdmissionController:
+    """Per-tenant outstanding-request quotas.  ``quota=None`` disables
+    enforcement (occupancy is still tracked for the stats surface)."""
+
+    def __init__(self, quota: Optional[int] = None):
+        self.quota = quota
+        self._outstanding: dict = {}
+
+    def charge(self, tenant: str, retry_after_ms: float) -> None:
+        """Admit one request for `tenant` or raise
+        :class:`QuotaExceeded`.  The caller MUST pair every successful
+        charge with a :meth:`release` (the dispatcher hooks it on the
+        response future)."""
+        held = self._outstanding.get(tenant, 0)
+        if self.quota is not None and held >= self.quota:
+            metrics.inc("pifft_serve_quota_rejected_total",
+                        tenant=tenant)
+            events.emit("serve_quota_reject", tenant=tenant,
+                        outstanding=held, quota=self.quota,
+                        retry_after_ms=retry_after_ms)
+            raise QuotaExceeded(
+                f"tenant {tenant!r} holds {held}/{self.quota} "
+                f"outstanding requests; retry in ~{retry_after_ms} ms",
+                retry_after_ms=retry_after_ms, tenant=tenant,
+                quota=self.quota)
+        self._outstanding[tenant] = held + 1
+
+    def release(self, tenant: str) -> None:
+        held = self._outstanding.get(tenant, 0)
+        if held <= 1:
+            self._outstanding.pop(tenant, None)
+        else:
+            self._outstanding[tenant] = held - 1
+
+    def outstanding(self, tenant: Optional[str] = None):
+        if tenant is not None:
+            return self._outstanding.get(tenant, 0)
+        return dict(self._outstanding)

@@ -29,6 +29,14 @@ def percentile(values, q: float) -> float:
     return ordered[int(min(rank, len(ordered))) - 1]
 
 
+def percentile_or_none(values, q: float):
+    """:func:`percentile`, or None for an empty population — the
+    loadgen row contract: a cell where every arrival was rejected (or
+    none were made) keeps its full row schema with null latency
+    fields instead of crashing the summary."""
+    return percentile(values, q) if values else None
+
+
 class LatencyStats:
     """Per-label accumulation of (queue_wait_s, compute_s, total_s)
     samples plus degradation/batching tallies.  Thread-safe: the

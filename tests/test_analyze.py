@@ -205,6 +205,49 @@ def test_loader_replicated_metric_kept_whole(tmp_path):
     assert rnd.metrics["tput_gflops"] == [990.0, 1000.0, 1010.0]
 
 
+def test_loader_parses_serve_mesh_rows(tmp_path):
+    """The serve_mesh row set (bench.py --serve-mesh —
+    docs/SERVING.md): per-device utilization becomes ONE replicated
+    metric with device-tagged samples, and the kill row's p99 split
+    becomes the scalar metrics a future gate can hold floors on."""
+    from cs87project_msolano2_tpu.analyze.loader import bench_samples
+
+    rows = [
+        {"row": "device", "device": "vdev0", "state": "dead",
+         "served": 10, "busy_s": 0.72, "utilization": 0.16},
+        {"row": "device", "device": "vdev1", "state": "healthy",
+         "served": 28, "busy_s": 0.32, "utilization": 0.07},
+        {"row": "kill", "killed_device": "vdev0", "t_kill_s": 0.6,
+         "p99_pre_kill_ms": 15.8, "p99_post_kill_ms": 50.6,
+         "requests": 144, "completed": 144, "rejected": 0,
+         "failed": 0, "failover_tagged": 1},
+    ]
+    path = write_round(tmp_path / "bench_r12.json", 12,
+                       {"serve_mesh": rows}, env=env_fingerprint(),
+                       smoke=True)
+    rnd = load_bench_round(path)
+    assert rnd.metrics["serve_mesh_utilization"] == [0.16, 0.07]
+    assert rnd.metrics["serve_mesh_p99_pre_kill_ms"] == 15.8
+    assert rnd.metrics["serve_mesh_p99_post_kill_ms"] == 50.6
+    assert len(rnd.serve_mesh_rows) == 3
+    samples = bench_samples(rnd)
+    util = [s for s in samples if s.metric == "serve_mesh_utilization"]
+    assert [(s.device, s.value) for s in util] \
+        == [("vdev0", 0.16), ("vdev1", 0.07)]
+    post = [s for s in samples
+            if s.metric == "serve_mesh_p99_post_kill_ms"]
+    assert len(post) == 1 and post[0].value == 50.6 \
+        and post[0].device is None
+
+
+def test_loader_pre_mesh_rounds_have_no_mesh_rows(tmp_path):
+    path = write_round(tmp_path / "bench_r02.json", 2,
+                       {"tput_gflops": 900.0}, env=env_fingerprint())
+    rnd = load_bench_round(path)
+    assert rnd.serve_mesh_rows == []
+    assert "serve_mesh_utilization" not in rnd.metrics
+
+
 def test_build_table_merges_all_three_sources(tmp_path):
     rows = make_phase_rows()
     tsv = write_tsv(tmp_path / "sweep.tsv", rows)

@@ -608,6 +608,30 @@ def test_pif107_noqa_escape():
     assert run(code, "PIF107", path=SERVE_PATH) == []
 
 
+def test_pif107_mesh_and_router_paths_in_scope():
+    """The mesh routing path is explicitly include-scoped: a blocking
+    call in serve/mesh.py or serve/router.py stalls EVERY device's
+    queue at once, so those files must stay covered (and are also
+    named in the config so a narrowed package glob cannot silently
+    drop them)."""
+    from cs87project_msolano2_tpu.check.rules import (
+        BlockingCallInAsyncServePath,
+    )
+
+    paths = BlockingCallInAsyncServePath.default_config["paths"]
+    assert "*/serve/mesh.py" in paths and "*/serve/router.py" in paths
+    code = """
+        import time
+
+        async def _reroute(requests):
+            time.sleep(0.01)
+    """
+    for fname in ("mesh.py", "router.py"):
+        findings = run(code, "PIF107",
+                       path=os.path.join(PKG, "serve", fname))
+        assert rule_ids(findings) == ["PIF107"], fname
+
+
 def test_pif107_serve_package_is_clean():
     """The shipped serve/ package must satisfy its own rule with no
     suppressions needed (the check-baseline stays empty)."""

@@ -199,6 +199,62 @@ def test_strict_shapes_rejects_unwarmed():
     run_async(main())
 
 
+def test_close_races_concurrent_submits_no_orphans_no_hang():
+    """The shutdown-under-load contract: close() racing a burst of
+    concurrent submits serves everything admitted before the close,
+    gives late submits a structured DispatcherClosed, joins every
+    worker, and leaves NO orphaned future (the run_async deadline is
+    the no-hang proof)."""
+    xr, xi = planes()
+
+    async def main():
+        d = Dispatcher(ServeConfig(max_wait_ms=25.0, queue_depth=256))
+
+        async def client():
+            try:
+                return ("ok", await d.submit(xr, xi))
+            except (DispatcherClosed, QueueFull) as e:
+                return ("rejected", e)
+
+        tasks = [asyncio.ensure_future(client()) for _ in range(24)]
+        await asyncio.sleep(0)  # submits enqueue before the close
+        await d.close()
+        with pytest.raises(DispatcherClosed):
+            await d.submit(xr, xi)
+        outcomes = await asyncio.gather(*tasks)
+        return d, outcomes
+
+    d, outcomes = run_async(main())
+    # every future resolved, and everything admitted pre-close was
+    # SERVED (the close drains, it does not drop)
+    assert len(outcomes) == 24
+    served = [r for tag, r in outcomes if tag == "ok"]
+    assert len(served) == 24, [tag for tag, _ in outcomes]
+    ref = ref_fft(xr, xi)
+    got = np.asarray(served[0].yr) + 1j * np.asarray(served[0].yi)
+    assert rel_err(got, ref) < 1e-4
+    assert all(w.done() for w in d._workers.values())
+    assert all(q.empty() for q in d._queues.values())
+
+
+def test_drain_alias_serves_then_stops():
+    xr, xi = planes()
+
+    async def main():
+        d = Dispatcher(ServeConfig(max_wait_ms=5.0))
+        pending = [asyncio.ensure_future(d.submit(xr, xi))
+                   for _ in range(3)]
+        await asyncio.sleep(0)
+        await d.drain()
+        done = await asyncio.gather(*pending)
+        with pytest.raises(DispatcherClosed):
+            await d.submit(xr, xi)
+        return done
+
+    done = run_async(main())
+    assert len(done) == 3 and all(r.batch_size >= 1 for r in done)
+
+
 def test_submit_after_close_raises():
     async def main():
         d = Dispatcher()
@@ -444,6 +500,70 @@ def test_protocol_frame_roundtrip_and_socket_server():
     assert bad["id"] == 9
 
 
+def test_protocol_client_disconnect_mid_write_never_escapes(obs_run):
+    """A client vanishing mid-write (ConnectionResetError out of
+    drain()) must close THAT connection with a warn event — never
+    propagate into the accept loop (the satellite contract)."""
+    from cs87project_msolano2_tpu.serve.protocol import (
+        encode_frame,
+        handle_connection,
+    )
+
+    class FakeReader:
+        def __init__(self, frames):
+            self._data = b"".join(encode_frame(f) for f in frames)
+            self._pos = 0
+
+        async def readexactly(self, k):
+            if self._pos + k > len(self._data):
+                raise asyncio.IncompleteReadError(
+                    self._data[self._pos:], k)
+            chunk = self._data[self._pos:self._pos + k]
+            self._pos += k
+            return chunk
+
+    class DyingWriter:
+        """Accepts the write, dies on drain — the kernel buffer
+        accepted the bytes but the peer reset underneath."""
+
+        def __init__(self):
+            self.closed = False
+            self.drains = 0
+
+        def write(self, data):
+            pass
+
+        async def drain(self):
+            self.drains += 1
+            raise ConnectionResetError("Connection reset by peer")
+
+        def close(self):
+            self.closed = True
+
+        def get_extra_info(self, name):
+            return ("198.51.100.7", 40213)
+
+    async def main():
+        async with Dispatcher(ServeConfig(max_wait_ms=1.0)) as d:
+            writer = DyingWriter()
+            reader = FakeReader([{"op": "ping", "id": 1},
+                                 {"op": "ping", "id": 2}])
+            # must return cleanly — any escaping exception would kill
+            # the asyncio.start_server accept task for EVERY client
+            await handle_connection(d, reader, writer)
+            return writer
+
+    writer = run_async(main())
+    assert writer.closed
+    assert writer.drains >= 1
+    lost = [r for r in obs.snapshot()
+            if r.get("kind") == "serve_conn_lost"]
+    assert lost and "ConnectionResetError" in lost[0]["payload"]["error"]
+    # the second reply attempt short-circuits: one connection loss is
+    # recorded once, not once per in-flight reply
+    assert len(lost) == 1
+
+
 # ------------------------------------------------------------ loadgen
 
 
@@ -462,6 +582,45 @@ def test_loadgen_row_shape_and_accounting():
     assert row["p99_ms"] >= row["p50_ms"] > 0
     assert row["queue_p99_ms"] >= 0 and row["compute_p99_ms"] > 0
     assert row["shape"] == "n2^8:natural"
+
+
+def test_loadgen_all_rejected_keeps_stable_schema_no_crash():
+    """The summary must survive a cell where EVERY arrival was
+    rejected (total saturation): same row keys, None latency fields —
+    never a percentile() crash on an empty population."""
+    from cs87project_msolano2_tpu.serve.loadgen import run_offered_load
+
+    class AlwaysFull:
+        async def submit(self, *a, **kw):
+            raise QueueFull("full", retry_after_ms=5.0)
+
+    async def main():
+        rejected_row = await run_offered_load(AlwaysFull(), N,
+                                              rps=50.0,
+                                              duration_s=0.1)
+        async with Dispatcher(ServeConfig(max_wait_ms=1.0)) as d:
+            ok_row = await run_offered_load(d, N, rps=40.0,
+                                            duration_s=0.1)
+        return rejected_row, ok_row
+
+    rejected_row, ok_row = run_async(main())
+    assert rejected_row["completed"] == 0
+    assert rejected_row["rejected"] == rejected_row["requests"] > 0
+    for key in ("p50_ms", "p99_ms", "queue_p50_ms", "queue_p99_ms",
+                "compute_p50_ms", "compute_p99_ms"):
+        assert rejected_row[key] is None, key
+    assert rejected_row["retry_after_p50_ms"] == 5.0
+    # a fully-completed row reports no rejections the same way
+    assert ok_row["retry_after_p50_ms"] is None
+    # SCHEMA STABILITY: both rows expose exactly the same keys
+    assert set(rejected_row) == set(ok_row)
+
+
+def test_percentile_or_none_contract():
+    from cs87project_msolano2_tpu.serve import percentile_or_none
+
+    assert percentile_or_none([], 99) is None
+    assert percentile_or_none([3.0, 1.0, 2.0], 50) == 2.0
 
 
 # ------------------------------------------------------- entry points

@@ -1,0 +1,475 @@
+"""Tests for mesh-scale serving (docs/SERVING.md, mesh section):
+shape-affinity routing asserted from the placement counter, priority
+admission (low sheds first, class-aware retry), per-tenant quotas,
+self-healing device failover (kill AND stall) with zero dropped
+requests and consensus before the re-route, warm-cache handoff on
+planned drain with journaled kill-mid-drain resume, and the
+``pifft serve --mesh-smoke`` / ``bench.py --serve-mesh`` capstone
+entry points end to end on the virtual CPU mesh."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs, resilience
+from cs87project_msolano2_tpu.obs import events as obs_events
+from cs87project_msolano2_tpu.obs import metrics
+from cs87project_msolano2_tpu.serve import (
+    GroupKey,
+    MeshConfig,
+    MeshDispatcher,
+    NoDeviceAvailable,
+    QueueFull,
+    QuotaExceeded,
+    ServeError,
+    ShapeSpec,
+)
+from cs87project_msolano2_tpu.serve.loadgen import run_mesh_chaos_load
+
+N = 256
+
+
+def planes(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal(n).astype(np.float32))
+
+
+def ref_fft(xr, xi):
+    return np.fft.fft(xr.astype(np.complex128)
+                      + 1j * xi.astype(np.complex128))
+
+
+def run_async(coro, timeout_s=180.0):
+    """Hard deadline: a mesh bug must FAIL, never hang the suite."""
+
+    async def bounded():
+        return await asyncio.wait_for(coro, timeout=timeout_s)
+
+    return asyncio.run(bounded())
+
+
+@pytest.fixture
+def obs_run():
+    obs.enable()
+    yield obs
+    obs.disable()
+
+
+def mesh_cfg(devices=3, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 1.0)
+    return MeshConfig(devices=devices, **kw)
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_affinity_second_batch_lands_on_same_device(obs_run):
+    """The acceptance bullet: a warmed GroupKey's repeat traffic lands
+    on the SAME device, asserted from the placement counter."""
+    specs = [ShapeSpec(n=N), ShapeSpec(n=N, layout="pi")]
+    xr, xi = planes()
+
+    async def main():
+        async with MeshDispatcher(mesh_cfg(), specs) as mesh:
+            home = mesh.router.route(GroupKey(n=N), record=False)
+            before = metrics.counter_value(
+                "pifft_serve_placement_total", device=home.id,
+                reason="affinity")
+            r1 = await mesh.submit(xr, xi)
+            r2 = await mesh.submit(xr, xi)
+            after = metrics.counter_value(
+                "pifft_serve_placement_total", device=home.id,
+                reason="affinity")
+            return home, r1, r2, after - before
+
+    home, r1, r2, placed = run_async(main())
+    assert r1.device == home.id and r2.device == home.id
+    assert placed >= 2
+    got = np.asarray(r2.yr) + 1j * np.asarray(r2.yi)
+    assert np.max(np.abs(got - ref_fft(xr, xi))) / \
+        np.max(np.abs(ref_fft(xr, xi))) < 1e-4
+
+
+def test_cold_group_routes_least_loaded_and_warms():
+    """A group nobody warmed goes to the least-loaded device — and the
+    device that served it becomes its affinity home."""
+    xr, xi = planes(n=128)
+
+    async def main():
+        async with MeshDispatcher(mesh_cfg()) as mesh:
+            r1 = await mesh.submit(xr, xi)
+            r2 = await mesh.submit(xr, xi)
+            first = mesh.device(r1.device)
+            return r1, r2, first.warmth(GroupKey(n=128))
+
+    r1, r2, warmth = run_async(main())
+    assert r1.device == r2.device  # compiled-callable affinity sticks
+    assert warmth == 3
+
+
+# ----------------------------------------------------------- admission
+
+
+def test_low_priority_sheds_first_with_scaled_retry(obs_run):
+    """The class ceilings: at a fill past low's ceiling but below the
+    hard bound, low is rejected (retry scaled 4x) while normal still
+    admits."""
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        PRIORITY_ADMIT_FILL,
+        PRIORITY_RETRY_SCALE,
+    )
+
+    assert PRIORITY_ADMIT_FILL["low"] < PRIORITY_ADMIT_FILL["normal"]
+    assert PRIORITY_RETRY_SCALE["low"] > PRIORITY_RETRY_SCALE["high"]
+    xr, xi = planes()
+
+    async def main():
+        cfg = mesh_cfg(devices=1, queue_depth=8, max_batch=2,
+                       max_wait_ms=50.0)
+        mesh = MeshDispatcher(cfg)
+        shed = metrics.counter_value("pifft_serve_shed_total",
+                                     priority="low")
+        # fill the single device's queue to 5/8: past low's ceiling
+        # (4) but under normal's (8)
+        pending = [asyncio.ensure_future(mesh.submit(xr, xi))
+                   for _ in range(5)]
+        await asyncio.sleep(0)
+        with pytest.raises(QueueFull) as low_err:
+            await mesh.submit(xr, xi, priority="low")
+        shed_after = metrics.counter_value("pifft_serve_shed_total",
+                                           priority="low")
+        ok = await mesh.submit(xr, xi, priority="normal")
+        await asyncio.gather(*pending)
+        await mesh.close()
+        return low_err.value, shed_after - shed, ok
+
+    low_err, shed_delta, ok = run_async(main())
+    assert low_err.retry_after_ms > 0
+    assert shed_delta >= 1
+    assert ok.batch_size >= 1
+
+
+def test_tenant_quota_rejects_structured_and_releases():
+    xr, xi = planes()
+
+    async def main():
+        cfg = mesh_cfg(devices=2, tenant_quota=2, max_wait_ms=30.0)
+        mesh = MeshDispatcher(cfg)
+        burst = [asyncio.ensure_future(
+            mesh.submit(xr, xi, tenant="acme")) for _ in range(2)]
+        await asyncio.sleep(0)
+        with pytest.raises(QuotaExceeded) as err:
+            await mesh.submit(xr, xi, tenant="acme")
+        # another tenant is untouched by acme's quota
+        other = await mesh.submit(xr, xi, tenant="zed")
+        done = await asyncio.gather(*burst)
+        # quota released on completion: acme admits again
+        again = await mesh.submit(xr, xi, tenant="acme")
+        await mesh.close()
+        return err.value, other, done, again
+
+    err, other, done, again = run_async(main())
+    assert err.tenant == "acme" and err.quota == 2
+    rec = err.to_record()
+    assert rec["type"] == "tenant_quota" and rec["quota"] == 2
+    assert rec["retry_after_ms"] > 0
+    assert len(done) == 2 and other.batch_size >= 1
+    assert again.batch_size >= 1
+
+
+def test_priority_validated():
+    xr, xi = planes()
+
+    async def main():
+        async with MeshDispatcher(mesh_cfg(devices=1)) as mesh:
+            with pytest.raises(ServeError):
+                await mesh.submit(xr, xi, priority="urgent")
+
+    run_async(main())
+
+
+# ------------------------------------------------------------ failover
+
+
+def test_device_kill_reroutes_zero_drops_consensus(obs_run):
+    """An injected device<K> fault mid-run: the device dies ONCE, its
+    queued + in-flight requests re-route failover-tagged, every
+    future resolves (zero drops), consensus ran, and the survivors'
+    answers stay numpy-correct."""
+    specs = [ShapeSpec(n=N)]
+    xr, xi = planes()
+
+    async def main():
+        cfg = mesh_cfg(devices=3, max_batch=2, max_wait_ms=5.0)
+        async with MeshDispatcher(cfg, specs) as mesh:
+            home = mesh.router.route(GroupKey(n=N), record=False)
+            await mesh.submit(xr, xi)  # prime the home device
+            with resilience.inject(home.site, "permanent", count=1):
+                results = await asyncio.gather(
+                    *[mesh.submit(xr, xi) for _ in range(8)])
+            late = await mesh.submit(xr, xi)
+            return mesh, home, results, late
+
+    mesh, home, results, late = run_async(main())
+    assert mesh.device(home.id).state == "dead"
+    assert len(results) == 8  # zero dropped: every future resolved
+    tagged = [r for r in results
+              if any(t == f"failover:{home.id}" for t in r.degrade)]
+    assert tagged and all(r.degraded for r in tagged)
+    assert all(r.device != home.id for r in results)
+    ref = ref_fft(xr, xi)
+    for r in results + [late]:
+        got = np.asarray(r.yr) + 1j * np.asarray(r.yi)
+        assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-4
+    # the new affinity home serves undegraded
+    assert not late.degraded
+    kinds = [r.get("kind") for r in obs_events.snapshot()]
+    assert "serve_device_failed" in kinds
+    consensus = [r for r in obs_events.snapshot()
+                 if r.get("kind") == "fallback_consensus"
+                 and str(r["payload"]["label"]).startswith(
+                     f"serve-mesh:{home.id}")]
+    assert consensus and consensus[0]["payload"]["agreed"] is True
+    assert metrics.counter_value("pifft_serve_failover_total",
+                                 device=home.id) >= len(tagged)
+
+
+def test_device_stall_supervisor_aborts_and_fails_over(obs_run):
+    """A device that STALLS (injected delay) under an armed batch
+    deadline is aborted by the PR-8 supervisor and failed over the
+    same way a dead one is.  The deadline is armed only AFTER both
+    devices are primed — the supervisor cannot tell a cold compile
+    from a stall (MeshConfig docstring), and neither can this test."""
+    specs = [ShapeSpec(n=N)]
+    xr, xi = planes()
+
+    async def main():
+        cfg = mesh_cfg(devices=2, max_batch=1, max_wait_ms=2.0)
+        async with MeshDispatcher(cfg, specs) as mesh:
+            home = mesh.router.route(GroupKey(n=N), record=False)
+            await mesh.submit(xr, xi)  # prime the home device
+            # prime the survivor too (route around the home), so the
+            # armed deadline only ever sees compiled batches
+            home.state = "draining"
+            await mesh.submit(xr, xi)
+            home.state = "healthy"
+            mesh.config.batch_deadline_s = 0.2
+            mesh.config.batch_abort_waits = 1
+            with resilience.inject(home.site, "stall", count=1,
+                                   stall_s=1.5):
+                results = await asyncio.gather(
+                    *[mesh.submit(xr, xi) for _ in range(4)])
+            mesh.config.batch_deadline_s = None
+            return mesh, home, results
+
+    mesh, home, results = run_async(main())
+    assert mesh.device(home.id).state == "dead"
+    assert len(results) == 4
+    assert any(f"failover:{home.id}" in r.degrade for r in results)
+    ref = ref_fft(xr, xi)
+    for r in results:
+        got = np.asarray(r.yr) + 1j * np.asarray(r.yi)
+        assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-4
+
+
+def test_all_devices_dead_is_structured_not_a_hang():
+    xr, xi = planes()
+
+    async def main():
+        cfg = mesh_cfg(devices=1, max_wait_ms=2.0)
+        async with MeshDispatcher(cfg, [ShapeSpec(n=N)]) as mesh:
+            home = mesh.devices[0]
+            await mesh.submit(xr, xi)
+            with resilience.inject(home.site, "permanent", count=1):
+                # the in-flight batch has nowhere to go: its future
+                # must resolve with the structured no-device error
+                with pytest.raises(NoDeviceAvailable):
+                    await mesh.submit(xr, xi)
+            with pytest.raises(NoDeviceAvailable):
+                await mesh.submit(xr, xi)
+
+    run_async(main())
+
+
+# --------------------------------------------------------------- drain
+
+
+def test_drain_hands_warm_cache_then_queue_journaled(tmp_path,
+                                                     obs_run):
+    """Planned drain: the successor adopts the compiled executors
+    BEFORE the queue moves, the handoff is journaled, the drained
+    group's next request lands on the successor (affinity — no
+    re-tune) undegraded, and the moved requests complete."""
+    journal = tmp_path / "drain.jsonl"
+    specs = [ShapeSpec(n=N)]
+    xr, xi = planes()
+    group = GroupKey(n=N)
+
+    async def main():
+        cfg = mesh_cfg(devices=3, max_wait_ms=20.0)
+        async with MeshDispatcher(cfg, specs) as mesh:
+            home = mesh.router.route(group, record=False)
+            await mesh.submit(xr, xi)  # compile on the home device
+            assert home.warmth(group) == 3
+            pending = [asyncio.ensure_future(mesh.submit(xr, xi))
+                       for _ in range(3)]
+            await asyncio.sleep(0)
+            report = await mesh.drain_device(home.id,
+                                             journal_path=str(journal))
+            moved = await asyncio.gather(*pending)
+            succ = mesh.device(report["handoffs"][0]["successor"])
+            assert succ.warmth(group) == 3  # adopted, not re-built
+            after = await mesh.submit(xr, xi)
+            return mesh, home, report, moved, after
+
+    mesh, home, report, moved, after = run_async(main())
+    assert mesh.device(home.id).state == "drained"
+    assert [h["group"] for h in report["handoffs"]] == [group.label()]
+    assert report["handoffs"][0]["adopted"] >= 1
+    successor = report["handoffs"][0]["successor"]
+    assert after.device == successor
+    assert not after.degraded and not after.degrade
+    for r in moved:  # a planned move is NOT degradation
+        assert not any(str(t).startswith("failover:")
+                       for t in r.degrade)
+    ref = ref_fft(xr, xi)
+    got = np.asarray(after.yr) + 1j * np.asarray(after.yi)
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 1e-4
+    records = [json.loads(line) for line in
+               journal.read_text().splitlines()]
+    cells = {r["cell"] for r in records}
+    assert f"handoff:{home.id}:{group.label()}" in cells
+    assert f"drained:{home.id}" in cells
+    kinds = [r.get("kind") for r in obs_events.snapshot()]
+    assert "serve_handoff" in kinds and "serve_drain_complete" in kinds
+
+
+def test_drain_resumes_from_journal_after_kill(tmp_path, obs_run):
+    """Kill-mid-drain resume: a journal already holding a group's
+    handoff cell means that group is NOT re-handed (no duplicate
+    serve_handoff event), but the drain still completes."""
+    journal = tmp_path / "drain.jsonl"
+    group = GroupKey(n=N)
+    xr, xi = planes()
+
+    async def main():
+        cfg = mesh_cfg(devices=3, max_wait_ms=5.0)
+        async with MeshDispatcher(cfg, [ShapeSpec(n=N)]) as mesh:
+            home = mesh.router.route(group, record=False)
+            await mesh.submit(xr, xi)
+            # simulate the pre-kill drain progress: the handoff cell
+            # is journaled, then the process died before the queue
+            # moved
+            from cs87project_msolano2_tpu.resilience import Journal
+
+            succ = mesh.router.route(group, exclude={home.id},
+                                     record=False)
+            Journal(str(journal)).record(
+                f"handoff:{home.id}:{group.label()}",
+                {"successor": succ.id, "adopted": 0})
+            before = [r for r in obs_events.snapshot()
+                      if r.get("kind") == "serve_handoff"]
+            report = await mesh.drain_device(home.id,
+                                             journal_path=str(journal))
+            after = [r for r in obs_events.snapshot()
+                     if r.get("kind") == "serve_handoff"]
+            return report, len(after) - len(before)
+
+    report, handoff_events = run_async(main())
+    assert report["resumed"] == 1
+    assert report["handoffs"] == []  # nothing re-handed
+    assert handoff_events == 0
+
+
+def test_drain_refuses_dead_device():
+    async def main():
+        cfg = mesh_cfg(devices=2)
+        async with MeshDispatcher(cfg) as mesh:
+            mesh.devices[0].state = "dead"
+            with pytest.raises(ServeError):
+                await mesh.drain_device(mesh.devices[0].id)
+
+    run_async(main())
+
+
+# --------------------------------------------------- event schema
+
+
+def test_mesh_event_kinds_are_schemad():
+    """The mesh kinds carry required payload fields — a placement
+    without its reason (or a failover without its count) is
+    schema-invalid, so the smoke's zero-invalid gate really guards
+    them."""
+    base = {"v": 1, "run": "r", "seq": 0, "kind": "serve_placement",
+            "t": 0.0}
+    bad = dict(base, payload={"device": "vdev0", "shape": "256"})
+    assert any("reason" in p for p in obs_events.validate_event(bad))
+    good = dict(base, payload={"device": "vdev0", "shape": "256",
+                               "reason": "affinity"})
+    assert obs_events.validate_event(good) == []
+    bad2 = {"v": 1, "run": "r", "seq": 1, "kind": "serve_failover",
+            "t": 0.0, "payload": {"device": "vdev0"}}
+    assert any("requests" in p for p in obs_events.validate_event(bad2))
+
+
+def test_device_site_registered():
+    from cs87project_msolano2_tpu.resilience import KNOWN_SITES
+
+    assert "device" in KNOWN_SITES
+    assert "device<K>" in KNOWN_SITES["device"] \
+        or "device3" in KNOWN_SITES["device"]
+
+
+# ------------------------------------------------------- entry points
+
+
+def test_mesh_smoke_cli_end_to_end(capsys):
+    """The `make serve-mesh-smoke` gate, in-process: kill, failover,
+    consensus, drain, affinity and spread all asserted."""
+    from cs87project_msolano2_tpu.serve.cli import serve_main
+
+    rc = serve_main(["--mesh-smoke", "--json", "--devices", "4",
+                     "--mesh-rps", "60", "--mesh-duration", "0.6"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["problems"]
+    assert out["ok"] is True
+    assert out["report"]["failed"] == 0
+    assert out["report"]["killed_device"] is not None
+    assert out["report"]["failover_tagged"] >= 1
+    assert out["report"]["p99_pre_kill_ms"] is not None
+    assert out["report"]["p99_post_kill_ms"] is not None
+    assert out["consensus_events"] >= 1
+    assert out["schema_invalid_events"] == 0
+    assert any(c.startswith("handoff:") for c in out["journal_cells"])
+
+
+def test_bench_serve_mesh_smoke_emits_row_set(capsys):
+    """`bench.py --serve-mesh --smoke` emits the serve_mesh row set in
+    the BENCH round format (per-device utilization + the pre/post-kill
+    p99 split) and exits 0 — the kill is the measurement, not an
+    error."""
+    import bench
+
+    rc = bench.main(["--serve-mesh", "--smoke",
+                     "--load-rps", "60", "--load-duration", "0.5"])
+    assert rc == 0
+    record = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["metric"] == "serve_mesh_p99_post_kill_ms"
+    assert record["unit"] == "ms" and record["smoke"] is True
+    assert record.get("degraded") is True
+    rows = record["serve_mesh"]
+    devices = [r for r in rows if r["row"] == "device"]
+    kills = [r for r in rows if r["row"] == "kill"]
+    assert len(devices) == 8 and len(kills) == 1
+    assert all({"device", "utilization", "served", "state"} <= set(r)
+               for r in devices)
+    kill = kills[0]
+    assert kill["failed"] == 0
+    assert kill["failover_tagged"] >= 1
+    assert kill["p99_post_kill_ms"] == record["value"]
+    assert sum(1 for r in devices if r["state"] == "dead") == 1
