@@ -70,4 +70,4 @@ def num_cores() -> int:
 # kept for API symmetry with timing-free callers; a raw clock read, not
 # a measurement, so the sanctioned-clock rules are waived here
 def wall_ms() -> float:
-    return time.perf_counter() * 1e3  # pifft: noqa[PIF102, PIF106]
+    return time.perf_counter() * 1e3  # pifft: noqa[PIF102, PIF106]: wall_ms is the backend's documented non-measurement wall stamp, not a timed window

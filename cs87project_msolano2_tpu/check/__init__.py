@@ -7,11 +7,20 @@ invalidates a result without failing any functional test.  This package
 enforces those invariants mechanically:
 
 * ``engine``  — AST rule engine: file walking, per-rule config,
-                ``# pifft: noqa[RULE]`` suppression, JSON + human
-                output, committed-baseline comparison.
-* ``rules``   — the bundled rule set (PIF1xx timing, PIF2xx retrace,
+                ``# pifft: noqa[RULE]: reason`` suppression, JSON +
+                human + SARIF output, committed-baseline comparison,
+                ``--changed`` git scoping, the noqa audit.
+* ``rules``   — the syntactic rule set (PIF1xx timing, PIF2xx retrace,
                 PIF3xx Mosaic, PIF4xx plan keys, PIF5xx hygiene); see
                 docs/CHECKS.md for each rule's rationale.
+* ``flow``    — the flow-sensitive layer: per-function CFGs (branches,
+                loops, try/finally, with-regions, ``@pl.when``
+                inlining), the path-pairing analysis (must/may
+                verdicts) and locksets.
+* ``rules_flow`` — rules on top of it: PIF302/303/304 DMA discipline,
+                PIF112 unguarded shared-state write, PIF113
+                await-holding-lock, PIF114 unpaired resource, PIF115
+                untagged demotion.
 * ``runtime`` — what static analysis cannot see, as pytest fixtures:
                 ``tracer_leak_guard`` (jax.checking_leaks) and
                 ``RecompileGuard`` (per-function retrace budgets).
@@ -23,11 +32,22 @@ from .engine import (  # noqa: F401
     Finding,
     Rule,
     all_rules,
+    changed_files,
     check_paths,
     check_source,
+    collect_noqa,
     compare_baseline,
     load_baseline,
     register,
+    to_sarif,
+)
+from .flow import (  # noqa: F401
+    CFG,
+    Event,
+    PairingResult,
+    build_cfg,
+    flow_locksets,
+    pair_events,
 )
 from .runtime import (  # noqa: F401
     RecompileBudgetExceeded,

@@ -1,11 +1,20 @@
 """`pifft check` — the static-analysis entry point.
 
-    pifft check [paths...] [--rule ID ...] [--json]
+    pifft check [paths...] [--rule ID ...] [--format human|json|sarif]
+                [--changed [REF]] [--list-noqa]
                 [--baseline FILE] [--write-baseline FILE] [--list-rules]
 
 Default paths are the whole measurement surface: the package plus the
 scripts that produce the paper's timed numbers (bench.py,
 bench_configs.py, exp_perf.py, harness/).
+
+``--changed`` scopes the run to files touched vs a git ref (default
+``HEAD``: committed-but-different plus staged, unstaged and untracked)
+— the pre-commit fast path; CI keeps the full run.  ``--format sarif``
+emits SARIF 2.1.0 for GitHub code-scanning annotations.
+``--list-noqa`` inventories every suppression with its reason (rule
+PIF503 makes the reason mandatory).
+
 Exit codes: 0 clean (or matches baseline), 1 findings (or new findings
 vs baseline), 2 usage errors.
 """
@@ -34,19 +43,39 @@ def _default_paths() -> list:
             if os.path.exists(p)]
 
 
+def _emit(findings: list, paths: list, fmt: str) -> None:
+    if fmt == "json":
+        print(engine.to_json(findings, paths))
+    elif fmt == "sarif":
+        print(engine.to_sarif(findings))
+    else:
+        print(engine.format_human(findings))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="pifft check",
         description="project-specific static analysis: timing/retrace/"
-                    "Mosaic/plan-key invariants as AST rules",
+                    "Mosaic/plan-key invariants as AST rules, plus "
+                    "flow-sensitive DMA/lock/pairing/degrade-tag rules",
     )
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the package "
                          "and bench.py)")
     ap.add_argument("--rule", action="append", metavar="ID", default=None,
                     help="run only this rule id (repeatable)")
+    ap.add_argument("--format", dest="fmt",
+                    choices=("human", "json", "sarif"), default="human",
+                    help="output format (sarif = SARIF 2.1.0 for "
+                         "GitHub code scanning)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="machine-readable output (alias for "
+                         "--format json)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="only check files changed vs REF (default "
+                         "HEAD; includes staged, unstaged and "
+                         "untracked) — the pre-commit fast path")
     ap.add_argument("--baseline", metavar="FILE", default=None,
                     help="compare against a committed baseline: only "
                          "NEW findings fail")
@@ -55,7 +84,11 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--list-rules", action="store_true",
                     help="list rule ids and summaries, then exit")
+    ap.add_argument("--list-noqa", action="store_true",
+                    help="inventory every `# pifft: noqa` suppression "
+                         "with its reason, then exit")
     args = ap.parse_args(argv)
+    fmt = "json" if args.json and args.fmt == "human" else args.fmt
 
     if args.list_rules:
         for rid, rule in sorted(engine.all_rules().items()):
@@ -66,6 +99,47 @@ def main(argv=None) -> int:
     # repo-root-relative display form is only for output metadata, so
     # the default run works from any cwd
     raw_paths = args.paths or _default_paths()
+
+    if args.list_noqa and fmt == "sarif":
+        print("error: --list-noqa has no SARIF form (it lists "
+              "suppressions, not findings); use --format json",
+              file=sys.stderr)
+        return 2
+
+    if args.changed is not None:
+        anchor = raw_paths[0] if raw_paths else os.getcwd()
+        if not os.path.isdir(anchor):
+            anchor = os.path.dirname(os.path.abspath(anchor))
+        try:
+            touched = engine.changed_files(args.changed, anchor)
+        except RuntimeError as e:
+            print(f"error: --changed {args.changed}: {e}",
+                  file=sys.stderr)
+            return 2
+        raw_paths = [p for p in engine.iter_python_files(raw_paths)
+                     if os.path.abspath(p) in touched]
+        if not raw_paths:
+            print(f"pifft check: no files changed vs {args.changed}")
+            return 0
+
+    if args.list_noqa:
+        # after the --changed filter, so the inventory scopes the same
+        # way the check itself would
+        records = engine.collect_noqa(raw_paths)
+        if fmt == "json":
+            import json as _json
+
+            print(_json.dumps({"schema": 1, "count": len(records),
+                               "suppressions": records},
+                              indent=1, sort_keys=True))
+        else:
+            for rec in records:
+                ids = ", ".join(rec["ids"])
+                reason = rec["reason"] or "(NO REASON — PIF503)"
+                print(f"{rec['path']}:{rec['line']}: [{ids}] {reason}")
+            print(f"pifft check: {len(records)} suppression(s)")
+        return 0
+
     paths = [engine._display_path(p) for p in raw_paths]
     try:
         findings = engine.check_paths(raw_paths, rules=args.rule)
@@ -91,8 +165,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         new, fixed = engine.compare_baseline(findings, baseline)
-        if args.json:
-            print(engine.to_json(new, paths))
+        if fmt != "human":
+            _emit(new, paths, fmt)
         else:
             if new:
                 print(engine.format_human(new))
@@ -107,8 +181,5 @@ def main(argv=None) -> int:
                       f"--write-baseline")
         return 1 if new else 0
 
-    if args.json:
-        print(engine.to_json(findings, paths))
-    else:
-        print(engine.format_human(findings))
+    _emit(findings, paths, fmt)
     return 1 if findings else 0

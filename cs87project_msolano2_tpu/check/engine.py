@@ -4,10 +4,14 @@ baseline comparison.
 A rule is a :class:`Rule` subclass registered via :func:`register`; the
 engine parses each file once, hands every selected rule the shared
 :class:`FileContext` (AST, source lines, import aliases, noqa map), and
-collects :class:`Finding`s.  Suppression is per line:
+collects :class:`Finding`s.  Suppression is per line, reason mandatory
+(rule PIF503 audits the suppressions themselves):
 
-    something_flagged()  # pifft: noqa[PIF101]
-    something_flagged()  # pifft: noqa          (blanket: all rules)
+    something_flagged()  # pifft: noqa[PIF101]: window is not timed here
+    something_flagged()  # pifft: noqa: generated code (blanket: all rules)
+
+Only real COMMENT tokens count — a noqa tag inside a string literal or
+docstring (like the ones above) is inert.
 
 Findings serialize to JSON records; :func:`compare_baseline` splits a
 run against a committed baseline into (new, fixed) so CI fails on new
@@ -19,9 +23,12 @@ from __future__ import annotations
 import ast
 import dataclasses
 import fnmatch
+import io
 import json
 import os
 import re
+import subprocess
+import tokenize
 from collections import Counter
 from typing import Iterable, Iterator, Optional
 
@@ -30,7 +37,8 @@ SKIP_DIRS = {".git", "__pycache__", "native", ".venv", "build", "dist",
              ".eggs", "node_modules"}
 
 _NOQA_RE = re.compile(
-    r"#\s*pifft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s-]+)\])?", re.IGNORECASE)
+    r"#\s*pifft:\s*noqa(?:\[(?P<ids>[A-Za-z0-9_,\s-]+)\])?"
+    r"(?::\s*(?P<reason>\S.*))?", re.IGNORECASE)
 
 # messages may embed a source line ("window opened ... at line 42");
 # normalized out of the baseline key so surrounding edits don't
@@ -114,6 +122,24 @@ def dotted_name(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _comment_tokens(source: str) -> Iterator[tuple]:
+    """(line, col, text) for every real COMMENT token.  Tokenizing (not
+    a regex over raw lines) keeps noqa tags inside string literals and
+    docstrings — rule messages quoting the syntax, documentation
+    examples — from registering as suppressions or being audited as
+    them.  Falls back to a line scan when the file does not tokenize
+    (it already parsed, so this is nearly unreachable)."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(source.splitlines(), start=1):
+            pos = line.find("#")
+            if pos >= 0:
+                yield i, pos, line[pos:]
+
+
 class FileContext:
     """Everything rules need about one parsed file."""
 
@@ -123,27 +149,52 @@ class FileContext:
         self.tree = tree
         self.lines = source.splitlines()
         self.imports = ImportMap(tree)
+        #: per-file scratch space for the flow analyses
+        #: (check/flow.py) so rules sharing a CFG build it once
+        self.flow_cache: dict = {}
         # line -> set of suppressed rule ids, or {"*"} for blanket noqa
         self.noqa: dict[int, set] = {}
-        for i, line in enumerate(self.lines, start=1):
-            m = _NOQA_RE.search(line)
+        # line -> {"ids": [...], "reason": str|None, "col": int} — the
+        # audit surface behind `pifft check --list-noqa` and PIF503
+        self.noqa_info: dict[int, dict] = {}
+        for lineno, col, text in _comment_tokens(source):
+            m = _NOQA_RE.search(text)
             if not m:
                 continue
             ids = m.group("ids")
             if ids:
-                self.noqa[i] = {s.strip().upper()
-                                for s in ids.split(",") if s.strip()}
+                idset = {s.strip().upper()
+                         for s in ids.split(",") if s.strip()}
             else:
-                self.noqa[i] = {"*"}
+                idset = {"*"}
+            self.noqa[lineno] = idset
+            self.noqa_info[lineno] = {
+                "ids": sorted(idset),
+                "reason": (m.group("reason") or "").strip() or None,
+                "col": col + m.start(),
+            }
 
     def resolve_call(self, call: ast.Call) -> Optional[str]:
         """Canonical dotted target of a call, through the import map."""
         name = dotted_name(call.func)
         return self.imports.resolve(name) if name else None
 
-    def suppressed(self, finding: Finding) -> bool:
+    def suppressed(self, finding: Finding,
+                   rule: Optional["Rule"] = None) -> bool:
+        """Is `finding` silenced by a noqa comment on its line?  Rules
+        with ``blanket_suppressible = False`` (the noqa audit itself)
+        are strict: blanket noqa never silences them, and an explicit
+        listing only counts when the comment carries a reason — a
+        reasonless suppression cannot vouch for itself."""
         ids = self.noqa.get(finding.line)
-        return bool(ids) and ("*" in ids or finding.rule.upper() in ids)
+        if not ids:
+            return False
+        strict = rule is not None and not rule.blanket_suppressible
+        if finding.rule.upper() in ids:
+            if strict and not self.noqa_info[finding.line]["reason"]:
+                return False
+            return True
+        return "*" in ids and not strict
 
 
 class Rule:
@@ -162,6 +213,10 @@ class Rule:
     summary: str = ""
     invariant: str = ""
     default_config: dict = {}
+    #: rules auditing the suppression machinery itself (PIF503) opt
+    #: out of blanket noqa — otherwise the finding about a noqa
+    #: comment could be silenced by the very comment it is about
+    blanket_suppressible: bool = True
 
     def check(self, ctx: FileContext,
               config: dict) -> Iterator[Finding]:  # pragma: no cover
@@ -189,8 +244,10 @@ def register(cls: type) -> type:
 
 
 def all_rules() -> dict[str, Rule]:
-    """id -> rule instance, importing the bundled rule set on first use."""
+    """id -> rule instance, importing the bundled rule sets (syntactic
+    AND flow-sensitive) on first use."""
     from . import rules as _  # noqa: F401  (registration side effect)
+    from . import rules_flow as _rf  # noqa: F401  (same)
 
     return dict(_REGISTRY)
 
@@ -248,7 +305,7 @@ def check_source(path: str, source: str, rules: Optional[Iterable[str]] = None,
         if _exempt(path, rcfg.get("exempt", ())):
             continue
         for f in rule.check(ctx, rcfg):
-            if not ctx.suppressed(f):
+            if not ctx.suppressed(f, rule=rule):
                 out.append(f)
     return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
 
@@ -311,6 +368,130 @@ def format_human(findings: list) -> str:
              for f in findings]
     lines.append(f"pifft check: {len(findings)} finding(s)")
     return "\n".join(lines)
+
+
+#: the SARIF 2.1.0 schema URI GitHub code scanning validates against
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: list) -> str:
+    """SARIF 2.1.0 for `findings` — the CI artifact format GitHub code
+    scanning renders as inline annotations.  Rule metadata (name,
+    summary, invariant) rides runs[0].tool.driver.rules so the
+    annotation popovers explain WHICH measurement invariant broke."""
+    registry = all_rules()
+    used = sorted({f.rule for f in findings})
+    rules_meta = []
+    index = {}
+    for rid in used:
+        index[rid] = len(rules_meta)
+        rule = registry.get(rid)
+        meta = {"id": rid}
+        if rule is not None:
+            meta["name"] = rule.name
+            meta["shortDescription"] = {"text": rule.summary}
+            if rule.invariant:
+                meta["fullDescription"] = {"text": rule.invariant}
+        else:  # PIF000 and friends
+            meta["name"] = "engine-error"
+            meta["shortDescription"] = {
+                "text": "file unreadable or does not parse"}
+        rules_meta.append(meta)
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pifft-check",
+                "informationUri":
+                    "https://github.com/elenasolano/CS87Project"
+                    "-msolano2",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True)
+
+
+# ---------------------------------------------------------- noqa audit
+
+
+def collect_noqa(paths: Iterable[str]) -> list:
+    """Every `# pifft: noqa` suppression under `paths`, with its rule
+    ids and (possibly missing) reason — the `--list-noqa` inventory.
+    Unparseable files are skipped (they already surface as PIF000 in a
+    check run)."""
+    out = []
+    for path in iter_python_files(paths):
+        shown = _display_path(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            # unreadable/unparseable files already surface as PIF000
+            # in a check run; the inventory just skips them
+            continue
+        ctx = FileContext(shown, source, tree)
+        for lineno in sorted(ctx.noqa_info):
+            info = ctx.noqa_info[lineno]
+            out.append({"path": shown, "line": lineno,
+                        "ids": info["ids"], "reason": info["reason"]})
+    return out
+
+
+# ------------------------------------------------------- changed-file scope
+
+
+def changed_files(ref: str = "HEAD",
+                  anchor: Optional[str] = None) -> set:
+    """Absolute paths of files changed vs `ref` (committed diff,
+    staged, unstaged AND untracked) in the git repo containing
+    `anchor` (default: the repo this package lives in).  Raises
+    RuntimeError with git's message when the query fails — the CLI
+    turns that into a usage error rather than silently checking
+    nothing."""
+    anchor = anchor or _REPO_ROOT
+
+    def _git(*args) -> str:
+        proc = subprocess.run(
+            ["git", "-C", anchor, *args],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    root = _git("rev-parse", "--show-toplevel").strip()
+    changed: set = set()
+    for chunk in _git("diff", "--name-only", "-z", ref, "--").split("\0"):
+        if chunk:
+            changed.add(os.path.abspath(os.path.join(root, chunk)))
+    # --full-name: ls-files is cwd-relative by default, diff is
+    # root-relative — force both onto the root so the join agrees
+    for chunk in _git("ls-files", "--others", "--exclude-standard",
+                      "--full-name", "-z").split("\0"):
+        if chunk:
+            changed.add(os.path.abspath(os.path.join(root, chunk)))
+    return changed
 
 
 # -------------------------------------------------------------- baseline

@@ -1,0 +1,642 @@
+"""Flow-sensitive analysis layer for the check subsystem.
+
+The syntactic rule engine (:mod:`.engine`, :mod:`.rules`) judges one
+AST node at a time; it cannot see *paths*.  But the repo's hardest
+discipline invariants are path properties: "every DMA start is waited
+exactly once on every path" (the fourstep/sixstep kernels,
+docs/KERNELS.md), "busy_s is only written under its lock" (the PR-12
+utilization race), "every quota charge is released even on the
+exception path", "a demotion trail never escapes untagged"
+(docs/RESILIENCE.md's never-silent rule).  This module supplies the
+machinery those rules (:mod:`.rules_flow`) share:
+
+* :func:`build_cfg` — a per-function control-flow graph over the
+  existing :class:`~.engine.FileContext` AST: branches, loops (with
+  back edges), ``try``/``except``/``finally``, ``with`` blocks, early
+  returns, ``break``/``continue``, explicit ``raise``.  Two modeling
+  options matter to kernel code: decorated nested defs matching
+  ``inline_decorated`` globs (the ``@pl.when(...)`` idiom) are inlined
+  as *conditional regions* — their bodies execute, maybe, right where
+  they are defined — and ``loop_back_edge=True`` adds an exit→entry
+  edge, modeling a Pallas grid kernel whose program body re-runs once
+  per grid step (that is how a write started at step ``i`` is legally
+  waited at step ``i+2``).
+
+* :func:`pair_events` / :class:`PairingResult` — the path-pairing
+  analysis: given open/close events on CFG nodes, a count-set dataflow
+  plus per-open reachability queries yield **must**/**may** verdicts
+  ("unclosed on every path" / "a path exists that skips the close")
+  and over-close detection ("a path exists on which this close runs
+  with nothing open").
+
+* :func:`locksets` — which statements execute under which
+  ``with <lock>:`` / held-resource regions: the syntactic with-nesting
+  (exact in Python — a ``with`` body cannot be left without releasing)
+  unioned with a must-dataflow over explicit ``.acquire()`` /
+  ``.release()`` calls (intersection at merge points, so a lock held on
+  only one inbound path does not count).
+
+Exception modeling is deliberately selective: *explicit* ``raise``
+statements and the exceptional edges into an existing
+``except``/``finally`` always exist; implicit "any statement may
+throw" edges exist only *inside* a ``try`` that has somewhere to go,
+and they carry the state from **before** the statement (an open that
+itself throws did not open).  That keeps the analyses quiet on
+straight-line code while still catching the planted
+acquire-then-raise leak.
+
+Everything here is pure ``ast`` — no imports of the analyzed code.
+Rules cache shared results per file on ``FileContext.flow_cache``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+from typing import Iterable, Iterator, Optional
+
+from .engine import dotted_name
+
+FN_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def function_defs(tree: ast.AST) -> Iterator:
+    """Every function definition in the module, any nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, FN_DEFS):
+            yield node
+
+
+def decorator_matches(fn, globs: Iterable[str]) -> bool:
+    """True when any decorator's dotted name (the call's func for
+    ``@pl.when(cond)`` style) matches a glob — matched on the full
+    dotted form AND its last segment, so ``pl.when``, ``pltpu.when``
+    and a bare ``when`` all hit the ``when`` glob."""
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if not name:
+            continue
+        last = name.split(".")[-1]
+        if any(fnmatch.fnmatch(name, g) or fnmatch.fnmatch(last, g)
+               for g in globs):
+            return True
+    return False
+
+
+def shallow_walk(node: ast.AST, *, into_lambdas: bool = False) -> Iterator:
+    """Walk a subtree without descending into nested function bodies
+    (their statements run when *called*, not here).  ``into_lambdas``
+    opts lambda bodies back in — the close-via-callback idiom
+    (``future.add_done_callback(lambda _: pool.release(t))``) registers
+    the close at this statement."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, FN_DEFS):
+            continue
+        if isinstance(n, ast.Lambda) and not into_lambdas:
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ------------------------------------------------------------------ CFG
+
+
+@dataclasses.dataclass
+class Node:
+    """One CFG node ≈ one simple statement (compound statements
+    contribute a *header* node scanning only their test/iter/context
+    expressions; their bodies become separate nodes)."""
+
+    idx: int
+    stmt: Optional[ast.AST]      # the owning ast node (None for markers)
+    scan: tuple                  # ast nodes event extractors may scan
+    locks: frozenset             # sync with-lock tokens held here
+    async_locks: frozenset       # async with-lock tokens held here
+    kind: str = "stmt"           # entry/exit/raise_exit/stmt/return/...
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+class CFG:
+    """The graph: ``nodes``, successor sets, and the three distinguished
+    nodes ``entry``, ``exit`` (normal returns + fallthrough) and
+    ``raise_exit`` (explicit raises / unhandled exceptional paths)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.nodes: list = []
+        self.succ: dict = {}
+        self.entry = self._new(None, (), kind="entry")
+        self.exit = self._new(None, (), kind="exit")
+        self.raise_exit = self._new(None, (), kind="raise_exit")
+
+    def _new(self, stmt, scan, locks=frozenset(), async_locks=frozenset(),
+             kind="stmt") -> int:
+        idx = len(self.nodes)
+        self.nodes.append(Node(idx=idx, stmt=stmt, scan=tuple(scan),
+                               locks=frozenset(locks),
+                               async_locks=frozenset(async_locks),
+                               kind=kind))
+        self.succ[idx] = set()
+        return idx
+
+    def add_edge(self, a: int, b: int) -> None:
+        self.succ[a].add(b)
+
+    def preds(self) -> dict:
+        out: dict = {i: set() for i in self.succ}
+        for a, bs in self.succ.items():
+            for b in bs:
+                out[b].add(a)
+        return out
+
+    def reachable(self, src: int, avoid: frozenset = frozenset()) -> set:
+        """Node ids reachable FROM `src` (src excluded unless cyclic)
+        without passing *through* any node in `avoid` (an avoided node
+        is never entered)."""
+        seen: set = set()
+        stack = [s for s in self.succ[src] if s not in avoid]
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(s for s in self.succ[n]
+                         if s not in seen and s not in avoid)
+        return seen
+
+    def statement_nodes(self) -> Iterator[Node]:
+        for n in self.nodes:
+            if n.stmt is not None:
+                yield n
+
+
+class _Builder:
+    def __init__(self, fn, inline_decorated, lock_globs):
+        self.cfg = CFG(fn)
+        self.inline = tuple(inline_decorated)
+        self.lock_globs = tuple(lock_globs)
+        self.locks: list = []        # [(token, is_async)]
+        self.loops: list = []        # [(head_idx, break_list)]
+        self.exc_targets: list = []  # innermost-last exception targets
+
+    # -- helpers
+
+    def _cur_locks(self) -> tuple:
+        sync = frozenset(t for t, a in self.locks if not a)
+        asyn = frozenset(t for t, a in self.locks if a)
+        return sync, asyn
+
+    def node(self, stmt, scan, kind="stmt") -> int:
+        sync, asyn = self._cur_locks()
+        return self.cfg._new(stmt, scan, sync, asyn, kind=kind)
+
+    def _exc_target(self) -> int:
+        return self.exc_targets[-1] if self.exc_targets \
+            else self.cfg.raise_exit
+
+    def _lock_token(self, expr) -> Optional[str]:
+        name = dotted_name(expr)
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+        if not name:
+            return None
+        last = name.split(".")[-1].lower()
+        if any(fnmatch.fnmatch(last, g.lower()) for g in self.lock_globs):
+            return name
+        return None
+
+    # -- construction
+
+    def build(self, loop_back_edge: bool) -> CFG:
+        frontier = self.block(self.cfg.fn.body, [self.cfg.entry])
+        for f in frontier:
+            self.cfg.add_edge(f, self.cfg.exit)
+        if loop_back_edge:
+            # grid-kernel semantics: the program body re-runs per grid
+            # step, so "later" includes the next step's whole body
+            self.cfg.add_edge(self.cfg.exit, self.cfg.entry)
+        return self.cfg
+
+    def block(self, stmts, frontier: list) -> list:
+        for stmt in stmts:
+            frontier = self.statement(stmt, frontier)
+        return frontier
+
+    def _link(self, frontier: list, idx: int) -> list:
+        for f in frontier:
+            self.cfg.add_edge(f, idx)
+        return [idx]
+
+    def statement(self, stmt, frontier: list) -> list:
+        cfg = self.cfg
+        if isinstance(stmt, FN_DEFS):
+            if self.inline and decorator_matches(stmt, self.inline):
+                # @pl.when(...) region: the body executes, maybe, here
+                inner = self.block(stmt.body, list(frontier))
+                return list(frontier) + [f for f in inner
+                                         if f not in frontier]
+            return self._link(frontier, self.node(stmt, ()))
+        if isinstance(stmt, ast.Return):
+            idx = self.node(stmt, (stmt.value,) if stmt.value else (),
+                            kind="return")
+            self._link(frontier, idx)
+            cfg.add_edge(idx, cfg.exit)
+            return []
+        if isinstance(stmt, ast.Raise):
+            scan = tuple(x for x in (stmt.exc, stmt.cause) if x)
+            idx = self.node(stmt, scan, kind="raise")
+            self._link(frontier, idx)
+            cfg.add_edge(idx, self._exc_target())
+            return []
+        if isinstance(stmt, ast.Break):
+            idx = self.node(stmt, ())
+            self._link(frontier, idx)
+            if self.loops:
+                self.loops[-1][1].append(idx)
+            return []
+        if isinstance(stmt, ast.Continue):
+            idx = self.node(stmt, ())
+            self._link(frontier, idx)
+            if self.loops:
+                cfg.add_edge(idx, self.loops[-1][0])
+            return []
+        if isinstance(stmt, ast.If):
+            head = self.node(stmt, (stmt.test,))
+            self._link(frontier, head)
+            body_f = self.block(stmt.body, [head])
+            if stmt.orelse:
+                else_f = self.block(stmt.orelse, [head])
+                return body_f + else_f
+            return body_f + [head]
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                scan = (stmt.test,)
+            else:
+                scan = (stmt.target, stmt.iter)
+            head = self.node(stmt, scan, kind="loop")
+            self._link(frontier, head)
+            breaks: list = []
+            self.loops.append((head, breaks))
+            body_f = self.block(stmt.body, [head])
+            self.loops.pop()
+            for f in body_f:
+                cfg.add_edge(f, head)  # the back edge
+            out = [head] + breaks
+            if stmt.orelse:
+                out = self.block(stmt.orelse, [head]) + breaks
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            is_async = isinstance(stmt, ast.AsyncWith)
+            scan = tuple(item.context_expr for item in stmt.items)
+            head = self.node(stmt, scan, kind="with")
+            self._link(frontier, head)
+            pushed = 0
+            for item in stmt.items:
+                token = self._lock_token(item.context_expr)
+                if token:
+                    self.locks.append((token, is_async))
+                    pushed += 1
+            body_f = self.block(stmt.body, [head])
+            for _ in range(pushed):
+                self.locks.pop()
+            return body_f
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+        if isinstance(stmt, ast.Match):
+            head = self.node(stmt, (stmt.subject,))
+            self._link(frontier, head)
+            out = [head]
+            for case in stmt.cases:
+                out += self.block(case.body, [head])
+            return out
+        # simple statement
+        scan = (stmt,)
+        kind = "stmt"
+        idx = self.node(stmt, scan, kind=kind)
+        return self._link(frontier, idx)
+
+    def _try(self, stmt: ast.Try, frontier: list) -> list:
+        cfg = self.cfg
+        marker = self.node(stmt, (), kind="try")
+        self._link(frontier, marker)
+
+        handler_heads: list = []
+
+        # Where do exceptions raised in the body go?  Handlers first;
+        # a handler-less try/finally routes them through the finally.
+        # Register BEFORE building the body so nested raises see it.
+        route_placeholder = self.node(None, (), kind="exc_route") \
+            if (stmt.handlers or stmt.finalbody) else None
+        if route_placeholder is not None:
+            self.exc_targets.append(route_placeholder)
+
+        body_start = len(cfg.nodes)
+        body_f = self.block(stmt.body, [marker])
+        body_nodes = set(range(body_start, len(cfg.nodes)))
+
+        if route_placeholder is not None:
+            self.exc_targets.pop()
+
+        # implicit-throw edges carry the state from BEFORE a statement:
+        # source them from the marker and from every body node that has
+        # a successor still inside the body (i.e. every pre-state)
+        exc_sources = [marker] + [
+            n for n in body_nodes
+            if cfg.succ[n] & body_nodes
+        ]
+        if route_placeholder is not None:
+            for src in exc_sources:
+                cfg.add_edge(src, route_placeholder)
+
+        handler_fs: list = []
+        for handler in stmt.handlers:
+            head = self.node(handler,
+                             (handler.type,) if handler.type else (),
+                             kind="handler")
+            handler_heads.append(head)
+            if route_placeholder is not None:
+                cfg.add_edge(route_placeholder, head)
+            handler_fs += self.block(handler.body, [head])
+
+        orelse_f = self.block(stmt.orelse, body_f) if stmt.orelse \
+            else body_f
+
+        normal_f = orelse_f + handler_fs
+        if stmt.finalbody:
+            fin_marker = self.node(None, (), kind="finally")
+            for f in normal_f:
+                cfg.add_edge(f, fin_marker)
+            if route_placeholder is not None and not stmt.handlers:
+                # no handler: the exceptional path runs the finally
+                cfg.add_edge(route_placeholder, fin_marker)
+            fin_f = self.block(stmt.finalbody, [fin_marker])
+            # after the finally, control either continues (normal) or
+            # keeps propagating (exceptional) — over-approximate with
+            # both edges
+            for f in fin_f:
+                cfg.add_edge(f, self._exc_target())
+            return fin_f
+        if route_placeholder is not None and not stmt.handlers:
+            cfg.add_edge(route_placeholder, self._exc_target())
+        return normal_f
+
+
+def build_cfg(fn, *, inline_decorated: Iterable[str] = (),
+              loop_back_edge: bool = False,
+              lock_globs: Iterable[str] = ("*lock*",)) -> CFG:
+    """Build the CFG of one function definition.  See the module
+    docstring for the modeling choices; `lock_globs` names which
+    ``with`` context expressions count as lock regions (matched
+    case-insensitively against the dotted name's last segment)."""
+    return _Builder(fn, inline_decorated, lock_globs).build(loop_back_edge)
+
+
+# ------------------------------------------------------- pairing analysis
+
+
+@dataclasses.dataclass
+class Event:
+    """One pairing event on a CFG node.  ``kind``: "open", "close" or
+    "reset" (a rebinding that forgets prior state)."""
+
+    kind: str
+    token: str
+    node: int
+    ast_node: ast.AST
+
+
+@dataclasses.dataclass
+class OpenVerdict:
+    event: Event
+    may_leak: bool    # a path open→exit exists that avoids every close
+    must_leak: bool   # NO close of this token is reachable from the open
+
+
+@dataclasses.dataclass
+class PairingResult:
+    opens: list                   # [OpenVerdict]
+    over_closes: list             # [Event] closes that can run with 0 open
+    exit_counts: dict             # token -> frozenset of possible counts
+
+    def leaks(self, must_only: bool = False) -> list:
+        return [v for v in self.opens
+                if (v.must_leak if must_only else v.may_leak)]
+
+
+def pair_events(cfg: CFG, events: list,
+                leak_exits: Optional[Iterable[int]] = None
+                ) -> PairingResult:
+    """Run the pairing analysis for `events` (list of :class:`Event`)
+    over `cfg`.  `leak_exits` are the nodes at which an unclosed open
+    counts as leaked (default: the normal exit only — pass
+    ``(cfg.exit, cfg.raise_exit)`` to demand pairing on exception
+    paths too, the resource-discipline setting)."""
+    exits = tuple(leak_exits) if leak_exits is not None else (cfg.exit,)
+    by_node: dict = {}
+    tokens: set = set()
+    for ev in events:
+        by_node.setdefault(ev.node, []).append(ev)
+        tokens.add(ev.token)
+    if not tokens:
+        return PairingResult([], [], {})
+
+    # --- count-set dataflow (union join, saturating counts 0..2)
+    init = {t: frozenset([0]) for t in tokens}
+    state: dict = {cfg.entry: init}
+    over: dict = {}
+    worklist = [cfg.entry]
+    while worklist:
+        n = worklist.pop()
+        cur = state[n]
+        out = cur
+        evs = by_node.get(n)
+        if evs:
+            out = dict(cur)
+            for ev in evs:
+                counts = out[ev.token]
+                if ev.kind == "open":
+                    out[ev.token] = frozenset(min(c + 1, 2)
+                                              for c in counts)
+                elif ev.kind == "close":
+                    if 0 in counts:
+                        over[id(ev)] = ev
+                    out[ev.token] = frozenset(max(c - 1, 0)
+                                              for c in counts)
+                else:  # reset
+                    out[ev.token] = frozenset([0])
+        for s in cfg.succ[n]:
+            prev = state.get(s)
+            if prev is None:
+                state[s] = dict(out)
+                worklist.append(s)
+            else:
+                changed = False
+                for t in tokens:
+                    merged = prev[t] | out[t]
+                    if merged != prev[t]:
+                        prev[t] = merged
+                        changed = True
+                if changed:
+                    worklist.append(s)
+
+    # --- per-open reachability verdicts
+    close_nodes: dict = {}
+    for ev in events:
+        if ev.kind == "close":
+            close_nodes.setdefault(ev.token, set()).add(ev.node)
+    opens: list = []
+    exit_leakable: dict = {}
+    for t in tokens:
+        counts: set = set()
+        for x in exits:
+            counts |= set(state.get(x, {}).get(t, frozenset()))
+        exit_leakable[t] = any(c >= 1 for c in counts)
+    for ev in events:
+        if ev.kind != "open":
+            continue
+        closes = frozenset(close_nodes.get(ev.token, ()))
+        reach_all = cfg.reachable(ev.node)
+        must = not (closes & reach_all)
+        reach_avoid = cfg.reachable(ev.node, avoid=closes)
+        may = (any(x in reach_avoid for x in exits)
+               and exit_leakable[ev.token]) or must
+        opens.append(OpenVerdict(event=ev, may_leak=may, must_leak=must))
+    exit_counts = {t: frozenset().union(*(
+        state.get(x, {}).get(t, frozenset()) for x in exits))
+        for t in tokens}
+    return PairingResult(opens=opens, over_closes=list(over.values()),
+                         exit_counts=exit_counts)
+
+
+# -------------------------------------------------------------- locksets
+
+
+def flow_locksets(cfg: CFG, lock_globs: Iterable[str] = ("*lock*",)
+                  ) -> dict:
+    """node idx -> frozenset of lock tokens **held** there: the
+    syntactic ``with``-region locks recorded on each node, unioned with
+    a must-dataflow over explicit ``.acquire()``/``.release()`` calls
+    (join = intersection: a lock held on only one inbound path is not
+    held at the merge)."""
+    globs = tuple(g.lower() for g in lock_globs)
+
+    def _explicit(node: Node) -> list:
+        out = []
+        for root in node.scan:
+            if root is None:
+                continue
+            # an `await lock.acquire()` is an asyncio.Lock — the
+            # sanctioned kind; only bare (sync) acquires count as
+            # holding a THREADING lock
+            awaited: set = set()
+            for n in shallow_walk(root):
+                if isinstance(n, ast.Await):
+                    for inner in ast.walk(n.value):
+                        awaited.add(id(inner))
+            for n in shallow_walk(root):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("acquire", "release")):
+                    continue
+                if id(n) in awaited:
+                    continue
+                recv = dotted_name(n.func.value)
+                if not recv:
+                    continue
+                last = recv.split(".")[-1].lower()
+                if any(fnmatch.fnmatch(last, g) for g in globs):
+                    out.append((n.func.attr, recv))
+        return out
+
+    gains: dict = {}
+    for node in cfg.nodes:
+        ops = _explicit(node)
+        if ops:
+            gains[node.idx] = ops
+
+    TOP = None  # unreached
+    state: dict = {cfg.entry: frozenset()}
+    worklist = [cfg.entry]
+    while worklist:
+        n = worklist.pop()
+        cur = state[n]
+        out = cur
+        for op, recv in gains.get(n, ()):
+            out = (out | {recv}) if op == "acquire" else (out - {recv})
+        for s in cfg.succ[n]:
+            prev = state.get(s, TOP)
+            merged = out if prev is None else (prev & out)
+            if prev is None or merged != prev:
+                state[s] = merged
+                worklist.append(s)
+
+    return {node.idx: node.locks | state.get(node.idx, frozenset())
+            for node in cfg.nodes}
+
+
+# ------------------------------------------------------- shared helpers
+
+
+def escaping_names(fn, *, exclude_calls=()) -> set:
+    """Local names whose value ESCAPES the function — returned/yielded,
+    stored into an attribute/subscript/container, or passed as a call
+    argument (calls whose resolved attribute name is in
+    `exclude_calls` — e.g. the close call itself — do not count).
+    Flow-insensitive and deliberately conservative: an escaped resource
+    changed owners, so pairing rules drop its obligation."""
+    out: set = set()
+    for node in shallow_walk_body(fn):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = getattr(node, "value", None)
+            if val is not None:
+                for n in ast.walk(val):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, ast.Assign):
+            stores_out = any(
+                not isinstance(t, ast.Name) for t in node.targets)
+            if stores_out:
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in exclude_calls:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    return out
+
+
+def shallow_walk_body(fn) -> Iterator:
+    """Walk a function's body without entering nested defs/lambdas."""
+    for stmt in fn.body:
+        yield from shallow_walk(stmt)
+
+
+def assigned_names(fn) -> set:
+    """Names bound by plain assignment/for/with in the function's own
+    body (no nested defs)."""
+    out: set = set()
+    for node in shallow_walk_body(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store,)):
+            out.add(node.id)
+        elif isinstance(node, ast.arg):
+            out.add(node.arg)
+    for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+        out.add(a.arg)
+    if fn.args.vararg:
+        out.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        out.add(fn.args.kwarg.arg)
+    return out

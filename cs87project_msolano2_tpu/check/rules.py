@@ -1092,6 +1092,43 @@ class BroadExceptSwallow(Rule):
 
 
 @register
+class NoqaWithoutReason(Rule):
+    id = "PIF503"
+    name = "noqa-without-reason"
+    summary = ("every `# pifft: noqa` (blanket or rule-scoped) must "
+               "carry a trailing reason: "
+               "`# pifft: noqa[PIF104]: two-trip fallback is "
+               "intentional`")
+    invariant = ("a suppression is a claim that the invariant holds "
+                 "anyway — and an unexplained claim cannot be audited "
+                 "or retired.  `pifft check --list-noqa` inventories "
+                 "every suppression with its reason; a reasonless one "
+                 "is a finding in its own right.  This rule is NOT "
+                 "silenced by blanket noqa (the comment under audit "
+                 "must not vouch for itself); listing PIF503 "
+                 "explicitly — with a reason — still works")
+    default_config = {}
+    blanket_suppressible = False
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import types
+
+        for lineno in sorted(ctx.noqa_info):
+            info = ctx.noqa_info[lineno]
+            if info["reason"]:
+                continue
+            ids = ", ".join(info["ids"])
+            anchor = types.SimpleNamespace(lineno=lineno,
+                                           col_offset=info["col"])
+            yield self.finding(
+                ctx, anchor,
+                f"noqa [{ids}] without a reason — append one "
+                f"(`# pifft: noqa[{info['ids'][0]}]: <why the "
+                f"invariant holds anyway>`) so the suppression can "
+                f"be audited by --list-noqa")
+
+
+@register
 class LegacyTablesKwarg(Rule):
     id = "PIF502"
     name = "legacy-tables-kwarg"

@@ -1,0 +1,890 @@
+"""Flow-sensitive rules: path invariants as machine checks.
+
+Every rule here rides the :mod:`.flow` engine (CFG + pairing +
+locksets) and declares its *vocabulary* — which calls open/close a
+resource, which names are locks, which appends are demotions — in
+``default_config``, so the engine stays generic and a new discipline is
+a config entry plus a message, not a new analysis.
+
+Id groups (docs/CHECKS.md has the catalog):
+
+* **PIF302/PIF303/PIF304 — DMA discipline** (the 300-series' flow
+  half): every ``make_async_copy(...).start()`` in a kernel is waited
+  exactly once on every path.  The fourstep/sixstep kernels' manual
+  double-buffered DMA (docs/KERNELS.md) is exactly where review prose
+  said "each start waited exactly once" — now the checker says it.
+  Kernels containing ``@pl.when`` phase regions are modeled with GRID
+  semantics (the program body re-runs per grid step), because that is
+  how a write started at step ``i`` is legally waited at step ``i+2``.
+
+* **PIF112/PIF113 — lock discipline** in the serving layer: a write to
+  a shared attribute that is elsewhere guarded (or that happens on an
+  executor thread) must itself be under the lock — the PR-12
+  ``busy_s`` race class; and an ``await`` while holding a *threading*
+  lock parks the whole event loop on it.
+
+* **PIF114 — resource pairing**: BufferPool ``acquire``/``release``,
+  AdmissionController ``charge``/``release``, journal append handles —
+  every open is matched on every path, exception paths included
+  (releasing via a future callback registered on the path counts).
+
+* **PIF115 — untagged demotion**: a path that grows a degrade/demotion
+  trail (or walks a degrade rung) must set ``degraded`` before the
+  value escapes — the resilience subsystem's never-silent rule
+  (docs/RESILIENCE.md) as a path property.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from typing import Iterator, Optional
+
+from . import flow
+from .engine import FileContext, Rule, dotted_name, register
+
+FN_DEFS = flow.FN_DEFS
+
+
+def _in_scope(ctx: FileContext, config: dict) -> bool:
+    norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+    return any(fnmatch.fnmatch(norm, pat) for pat in config["paths"])
+
+
+def _cache(ctx: FileContext) -> dict:
+    cache = getattr(ctx, "flow_cache", None)
+    if cache is None:
+        cache = ctx.flow_cache = {}
+    return cache
+
+
+def _last_segment(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _matches(name: str, globs) -> bool:
+    low = name.lower()
+    return any(fnmatch.fnmatch(low, g.lower()) for g in globs)
+
+
+# =================================================== DMA discipline (3xx)
+
+
+class _DmaAnalysis:
+    """Shared per-file DMA pairing analysis (computed once, read by the
+    three 30x rules via the FileContext flow cache).
+
+    Findings are (rule_id, ast_node, message) triples."""
+
+    CACHE_KEY = "dma"
+
+    def __init__(self, ctx: FileContext, config: dict):
+        self.ctx = ctx
+        self.config = config
+        self.findings: dict = {"PIF302": [], "PIF303": [], "PIF304": []}
+        roots = [fn for fn in flow.function_defs(ctx.tree)
+                 if not flow.decorator_matches(
+                     fn, config["when_decorators"])]
+        for fn in roots:
+            self._analyze(fn)
+
+    # -- vocabulary
+
+    def _copy_helpers(self, fn) -> dict:
+        """name -> def for nested helpers whose body returns a
+        make_async_copy-style call (the reconstructed-descriptor
+        idiom the kernels use)."""
+        helpers: dict = {}
+        suffixes = self.config["copy_calls"]
+        for node in ast.walk(fn):
+            if not isinstance(node, FN_DEFS) or node is fn:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) \
+                        and isinstance(sub.value, ast.Call) \
+                        and _last_segment(dotted_name(sub.value.func)) \
+                        in suffixes:
+                    helpers[node.name] = node
+                    break
+        return helpers
+
+    def _is_copy_call(self, call: ast.Call, helpers: dict) -> Optional[str]:
+        """Stream token for a call producing a DMA descriptor."""
+        name = dotted_name(call.func)
+        if isinstance(call.func, ast.Name) and call.func.id in helpers:
+            return f"stream:{call.func.id}"
+        if _last_segment(name) in self.config["copy_calls"]:
+            return "copy:" + ast.unparse(call)
+        return None
+
+    # -- per-function analysis
+
+    def _analyze(self, fn) -> None:
+        cfg_conf = self.config
+        helpers = self._copy_helpers(fn)
+        grid = any(flow.decorator_matches(d, cfg_conf["when_decorators"])
+                   for d in ast.walk(fn)
+                   if isinstance(d, FN_DEFS) and d is not fn)
+        # cheap pre-scan: skip functions with no DMA vocabulary at all
+        has_dma = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    self._is_copy_call(node, helpers):
+                has_dma = True
+                break
+        if not has_dma:
+            return
+
+        cfg = flow.build_cfg(fn,
+                             inline_decorated=cfg_conf["when_decorators"],
+                             loop_back_edge=grid)
+        events: list = []
+        dma_vars: set = set()
+        # first pass: find var bindings so later waits resolve
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub in flow.shallow_walk(root):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Name) \
+                            and isinstance(sub.value, ast.Call) \
+                            and self._is_copy_call(sub.value, helpers):
+                        dma_vars.add(sub.targets[0].id)
+        start_m = cfg_conf["start_method"]
+        wait_m = cfg_conf["wait_method"]
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub in flow.shallow_walk(root):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Name) \
+                            and isinstance(sub.value, ast.Call) \
+                            and self._is_copy_call(sub.value, helpers):
+                        events.append(flow.Event(
+                            "reset", f"var:{sub.targets[0].id}",
+                            node.idx, sub))
+                        continue
+                    if not (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in (start_m, wait_m)
+                            and not sub.args):
+                        continue
+                    kind = "open" if sub.func.attr == start_m else "close"
+                    recv = sub.func.value
+                    if isinstance(recv, ast.Call):
+                        # stream token (helper name) or anonymous
+                        # descriptor (keyed by its reconstructed call
+                        # text — start and wait must match exactly)
+                        token = self._is_copy_call(recv, helpers)
+                        if token is None:
+                            continue
+                        events.append(flow.Event(kind, token,
+                                                 node.idx, sub))
+                    elif isinstance(recv, ast.Name) \
+                            and recv.id in dma_vars:
+                        events.append(flow.Event(
+                            kind, f"var:{recv.id}", node.idx, sub))
+        if not events:
+            return
+        result = flow.pair_events(cfg, events)
+        helper_hint = (" (grid kernel: a start with no wait site "
+                       "anywhere can never retire)" if grid else "")
+        for verdict in result.opens:
+            ev = verdict.event
+            label = ev.token.split(":", 1)[1]
+            if verdict.must_leak:
+                self.findings["PIF302"].append((
+                    ev.ast_node,
+                    f"DMA start of `{label}` is never waited: no "
+                    f"matching .{wait_m}() is reachable from this "
+                    f".{start_m}(){helper_hint} — every async copy "
+                    f"must be waited exactly once (docs/KERNELS.md)"))
+            elif verdict.may_leak and not grid:
+                self.findings["PIF304"].append((
+                    ev.ast_node,
+                    f"the .{wait_m}() for DMA `{label}` can be "
+                    f"skipped: a branch/loop path from this "
+                    f".{start_m}() reaches the function exit without "
+                    f"waiting — the copy may still be in flight when "
+                    f"its buffers are reused"))
+        if not grid:
+            for ev in result.over_closes:
+                label = ev.token.split(":", 1)[1]
+                self.findings["PIF303"].append((
+                    ev.ast_node,
+                    f"DMA `{label}` can be waited with nothing in "
+                    f"flight on some path (double-wait, or a wait "
+                    f"whose start a branch skipped) — a second "
+                    f".{wait_m}() on a retired semaphore hangs the "
+                    f"kernel"))
+
+
+_DMA_DEFAULTS = {
+    "paths": ("*/ops/*",),
+    "copy_calls": ("make_async_copy", "make_copy"),
+    "when_decorators": ("when",),
+    "start_method": "start",
+    "wait_method": "wait",
+}
+
+
+def _dma_findings(rule: Rule, ctx: FileContext, config: dict) -> Iterator:
+    if not _in_scope(ctx, config):
+        return
+    cache = _cache(ctx)
+    key = (_DmaAnalysis.CACHE_KEY,
+           tuple(sorted((k, tuple(v) if isinstance(v, (list, tuple))
+                         else v) for k, v in config.items())))
+    analysis = cache.get(key)
+    if analysis is None:
+        analysis = cache[key] = _DmaAnalysis(ctx, config)
+    for node, message in analysis.findings.get(rule.id, ()):
+        yield rule.finding(ctx, node, message)
+
+
+@register
+class DmaStartNotWaited(Rule):
+    id = "PIF302"
+    name = "dma-start-not-waited"
+    summary = ("flow: a make_async_copy .start() with no .wait() "
+               "reachable on any path — the copy can never retire")
+    invariant = ("the fourstep/sixstep kernels' manual DMA pipelines "
+                 "(docs/KERNELS.md) promise 'every start is waited "
+                 "exactly once': an unwaited start leaves the copy in "
+                 "flight when its staging slot is reused, which "
+                 "corrupts the carry on hardware and deadlocks the "
+                 "semaphore on the next kernel — invisible in "
+                 "interpret mode, fatal on the device.  Kernels with "
+                 "@pl.when phase regions are modeled with grid "
+                 "semantics: the wait may live in a later grid step, "
+                 "but it must exist")
+    default_config = dict(_DMA_DEFAULTS)
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        yield from _dma_findings(self, ctx, config)
+
+
+@register
+class DmaDoubleWait(Rule):
+    id = "PIF303"
+    name = "dma-double-wait"
+    summary = ("flow: a path exists on which a DMA descriptor is "
+               "waited twice (or waited without a start)")
+    invariant = ("waiting an async copy whose semaphore already "
+                 "retired blocks forever: the second .wait() has no "
+                 "signal coming.  The flow analysis walks every "
+                 "branch/loop path counting starts against waits, so "
+                 "a wait reachable twice without an intervening start "
+                 "— or a wait whose start a branch skipped — is "
+                 "caught before it wedges a device")
+    default_config = dict(_DMA_DEFAULTS)
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        yield from _dma_findings(self, ctx, config)
+
+
+@register
+class DmaWaitSkippable(Rule):
+    id = "PIF304"
+    name = "dma-wait-skippable"
+    summary = ("flow: a branch/loop path can skip the .wait() of a "
+               "started DMA copy")
+    invariant = ("a wait that only happens on SOME paths (inside a "
+                 "conditional, inside a loop that can run zero times) "
+                 "is the subtle half of the pairing discipline: the "
+                 "kernel works on the tested path and corrupts data "
+                 "on the untested one.  The pairing analysis reports "
+                 "the may-verdict — a path exists from the start to "
+                 "the exit that avoids every wait")
+    default_config = dict(_DMA_DEFAULTS)
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        yield from _dma_findings(self, ctx, config)
+
+
+# ============================================ PIF112 unguarded shared write
+
+
+@register
+class UnguardedSharedStateWrite(Rule):
+    id = "PIF112"
+    name = "unguarded-shared-state-write"
+    summary = ("flow: a write to a shared attribute outside its lock — "
+               "the attribute is elsewhere accessed under a lock "
+               "region, or the write runs on an executor thread")
+    invariant = ("the serving layer mixes the event loop with executor "
+                 "threads, so attributes like MeshDevice.busy_s "
+                 "accumulate from BOTH at once: a lost `+=` skews the "
+                 "utilization rows the mesh balance gate reads — the "
+                 "exact race PR-12 review fixed by locking the "
+                 "accounting.  Two evidence sources: (a) the same "
+                 "attribute is accessed under a `with <lock>:` region "
+                 "elsewhere in the file (so an unlocked write "
+                 "bypasses an established discipline), and (b) the "
+                 "write happens inside a function handed to an "
+                 "executor/thread (so it races the loop even if the "
+                 "lock was deleted everywhere — the regression "
+                 "direction).  __init__-time writes are exempt: no "
+                 "concurrency exists yet")
+    default_config = {
+        "paths": ("*/serve/*",),
+        "lock_globs": ("*lock*",),
+        "init_methods": ("__init__", "__post_init__", "__new__"),
+        # call entry points whose function-argument runs on another
+        # thread (names checked as suffixes of the resolved target)
+        "thread_entry_calls": ("run_in_executor", "Thread",
+                               "supervise_collective", "submit"),
+    }
+
+    #: guarded-evidence wildcard: the access receiver's class is
+    #: statically unknown (anything but `self`/`cls`)
+    _ANY_CLASS = "<any>"
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        if not _in_scope(ctx, config):
+            return
+        defs = list(flow.function_defs(ctx.tree))
+        parents: dict = {}
+        for fn in defs:
+            for sub in ast.walk(fn):
+                if isinstance(sub, FN_DEFS) and sub is not fn:
+                    parents.setdefault(id(sub), fn)
+        owner_class = self._owner_classes(ctx.tree)
+
+        cfgs = {}
+        locks = {}
+        for fn in defs:
+            cfg = flow.build_cfg(fn, lock_globs=config["lock_globs"])
+            cfgs[id(fn)] = cfg
+            locks[id(fn)] = flow.flow_locksets(cfg, config["lock_globs"])
+
+        # evidence (a): attributes accessed under any lock region,
+        # keyed (owning class, attr) — a `self.X` access binds to the
+        # enclosing class, any other receiver is a wildcard (its class
+        # is unknown), so a same-named attribute on an UNRELATED class
+        # in the same file does not inherit the discipline
+        guarded: dict = {}
+        for fn in defs:
+            cfg, lockmap = cfgs[id(fn)], locks[id(fn)]
+            cls = owner_class.get(id(fn), self._ANY_CLASS)
+            for node in cfg.statement_nodes():
+                held = lockmap[node.idx]
+                if not held:
+                    continue
+                for root in node.scan:
+                    if root is None:
+                        continue
+                    for sub in flow.shallow_walk(root):
+                        if not isinstance(sub, ast.Attribute):
+                            continue
+                        recv = dotted_name(sub.value)
+                        key_cls = cls if recv in ("self", "cls") \
+                            else self._ANY_CLASS
+                        guarded.setdefault((key_cls, sub.attr),
+                                           sorted(held)[0])
+
+        guarded_attrs = {attr for (_c, attr) in guarded}
+
+        def guarded_lock(cls, recv, attr):
+            """The lock evidence applying to this write, or None."""
+            if attr not in guarded_attrs:
+                return None
+            if recv in ("self", "cls"):
+                return guarded.get((cls, attr)) \
+                    or guarded.get((self._ANY_CLASS, attr))
+            # unknown receiver object: any class's discipline may apply
+            for (_c, a), lock in guarded.items():
+                if a == attr:
+                    return lock
+            return None
+
+        # evidence (b): nested defs that escape into a thread
+        threaded = self._threaded_defs(defs, parents)
+
+        seen: set = set()
+        for fn in defs:
+            if fn.name in config["init_methods"]:
+                continue
+            cfg, lockmap = cfgs[id(fn)], locks[id(fn)]
+            cls = owner_class.get(id(fn), self._ANY_CLASS)
+            local = flow.assigned_names(fn)
+            is_threaded = id(fn) in threaded
+            for node in cfg.statement_nodes():
+                stmt = node.stmt
+                targets = self._write_targets(stmt)
+                if not targets:
+                    continue
+                held = lockmap[node.idx]
+                for target in targets:
+                    attr = target.attr
+                    recv = dotted_name(target.value)
+                    if held or id(target) in seen:
+                        continue
+                    lock = guarded_lock(cls, recv, attr)
+                    if lock is not None:
+                        seen.add(id(target))
+                        yield self.finding(
+                            ctx, target,
+                            f"write to `{recv or '?'}.{attr}` outside "
+                            f"a lock region, but `.{attr}` is "
+                            f"elsewhere accessed under "
+                            f"`{lock}` — a concurrent writer "
+                            f"can lose this update (the busy_s race "
+                            f"class, docs/SERVING.md)")
+                    elif is_threaded and recv is not None \
+                            and recv.split(".")[0] not in local:
+                        seen.add(id(target))
+                        yield self.finding(
+                            ctx, target,
+                            f"write to shared `{recv}.{attr}` inside "
+                            f"`{fn.name}`, which runs on an executor "
+                            f"thread, without holding a lock — it "
+                            f"races every event-loop reader/writer "
+                            f"of `.{attr}`")
+
+    @staticmethod
+    def _owner_classes(tree) -> dict:
+        """def id -> name of the class whose `self` the def's methods
+        see: the nearest enclosing ClassDef (nested defs inherit the
+        enclosing method's class — their closures see the same
+        object)."""
+        out: dict = {}
+
+        def visit(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, FN_DEFS):
+                    out[id(child)] = cls
+                    visit(child, cls)
+                else:
+                    visit(child, cls)
+
+        visit(tree, None)
+        return {k: v for k, v in out.items() if v is not None}
+
+    @staticmethod
+    def _write_targets(stmt) -> list:
+        out = []
+        if isinstance(stmt, ast.Assign):
+            cands = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            cands = [stmt.target]
+        else:
+            return out
+        for t in cands:
+            if isinstance(t, ast.Attribute):
+                out.append(t)
+            elif isinstance(t, ast.Tuple):
+                out.extend(e for e in t.elts
+                           if isinstance(e, ast.Attribute))
+        return out
+
+    def _threaded_defs(self, defs, parents) -> set:
+        """ids of defs whose body runs off the defining thread: their
+        name is referenced (not directly called) anywhere in the file —
+        passed to run_in_executor / Thread / supervise_collective,
+        aliased then passed — plus defs directly called from one."""
+        by_name: dict = {}
+        for fn in defs:
+            if id(fn) in parents:  # nested defs only
+                by_name.setdefault(fn.name, []).append(fn)
+        if not by_name:
+            return set()
+        call_funcs = set()
+        refs = set()
+        calls: dict = {}  # def id -> called local names
+        for fn in defs:
+            own_calls: set = set()
+            for sub in flow.shallow_walk_body(fn):
+                if isinstance(sub, ast.Call):
+                    call_funcs.add(id(sub.func))
+                    if isinstance(sub.func, ast.Name):
+                        own_calls.add(sub.func.id)
+            calls[id(fn)] = own_calls
+        for fn in defs:
+            for sub in flow.shallow_walk_body(fn):
+                if isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load) \
+                        and sub.id in by_name \
+                        and id(sub) not in call_funcs:
+                    refs.add(sub.id)
+        threaded: set = set()
+        for name in refs:
+            for fn in by_name[name]:
+                threaded.add(id(fn))
+        # one transitive step per pass: a def called from a threaded
+        # def also runs on that thread
+        changed = True
+        while changed:
+            changed = False
+            for fn in defs:
+                if id(fn) in threaded:
+                    for name in calls[id(fn)]:
+                        for callee in by_name.get(name, ()):
+                            if id(callee) not in threaded:
+                                threaded.add(id(callee))
+                                changed = True
+        return threaded
+
+
+# ============================================= PIF113 await holding a lock
+
+
+@register
+class AwaitWhileHoldingLock(Rule):
+    id = "PIF113"
+    name = "await-while-holding-lock"
+    summary = ("flow: an await inside a sync `with <lock>:` region in "
+               "the async serve path — the event loop parks holding a "
+               "threading lock")
+    invariant = ("a threading.Lock held across an await is the worst "
+                 "of both concurrency worlds: the coroutine suspends "
+                 "WITH the lock held, so every executor thread "
+                 "touching the same lock blocks until the event loop "
+                 "happens to resume this one coroutine — and if that "
+                 "resume itself needs the executor, the serve path "
+                 "deadlocks.  asyncio.Lock via `async with` is the "
+                 "sanctioned form (serve/protocol.py's write lock); "
+                 "the flow lockset makes the held region explicit, "
+                 "early returns and all")
+    default_config = {
+        "paths": ("*/serve/*",),
+        "lock_globs": ("*lock*",),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        if not _in_scope(ctx, config):
+            return
+        for fn in flow.function_defs(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            cfg = flow.build_cfg(fn, lock_globs=config["lock_globs"])
+            lockmap = flow.flow_locksets(cfg, config["lock_globs"])
+            for node in cfg.statement_nodes():
+                held = lockmap[node.idx]
+                if not held:
+                    continue
+                for root in node.scan:
+                    if root is None:
+                        continue
+                    for sub in flow.shallow_walk(root):
+                        if isinstance(sub, ast.Await):
+                            yield self.finding(
+                                ctx, sub,
+                                f"await while holding sync lock "
+                                f"`{sorted(held)[0]}` in async "
+                                f"`{fn.name}` — the event loop parks "
+                                f"with the lock held and every "
+                                f"executor thread on it stalls; use "
+                                f"asyncio.Lock (`async with`) or "
+                                f"release before awaiting")
+
+
+# ================================================ PIF114 unpaired resource
+
+
+@register
+class UnpairedResource(Rule):
+    id = "PIF114"
+    name = "unpaired-resource"
+    summary = ("flow: an acquire/charge/handle-open not matched by its "
+               "release on every path (exception paths included; a "
+               "release registered via a future callback counts)")
+    invariant = ("three pairings keep the serving layer honest under "
+                 "churn: BufferPool acquire/release (a leaked staging "
+                 "plane defeats the pool and grows RSS at serving "
+                 "rates), AdmissionController charge/release (a "
+                 "leaked quota slot permanently shrinks a tenant's "
+                 "admission — the quota is OUTSTANDING requests, so "
+                 "one leak per crash strangles the tenant), and the "
+                 "journal's append handle (an unclosed fsync'd handle "
+                 "holds the fd and can interleave half-written "
+                 "lines).  The path analysis demands a close on every "
+                 "path — including explicit-raise paths — with two "
+                 "sanctioned outs: ownership transfer (the value "
+                 "escapes: returned, stored, passed on) and deferred "
+                 "release (a callback containing the close, "
+                 "registered on the path)")
+    default_config = {
+        "paths": ("*/serve/*", "*/resilience/*", "*/obs/*"),
+        # (open spec, close spec, label): a leading "." means an
+        # attribute call on a receiver; bare names resolve through the
+        # import map by last segment
+        "pairs": (
+            (".acquire", ".release", "buffer-pool staging plane"),
+            (".charge", ".release", "admission quota slot"),
+            ("open_append", ".close", "journal append handle"),
+        ),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        if not _in_scope(ctx, config):
+            return
+        pairs = [tuple(p) for p in config["pairs"]]
+        close_methods = {c.lstrip(".") for _o, c, _l in pairs}
+        for fn in flow.function_defs(ctx.tree):
+            yield from self._check_fn(ctx, fn, pairs, close_methods)
+
+    # -- event extraction
+
+    def _open_call(self, ctx, call: ast.Call, pairs) -> Optional[tuple]:
+        """(token_receiver, label) when `call` is an open of some
+        pair."""
+        for open_spec, _close, label in pairs:
+            if open_spec.startswith("."):
+                if isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == open_spec[1:]:
+                    recv = dotted_name(call.func.value) or "<expr>"
+                    return recv, label
+            else:
+                target = ctx.resolve_call(call)
+                if target and _last_segment(target) == open_spec:
+                    return f"<{open_spec}>", label
+        return None
+
+    def _check_fn(self, ctx, fn, pairs, close_methods) -> Iterator:
+        # cheap pre-scan
+        has_open = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and self._open_call(ctx, node, pairs):
+                has_open = True
+                break
+        if not has_open:
+            return
+        cfg = flow.build_cfg(fn)
+        escapes = flow.escaping_names(fn, exclude_calls=close_methods)
+        events: list = []
+        labels: dict = {}
+        var_tokens: set = set()
+
+        # pass 1: var-bound opens (so pass 2 can match closes by arg)
+        for node in cfg.statement_nodes():
+            if node.kind == "with":
+                continue  # `with pool.acquire() as x:` pairs itself
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub in flow.shallow_walk(root):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Name) \
+                            and isinstance(sub.value, ast.Call):
+                        hit = self._open_call(ctx, sub.value, pairs)
+                        if hit:
+                            var_tokens.add(sub.targets[0].id)
+
+        for node in cfg.statement_nodes():
+            is_with = node.kind == "with"
+            for root in node.scan:
+                if root is None:
+                    continue
+                handled_assign_values = set()
+                for sub in flow.shallow_walk(root):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.value, ast.Call):
+                        hit = self._open_call(ctx, sub.value, pairs)
+                        if hit is None:
+                            continue
+                        handled_assign_values.add(id(sub.value))
+                        target = sub.targets[0]
+                        if isinstance(target, ast.Name):
+                            v = target.id
+                            if v in escapes:
+                                continue  # ownership transferred
+                            tok = f"var:{v}"
+                            labels[tok] = hit[1]
+                            events.append(flow.Event("open", tok,
+                                                     node.idx, sub.value))
+                        # attribute/subscript target: stored == escaped
+                        continue
+                for sub in flow.shallow_walk(root, into_lambdas=True):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if id(sub) in handled_assign_values:
+                        continue
+                    hit = self._open_call(ctx, sub, pairs)
+                    if hit is not None and not is_with \
+                            and not self._inside_lambda(root, sub):
+                        recv, label = hit
+                        tok = f"recv:{recv}"
+                        labels[tok] = label
+                        events.append(flow.Event("open", tok,
+                                                 node.idx, sub))
+                        continue
+                    if isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr in close_methods:
+                        recv = dotted_name(sub.func.value)
+                        if recv:
+                            events.append(flow.Event(
+                                "close", f"recv:{recv}", node.idx, sub))
+                            # `handle.close()`: the receiver itself may
+                            # be a var-bound token
+                            if "." not in recv and recv in var_tokens:
+                                events.append(flow.Event(
+                                    "close", f"var:{recv}",
+                                    node.idx, sub))
+                        for arg in sub.args:
+                            for n in ast.walk(arg):
+                                if isinstance(n, ast.Name) \
+                                        and n.id in var_tokens:
+                                    events.append(flow.Event(
+                                        "close", f"var:{n.id}",
+                                        node.idx, sub))
+        open_tokens = {e.token for e in events if e.kind == "open"}
+        events = [e for e in events
+                  if e.kind == "open" or e.token in open_tokens]
+        if not any(e.kind == "open" for e in events):
+            return
+        result = flow.pair_events(
+            cfg, events, leak_exits=(cfg.exit, cfg.raise_exit))
+        for verdict in result.opens:
+            if not verdict.may_leak:
+                continue
+            ev = verdict.event
+            label = labels.get(ev.token, "resource")
+            kind_, name_ = ev.token.split(":", 1)
+            what = f"`{name_}`" if kind_ == "var" else f"on `{name_}`"
+            strength = "every path leaks it" if verdict.must_leak \
+                else "a path exists that skips the release"
+            yield self.finding(
+                ctx, ev.ast_node,
+                f"unpaired {label}: the open {what} is not matched by "
+                f"its close on every path ({strength}, exception "
+                f"paths included) — release it in a finally, a with, "
+                f"or a done-callback registered on the path")
+
+    @staticmethod
+    def _inside_lambda(root, target) -> bool:
+        """Is `target` nested under a Lambda within `root`?  Opens
+        inside callbacks run later, not on this path."""
+        for sub in flow.shallow_walk(root):
+            if isinstance(sub, ast.Lambda):
+                for inner in ast.walk(sub):
+                    if inner is target:
+                        return True
+        return False
+
+
+# ================================================ PIF115 untagged demotion
+
+
+@register
+class UntaggedDemotion(Rule):
+    id = "PIF115"
+    name = "untagged-demotion"
+    summary = ("flow: a path grows a degrade/demotion trail (or walks "
+               "a degrade rung) but never sets `degraded` before the "
+               "value escapes")
+    invariant = ("the resilience contract (docs/RESILIENCE.md) is "
+                 "never-silent: every demotion is TAGGED — "
+                 "`degraded: true` rides the plan, the bench record, "
+                 "and every serve response, and the chaos gates "
+                 "assert it.  A code path that appends to a degrade "
+                 "trail but returns without setting the flag ships a "
+                 "value downstream consumers will read as full-"
+                 "quality; the flow analysis demands a tag event "
+                 "(attribute/key assignment or a degraded= keyword) "
+                 "on every entry→demotion→return path.  The "
+                 "machinery that IMPLEMENTS demotion "
+                 "(resilience/degrade.py) is exempt")
+    default_config = {
+        "paths": ("*/serve/*", "*/resilience/*", "*/plans/*",
+                  "*/parallel/*", "*bench.py"),
+        "exempt": ("*resilience/degrade.py",),
+        "trail_globs": ("*degrade*", "*demotion*"),
+        "rung_calls": ("promote_precision",),
+        "tag_globs": ("*degraded*",),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        if not _in_scope(ctx, config):
+            return
+        for fn in flow.function_defs(ctx.tree):
+            yield from self._check_fn(ctx, fn, config)
+
+    def _demote_in(self, ctx, root, config) -> list:
+        out = []
+        for sub in flow.shallow_walk(root):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in ("append", "extend") \
+                    and sub.args:
+                container = dotted_name(sub.func.value)
+                if container and _matches(_last_segment(container),
+                                          config["trail_globs"]):
+                    out.append((sub, f"append to `{container}`"))
+                    continue
+            target = ctx.resolve_call(sub)
+            if target and _last_segment(target) in config["rung_calls"]:
+                out.append((sub, f"`{_last_segment(target)}(...)`"))
+        return out
+
+    def _tags_in(self, root, config) -> bool:
+        globs = config["tag_globs"]
+        for sub in flow.shallow_walk(root):
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    name = None
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                    elif isinstance(t, ast.Attribute):
+                        name = t.attr
+                    elif isinstance(t, ast.Subscript) and isinstance(
+                            t.slice, ast.Constant) and isinstance(
+                            t.slice.value, str):
+                        name = t.slice.value
+                    if name and _matches(name, globs):
+                        return True
+            elif isinstance(sub, ast.Call):
+                for kw in sub.keywords:
+                    if kw.arg and _matches(kw.arg, globs):
+                        return True
+        return False
+
+    def _check_fn(self, ctx, fn, config) -> Iterator:
+        # cheap pre-scan over the function's own statements (nested
+        # defs are analyzed as their own functions)
+        if not any(self._demote_in(ctx, stmt, config)
+                   for stmt in fn.body):
+            return
+        cfg = flow.build_cfg(fn)
+        demotes: list = []      # (node_idx, ast_node, what)
+        tag_nodes: set = set()
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub, what in self._demote_in(ctx, root, config):
+                    demotes.append((node.idx, sub, what))
+                if self._tags_in(root, config):
+                    tag_nodes.add(node.idx)
+        if not demotes:
+            return
+        avoid = frozenset(tag_nodes)
+        from_entry = cfg.reachable(cfg.entry, avoid=avoid)
+        for idx, sub, what in demotes:
+            if idx in tag_nodes:
+                continue
+            if idx not in from_entry and idx != cfg.entry:
+                continue  # every path here already passed a tag
+            onward = cfg.reachable(idx, avoid=avoid)
+            if cfg.exit in onward:
+                yield self.finding(
+                    ctx, sub,
+                    f"demotion {what} can escape untagged: a path "
+                    f"from this statement reaches a return with no "
+                    f"`degraded` tag set (assignment or degraded= "
+                    f"keyword) — the never-silent rule "
+                    f"(docs/RESILIENCE.md) requires every demotion "
+                    f"to be tagged before the value escapes")

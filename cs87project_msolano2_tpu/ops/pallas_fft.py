@@ -700,7 +700,7 @@ def fft_pi_layout_pallas2(xr, xi, tile: int | None = None,
             separable, storage,
         )
         xr, xi = xr2.reshape(n), xi2.reshape(n)
-    yr, yi = tile_fft_grid(  # pifft: noqa[PIF104] (the documented two-trip fallback path: kept as the tuner's always-lowerable baseline — fourstep/fused are the single-pass designs)
+    yr, yi = tile_fft_grid(  # pifft: noqa[PIF104]: the documented two-trip fallback path, kept as the tuner's always-lowerable baseline — fourstep/fused are the single-pass designs
         xr.reshape(-1, LANE), xi.reshape(-1, LANE), tile, interpret,
         precision, tail, storage=storage,
     )
@@ -824,7 +824,7 @@ def fft_pi_layout_pallas_rql(xr, xi, tile: int | None = None,
 
     if precision is None:
         precision = SPLIT3
-    yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104] (two-trip by design: the retiling-free ladder fallback where fused/fourstep reject; its intermediate round trip is what the fourstep pipeline removes)
+    yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104]: two-trip by design — the retiling-free ladder fallback where fused/fourstep reject; its intermediate round trip is what the fourstep pipeline removes
         x3r, x3i, tile, tail, precision, interpret, storage)
     return _f32(yr).reshape(n), _f32(yi).reshape(n)
 
@@ -963,7 +963,7 @@ def fft_pi_layout_pallas_fused(xr, xi, tile: int | None = None,
     def out_row(i):
         return (jnp.maximum(i - QB, 0), 0, 0)
 
-    out = pl.pallas_call(  # pifft: noqa[PIF104] (single-pass: the R<2 branch above is a dispatch — exactly one of the two trips ever runs)
+    out = pl.pallas_call(  # pifft: noqa[PIF104]: single-pass — the R<2 branch above is a dispatch, exactly one of the two trips ever runs
         partial(_fused_fft_kernel, levels, R, QB, qb, steps, precision),
         grid=(QB + R,),
         in_specs=in_specs,
@@ -1322,7 +1322,7 @@ def fft_pi_layout_pallas_fourstep(xr, xi, tile: int | None = None,
     def out_row(i):
         return (jnp.maximum(i - QB, 0), 0, 0)
 
-    out = pl.pallas_call(  # pifft: noqa[PIF104] (single-pass: the R<2 branch above is a dispatch — exactly one of the two trips ever runs)
+    out = pl.pallas_call(  # pifft: noqa[PIF104]: single-pass — the R<2 branch above is a dispatch, exactly one of the two trips ever runs
         partial(_fourstep_kernel, levels, R, QB, qb, steps, precision,
                 separable),
         grid=(QB + R,),
@@ -2057,7 +2057,7 @@ def fft_pi_layout_pallas_mf(xr, xi, R: int = LANE, cb: int | None = None,
         interpret=interpret,
     )(x3r, x3i, br, bi, atr, ati, b2r, b2i)
 
-    yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104] (two-trip by design: the matmul-funnel research path, not in the flagship ladder)
+    yr, yi = _tile_fft_rows(  # pifft: noqa[PIF104]: two-trip by design — the matmul-funnel research path, not in the flagship ladder
         x3r, x3i, tile, tail, precision, interpret)
     return yr.reshape(n), yi.reshape(n)
 

@@ -140,7 +140,7 @@ def _distributed_client():
         from jax._src.distributed import global_state
 
         return global_state.client
-    except Exception:  # pragma: no cover - import drift  # pifft: noqa[PIF501]
+    except Exception:  # pragma: no cover - import drift  # pifft: noqa[PIF501]: optional-dependency import drift probe — absence is the signal, not an error
         return None
 
 

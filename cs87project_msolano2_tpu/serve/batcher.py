@@ -222,6 +222,13 @@ class BatchRunner:
         finally:
             self.pool.release(sxr, sxi)
         outcome.size = size
+        # a non-empty degrade trail must never ride degraded=False: the
+        # admission rung's "overload:<rung>" tag is attached up here
+        # before the runner outcome exists, so reconcile on the way out
+        # — the never-silent rule PIF115 machine-checks (the dispatcher
+        # computed the same disjunction per response; now the OUTCOME
+        # consumers — loadgen rows, tests — see it too)
+        outcome.degraded = outcome.degraded or bool(outcome.degrade)
         metrics.inc("pifft_serve_batches_total", shape=group.label())
         metrics.inc("pifft_serve_batched_requests_total", value=size,
                     shape=group.label())

@@ -141,7 +141,7 @@ async def handle_connection(dispatcher: Dispatcher, reader,
         peer = None
         try:
             peer = writer.get_extra_info("peername")
-        except Exception:  # pragma: no cover - transport gone entirely  # pifft: noqa[PIF501]
+        except Exception:  # pragma: no cover - transport gone entirely  # pifft: noqa[PIF501]: transport is gone entirely — there is no peer left to report the error to
             pass
         metrics.inc("pifft_serve_conn_lost_total")
         events.emit("serve_conn_lost", peer=str(peer),

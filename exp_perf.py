@@ -82,7 +82,7 @@ def main():
     for R in (64, 128):
         # one-shot accuracy call per R (each R is a distinct program
         # traced exactly once, nothing to reuse across iterations)
-        yr, yi = jax.jit(  # pifft: noqa[PIF202]
+        yr, yi = jax.jit(  # pifft: noqa[PIF202]: one jit per radix config is deliberate — the sweep compares compiled programs, not cache hits
             lambda a, b, r=R: fft_pi_layout_pallas_mf(
                 a, b, R=r, tail=256)  # cb=None: auto-picked feasible block
         )(hxr, hxi)

@@ -1,0 +1,1087 @@
+"""Tests for the flow-sensitive check layer: the CFG + analyses
+(check/flow.py) independent of any rule, then every flow rule
+(check/rules_flow.py — PIF302/303/304 DMA discipline, PIF112 unguarded
+shared write, PIF113 await-holding-lock, PIF114 unpaired resource,
+PIF115 untagged demotion) positive AND negative AND noqa AND scope,
+a shipped-package-clean test per rule, and the PR-12 busy_s regression:
+reverting the lock around the mesh utilization accounting must make
+`pifft check` fail with PIF112.
+"""
+
+import ast
+import os
+import textwrap
+
+import pytest
+
+from cs87project_msolano2_tpu import check
+from cs87project_msolano2_tpu.check import engine, flow
+
+PKG_DIR = os.path.dirname(os.path.abspath(check.__file__))
+PKG = os.path.dirname(PKG_DIR)
+
+
+def fn_def(code, name=None):
+    tree = ast.parse(textwrap.dedent(code))
+    defs = [n for n in ast.walk(tree) if isinstance(n, flow.FN_DEFS)]
+    if name is None:
+        return defs[0]
+    return next(d for d in defs if d.name == name)
+
+
+def run(code, rule=None, path="pkg/serve/snippet.py"):
+    return check.check_source(
+        path, textwrap.dedent(code), rules=[rule] if rule else None)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+def call_events(cfg, open_name="open_it", close_name="close_it",
+                token="r"):
+    """Test vocabulary: calls named open_it/close_it become pairing
+    events."""
+    events = []
+    for node in cfg.statement_nodes():
+        for root in node.scan:
+            if root is None:
+                continue
+            for sub in flow.shallow_walk(root):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    if sub.func.id == open_name:
+                        events.append(flow.Event("open", token,
+                                                 node.idx, sub))
+                    elif sub.func.id == close_name:
+                        events.append(flow.Event("close", token,
+                                                 node.idx, sub))
+    return events
+
+
+# ================================================== CFG construction
+
+
+def test_cfg_if_diamond_joins():
+    fn = fn_def("""
+        def f(c):
+            a = 1
+            if c:
+                b = 2
+            else:
+                b = 3
+            return b
+    """)
+    cfg = flow.build_cfg(fn)
+    # the return is reachable from both branch bodies
+    stmts = {n.idx: n for n in cfg.statement_nodes()}
+    branch_nodes = [i for i, n in stmts.items()
+                    if isinstance(n.stmt, ast.Assign)
+                    and n.stmt.value.value in (2, 3)]
+    ret = next(i for i, n in stmts.items() if n.kind == "return")
+    assert len(branch_nodes) == 2
+    for b in branch_nodes:
+        assert ret in cfg.reachable(b)
+    assert cfg.exit in cfg.reachable(cfg.entry)
+
+
+def test_cfg_while_has_back_edge():
+    fn = fn_def("""
+        def f(n):
+            i = 0
+            while i < n:
+                i += 1
+            return i
+    """)
+    cfg = flow.build_cfg(fn)
+    head = next(n.idx for n in cfg.statement_nodes()
+                if n.kind == "loop")
+    body = next(n.idx for n in cfg.statement_nodes()
+                if isinstance(n.stmt, ast.AugAssign))
+    assert head in cfg.succ[body]          # the back edge
+    assert body in cfg.reachable(head)
+    assert cfg.exit in cfg.reachable(head)  # loop exit
+
+
+def test_cfg_for_loop_can_run_zero_times():
+    fn = fn_def("""
+        def f(xs):
+            hit = False
+            for x in xs:
+                hit = True
+            return hit
+    """)
+    cfg = flow.build_cfg(fn)
+    body = next(n.idx for n in cfg.statement_nodes()
+                if isinstance(n.stmt, ast.Assign)
+                and n.stmt.value.value is True)
+    # a path around the loop body exists
+    assert cfg.exit in cfg.reachable(cfg.entry, avoid=frozenset([body]))
+
+
+def test_cfg_early_return_bypasses_tail():
+    fn = fn_def("""
+        def f(c):
+            if c:
+                return 1
+            tail = 2
+            return tail
+    """)
+    cfg = flow.build_cfg(fn)
+    early = next(n.idx for n in cfg.statement_nodes()
+                 if n.kind == "return"
+                 and isinstance(n.stmt.value, ast.Constant))
+    tail = next(n.idx for n in cfg.statement_nodes()
+                if isinstance(n.stmt, ast.Assign))
+    assert cfg.exit in cfg.succ[early]
+    assert tail not in cfg.reachable(early)
+
+
+def test_cfg_try_finally_runs_on_raise_path():
+    fn = fn_def("""
+        def f(c):
+            try:
+                if c:
+                    raise ValueError("x")
+                ok = 1
+            finally:
+                cleanup = True
+            return ok
+    """)
+    cfg = flow.build_cfg(fn)
+    raise_n = next(n.idx for n in cfg.statement_nodes()
+                   if n.kind == "raise")
+    fin = next(n.idx for n in cfg.statement_nodes()
+               if isinstance(n.stmt, ast.Assign)
+               and isinstance(n.stmt.targets[0], ast.Name)
+               and n.stmt.targets[0].id == "cleanup")
+    # the raise flows through the finally, then keeps propagating
+    assert fin in cfg.reachable(raise_n)
+    assert cfg.raise_exit in cfg.reachable(raise_n)
+
+
+def test_cfg_except_handler_reached_from_body():
+    fn = fn_def("""
+        def f():
+            try:
+                risky = work()
+            except Exception:
+                handled = True
+            return 0
+    """)
+    cfg = flow.build_cfg(fn)
+    handler_body = next(n.idx for n in cfg.statement_nodes()
+                        if isinstance(n.stmt, ast.Assign)
+                        and n.stmt.targets[0].id == "handled")
+    assert handler_body in cfg.reachable(cfg.entry)
+    assert cfg.exit in cfg.reachable(handler_body)
+
+
+def test_cfg_break_exits_loop_continue_reenters():
+    fn = fn_def("""
+        def f(xs):
+            for x in xs:
+                if x < 0:
+                    continue
+                if x > 9:
+                    break
+                use(x)
+            return 0
+    """)
+    cfg = flow.build_cfg(fn)
+    head = next(n.idx for n in cfg.statement_nodes()
+                if n.kind == "loop")
+    cont = next(n.idx for n in cfg.statement_nodes()
+                if isinstance(n.stmt, ast.Continue))
+    brk = next(n.idx for n in cfg.statement_nodes()
+               if isinstance(n.stmt, ast.Break))
+    ret = next(n.idx for n in cfg.statement_nodes()
+               if n.kind == "return")
+    assert head in cfg.succ[cont]
+    assert ret in cfg.succ[brk]
+
+
+def test_cfg_grid_back_edge_option():
+    fn = fn_def("""
+        def kernel(i):
+            a = 1
+    """)
+    plain = flow.build_cfg(fn)
+    grid = flow.build_cfg(fn, loop_back_edge=True)
+    assert plain.entry not in plain.reachable(plain.exit)
+    assert grid.entry in grid.reachable(grid.exit)
+
+
+def test_cfg_inlines_when_decorated_defs_conditionally():
+    fn = fn_def("""
+        def kernel(i):
+            before = 1
+
+            @pl.when(i == 0)
+            def _phase():
+                inside = 2
+
+            after = 3
+    """, name="kernel")
+    cfg = flow.build_cfg(fn, inline_decorated=("when",))
+    names = {}
+    for n in cfg.statement_nodes():
+        if isinstance(n.stmt, ast.Assign) and \
+                isinstance(n.stmt.targets[0], ast.Name):
+            names[n.stmt.targets[0].id] = n.idx
+    assert set(names) == {"before", "inside", "after"}
+    # conditional region: `after` reachable both through and around it
+    assert names["after"] in cfg.reachable(names["inside"])
+    assert names["after"] in cfg.reachable(
+        names["before"], avoid=frozenset([names["inside"]]))
+
+
+# ================================================== pairing analysis
+
+
+def test_pairing_straight_line_is_clean():
+    fn = fn_def("""
+        def f():
+            open_it()
+            close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(cfg, call_events(cfg))
+    assert res.leaks() == [] and res.over_closes == []
+
+
+def test_pairing_open_without_close_is_must_leak():
+    fn = fn_def("""
+        def f():
+            open_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(cfg, call_events(cfg))
+    assert [v.must_leak for v in res.leaks()] == [True]
+
+
+def test_pairing_close_in_branch_is_may_not_must():
+    fn = fn_def("""
+        def f(c):
+            open_it()
+            if c:
+                close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(cfg, call_events(cfg))
+    leaks = res.leaks()
+    assert len(leaks) == 1
+    assert leaks[0].may_leak and not leaks[0].must_leak
+
+
+def test_pairing_open_in_both_branches_close_after_is_clean():
+    fn = fn_def("""
+        def f(c):
+            if c:
+                open_it()
+            else:
+                open_it()
+            close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(cfg, call_events(cfg))
+    assert res.leaks() == [] and res.over_closes == []
+
+
+def test_pairing_double_close_on_a_path_is_over_close():
+    fn = fn_def("""
+        def f(c):
+            open_it()
+            close_it()
+            if c:
+                close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(cfg, call_events(cfg))
+    assert len(res.over_closes) == 1
+
+
+def test_pairing_close_only_inside_zero_trip_loop_is_may_leak():
+    fn = fn_def("""
+        def f(xs):
+            open_it()
+            for x in xs:
+                close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(cfg, call_events(cfg))
+    leaks = res.leaks()
+    assert len(leaks) == 1 and leaks[0].may_leak \
+        and not leaks[0].must_leak
+
+
+def test_pairing_finally_close_covers_raise_path():
+    fn = fn_def("""
+        def f(c):
+            open_it()
+            try:
+                if c:
+                    raise ValueError("x")
+            finally:
+                close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(
+        cfg, call_events(cfg),
+        leak_exits=(cfg.exit, cfg.raise_exit))
+    assert res.leaks() == []
+
+
+def test_pairing_explicit_raise_path_leaks():
+    fn = fn_def("""
+        def f(c):
+            open_it()
+            if c:
+                raise ValueError("x")
+            close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(
+        cfg, call_events(cfg),
+        leak_exits=(cfg.exit, cfg.raise_exit))
+    leaks = res.leaks()
+    assert len(leaks) == 1 and leaks[0].may_leak
+
+
+def test_pairing_open_that_throws_did_not_open():
+    # the exception edge out of a try carries the state from BEFORE
+    # the statement: a failing open leaves nothing to close
+    fn = fn_def("""
+        def f():
+            try:
+                open_it()
+            except Exception:
+                raise
+            close_it()
+    """)
+    cfg = flow.build_cfg(fn)
+    res = flow.pair_events(
+        cfg, call_events(cfg),
+        leak_exits=(cfg.exit, cfg.raise_exit))
+    assert res.leaks() == []
+
+
+# ======================================================== locksets
+
+
+def test_lockset_with_region_held_only_inside():
+    fn = fn_def("""
+        def f(self):
+            before = 1
+            with self._lock:
+                inside = 2
+            after = 3
+    """)
+    cfg = flow.build_cfg(fn)
+    locks = flow.flow_locksets(cfg)
+    by_name = {n.stmt.targets[0].id: n.idx
+               for n in cfg.statement_nodes()
+               if isinstance(n.stmt, ast.Assign)}
+    assert locks[by_name["before"]] == frozenset()
+    assert locks[by_name["inside"]] == frozenset({"self._lock"})
+    assert locks[by_name["after"]] == frozenset()
+
+
+def test_lockset_nested_with_holds_both():
+    fn = fn_def("""
+        def f(self):
+            with self._lock:
+                with self._other_lock:
+                    inside = 1
+    """)
+    cfg = flow.build_cfg(fn)
+    locks = flow.flow_locksets(cfg)
+    node = next(n.idx for n in cfg.statement_nodes()
+                if isinstance(n.stmt, ast.Assign))
+    assert locks[node] == frozenset({"self._lock", "self._other_lock"})
+
+
+def test_lockset_join_is_intersection():
+    # acquired on only ONE inbound path -> not held at the merge
+    fn = fn_def("""
+        def f(self, c):
+            if c:
+                self.big_lock.acquire()
+            merged = 1
+    """)
+    cfg = flow.build_cfg(fn)
+    locks = flow.flow_locksets(cfg)
+    node = next(n.idx for n in cfg.statement_nodes()
+                if isinstance(n.stmt, ast.Assign)
+                and n.stmt.targets[0].id == "merged")
+    assert locks[node] == frozenset()
+
+
+def test_lockset_acquire_release_flow():
+    fn = fn_def("""
+        def f(self):
+            self.big_lock.acquire()
+            held = 1
+            self.big_lock.release()
+            free = 2
+    """)
+    cfg = flow.build_cfg(fn)
+    locks = flow.flow_locksets(cfg)
+    by_name = {n.stmt.targets[0].id: n.idx
+               for n in cfg.statement_nodes()
+               if isinstance(n.stmt, ast.Assign)}
+    assert "self.big_lock" in locks[by_name["held"]]
+    assert locks[by_name["free"]] == frozenset()
+
+
+def test_lockset_early_return_stays_locked_until_exit():
+    fn = fn_def("""
+        def f(self, c):
+            with self._lock:
+                if c:
+                    return 1
+                inside = 2
+            return 3
+    """)
+    cfg = flow.build_cfg(fn)
+    locks = flow.flow_locksets(cfg)
+    early = next(n.idx for n in cfg.statement_nodes()
+                 if n.kind == "return"
+                 and isinstance(n.stmt.value, ast.Constant)
+                 and n.stmt.value.value == 1)
+    assert "self._lock" in locks[early]
+
+
+# ============================================ PIF302/303/304 — DMA
+
+
+DMA_PATH = "pkg/ops/kernel.py"
+
+
+def test_pif302_flags_unwaited_branch_start():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, cond):
+            def write_dma(slot):
+                return pltpu.make_async_copy(refs[0], refs[1], sem)
+            write_dma(0).wait()
+            if cond:
+                write_dma(1).start()
+    """, "PIF302", DMA_PATH)
+    assert rule_ids(found) == ["PIF302"]
+
+
+def test_pif302_var_bound_unwaited():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+    """, "PIF302", DMA_PATH)
+    assert rule_ids(found) == ["PIF302"]
+
+
+def test_pif302_clean_when_paired():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+            dma.wait()
+    """, "PIF302", DMA_PATH)
+    assert found == []
+
+
+def test_pif302_grid_kernel_cross_step_wait_is_clean():
+    # the fourstep idiom: start at step i, wait at step i+2, phases
+    # selected by @pl.when — legal under grid semantics
+    found = run("""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, QB):
+            i = pl.program_id(0)
+
+            def write_dma(slot, blk):
+                return pltpu.make_async_copy(refs[0], refs[1], sem)
+
+            @pl.when(i < QB)
+            def _phase_a():
+                @pl.when(i >= 2)
+                def _retire():
+                    write_dma(i % 2, i - 2).wait()
+
+                write_dma(i % 2, i).start()
+    """, "PIF302", DMA_PATH)
+    assert found == []
+
+
+def test_pif302_grid_kernel_missing_wait_flags():
+    found = run("""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, QB):
+            i = pl.program_id(0)
+
+            def write_dma(slot, blk):
+                return pltpu.make_async_copy(refs[0], refs[1], sem)
+
+            @pl.when(i < QB)
+            def _phase_a():
+                write_dma(i % 2, i).start()
+    """, "PIF302", DMA_PATH)
+    assert rule_ids(found) == ["PIF302"]
+
+
+def test_pif303_flags_double_wait_path():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, cond):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+            dma.wait()
+            if cond:
+                dma.wait()
+    """, "PIF303", DMA_PATH)
+    assert rule_ids(found) == ["PIF303"]
+
+
+def test_pif303_clean_single_wait():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+            dma.wait()
+    """, "PIF303", DMA_PATH)
+    assert found == []
+
+
+def test_pif304_flags_wait_skippable_by_branch():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, cond):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+            if cond:
+                dma.wait()
+    """, "PIF304", DMA_PATH)
+    assert rule_ids(found) == ["PIF304"]
+
+
+def test_pif304_flags_wait_in_zero_trip_loop():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, rows):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+            for r in rows:
+                dma.wait()
+    """, "PIF304", DMA_PATH)
+    assert rule_ids(found) == ["PIF304"]
+
+
+def test_pif304_clean_unconditional_wait():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem, cond):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()
+            if cond:
+                early = 1
+            dma.wait()
+    """, "PIF304", DMA_PATH)
+    assert found == []
+
+
+def test_dma_rules_scope_is_ops_only():
+    code = """
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem):
+            pltpu.make_async_copy(refs[0], refs[1], sem).start()
+    """
+    assert rule_ids(run(code, "PIF302", DMA_PATH)) == ["PIF302"]
+    assert run(code, "PIF302", "pkg/serve/elsewhere.py") == []
+
+
+def test_dma_noqa_suppresses():
+    found = run("""
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(refs, sem):
+            dma = pltpu.make_async_copy(refs[0], refs[1], sem)
+            dma.start()  # pifft: noqa[PIF302]: retired by the next kernel launch by design
+    """, "PIF302", DMA_PATH)
+    assert found == []
+
+
+# =================================================== PIF112 — locks
+
+
+def test_pif112_flags_unlocked_write_to_guarded_attr():
+    found = run("""
+        class Device:
+            def bump(self, dt):
+                with self._busy_lock:
+                    self.busy_s += dt
+
+            def skew(self):
+                self.busy_s = 0.0
+    """, "PIF112")
+    assert rule_ids(found) == ["PIF112"]
+    assert "busy_s" in found[0].message
+
+
+def test_pif112_clean_when_all_writes_locked():
+    found = run("""
+        class Device:
+            def bump(self, dt):
+                with self._busy_lock:
+                    self.busy_s += dt
+
+            def reset(self):
+                with self._busy_lock:
+                    self.busy_s = 0.0
+    """, "PIF112")
+    assert found == []
+
+
+def test_pif112_init_writes_exempt():
+    found = run("""
+        class Device:
+            def __init__(self):
+                self.busy_s = 0.0
+
+            def bump(self, dt):
+                with self._busy_lock:
+                    self.busy_s += dt
+    """, "PIF112")
+    assert found == []
+
+
+def test_pif112_flags_executor_thread_write_without_any_lock():
+    # the regression direction: delete the lock everywhere and the
+    # thread-evidence still fires
+    found = run("""
+        import asyncio
+
+        class Mesh:
+            async def invoke(self, device, dt):
+                def execute():
+                    device.busy_s += dt
+
+                call = execute
+                return await asyncio.get_running_loop() \\
+                    .run_in_executor(None, call)
+    """, "PIF112")
+    assert rule_ids(found) == ["PIF112"]
+
+
+def test_pif112_executor_write_under_lock_is_clean():
+    found = run("""
+        import asyncio
+
+        class Mesh:
+            async def invoke(self, device, dt):
+                def execute():
+                    with device._busy_lock:
+                        device.busy_s += dt
+
+                return await asyncio.get_running_loop() \\
+                    .run_in_executor(None, execute)
+    """, "PIF112")
+    assert found == []
+
+
+def test_pif112_thread_local_object_write_is_clean():
+    found = run("""
+        import asyncio
+
+        class Mesh:
+            async def invoke(self):
+                def execute():
+                    box = Box()
+                    box.value = 1
+                    return box
+
+                return await asyncio.get_running_loop() \\
+                    .run_in_executor(None, execute)
+    """, "PIF112")
+    assert found == []
+
+
+def test_pif112_scope_is_serve_only():
+    code = """
+        class Device:
+            def bump(self, dt):
+                with self._busy_lock:
+                    self.busy_s += dt
+
+            def skew(self):
+                self.busy_s = 0.0
+    """
+    assert run(code, "PIF112", "pkg/plans/core.py") == []
+
+
+# ============================================ PIF113 — await + lock
+
+
+def test_pif113_flags_await_under_sync_lock():
+    found = run("""
+        class D:
+            async def flush(self):
+                with self._lock:
+                    await self.sink.drain()
+    """, "PIF113")
+    assert rule_ids(found) == ["PIF113"]
+
+
+def test_pif113_async_with_lock_is_clean():
+    found = run("""
+        class D:
+            async def flush(self):
+                async with self._write_lock:
+                    await self.sink.drain()
+    """, "PIF113")
+    assert found == []
+
+
+def test_pif113_await_after_region_is_clean():
+    found = run("""
+        class D:
+            async def flush(self):
+                with self._lock:
+                    snapshot = list(self.rows)
+                await self.sink.send(snapshot)
+    """, "PIF113")
+    assert found == []
+
+
+def test_pif113_scope_is_serve_only():
+    code = """
+        class D:
+            async def flush(self):
+                with self._lock:
+                    await self.sink.drain()
+    """
+    assert run(code, "PIF113", "pkg/analyze/cli.py") == []
+
+
+# ========================================== PIF114 — resource pairs
+
+
+def test_pif114_flags_quota_leak_on_exception_path():
+    found = run("""
+        class D:
+            def admit(self, tenant, bad):
+                self.admission.charge(tenant, 1.0)
+                if bad:
+                    raise RuntimeError("boom")
+                self.admission.release(tenant)
+    """, "PIF114")
+    assert rule_ids(found) == ["PIF114"]
+    assert "quota" in found[0].message
+
+
+def test_pif114_finally_release_is_clean():
+    found = run("""
+        class D:
+            def admit(self, tenant, bad):
+                self.admission.charge(tenant, 1.0)
+                try:
+                    if bad:
+                        raise RuntimeError("boom")
+                finally:
+                    self.admission.release(tenant)
+    """, "PIF114")
+    assert found == []
+
+
+def test_pif114_callback_registered_release_is_clean():
+    found = run("""
+        class D:
+            def admit(self, req, tenant):
+                self.admission.charge(tenant, 1.0)
+                req.future.add_done_callback(
+                    lambda _f: self.admission.release(tenant))
+    """, "PIF114")
+    assert found == []
+
+
+def test_pif114_ownership_transfer_is_clean():
+    found = run("""
+        class D:
+            def stage(self, bucket, width):
+                xr = self.pool.acquire((bucket, width))
+                xi = self.pool.acquire((bucket, width))
+                return xr, xi
+    """, "PIF114")
+    assert found == []
+
+
+def test_pif114_flags_buffer_leaked_by_early_return():
+    found = run("""
+        class D:
+            def stage(self, bucket, width, planes):
+                xr = self.pool.acquire((bucket, width))
+                if not planes:
+                    return None
+                self.pool.release(xr)
+                return None
+    """, "PIF114")
+    assert rule_ids(found) == ["PIF114"]
+
+
+def test_pif114_open_append_with_statement_is_clean():
+    found = run("""
+        from cs87project_msolano2_tpu.resilience.journal import open_append
+
+        def record(path, rec):
+            with open_append(path) as fh:
+                fh.write(rec)
+    """, "PIF114", "pkg/resilience/j.py")
+    assert found == []
+
+
+def test_pif114_flags_dangling_open_append():
+    found = run("""
+        from cs87project_msolano2_tpu.resilience.journal import open_append
+
+        def record(path, rec, bad):
+            fh = open_append(path)
+            fh.write(rec)
+            if bad:
+                return None
+            fh.close()
+            return None
+    """, "PIF114", "pkg/resilience/j.py")
+    assert rule_ids(found) == ["PIF114"]
+
+
+def test_pif114_noqa_suppresses():
+    found = run("""
+        class D:
+            def admit(self, tenant, bad):
+                self.admission.charge(tenant, 1.0)  # pifft: noqa[PIF114]: released by the caller's teardown hook
+                if bad:
+                    raise RuntimeError("boom")
+                self.admission.release(tenant)
+    """, "PIF114")
+    assert found == []
+
+
+def test_pif114_scope():
+    code = """
+        class D:
+            def admit(self, tenant):
+                self.admission.charge(tenant, 1.0)
+    """
+    assert rule_ids(run(code, "PIF114")) == ["PIF114"]
+    assert run(code, "PIF114", "pkg/models/x.py") == []
+
+
+# ======================================= PIF115 — untagged demotion
+
+
+def test_pif115_flags_untagged_trail_append():
+    found = run("""
+        def serve(outcome, rung):
+            if rung is not None:
+                outcome.degrade.append(f"overload:{rung}")
+            return outcome
+    """, "PIF115")
+    assert rule_ids(found) == ["PIF115"]
+
+
+def test_pif115_tag_after_append_is_clean():
+    found = run("""
+        def serve(outcome, rung):
+            if rung is not None:
+                outcome.degrade.append(f"overload:{rung}")
+                outcome.degraded = True
+            return outcome
+    """, "PIF115")
+    assert found == []
+
+
+def test_pif115_tag_before_append_is_clean():
+    found = run("""
+        def promote(outcome, nxt):
+            outcome.degraded = True
+            outcome.degrade.append(f"precision:{nxt}")
+            return outcome
+    """, "PIF115")
+    assert found == []
+
+
+def test_pif115_tag_via_keyword_is_clean():
+    found = run("""
+        def build(trail, rung):
+            trail = list(trail)
+            trail.append(f"overload:{rung}")
+            demotions = trail
+            demotions.append("x")
+            return Outcome(degraded=True, degrade=demotions)
+    """, "PIF115")
+    assert found == []
+
+
+def test_pif115_raise_path_needs_no_tag():
+    # the value never escapes on a raise path
+    found = run("""
+        def serve(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")
+            raise RuntimeError("batch failed anyway")
+    """, "PIF115")
+    assert found == []
+
+
+def test_pif115_flags_untagged_rung_call():
+    found = run("""
+        from cs87project_msolano2_tpu.resilience.degrade import promote_precision
+
+        def enforce(plan, err, budget):
+            nxt = promote_precision(plan, err, budget)
+            return nxt
+    """, "PIF115")
+    assert rule_ids(found) == ["PIF115"]
+
+
+def test_pif115_degrade_module_exempt():
+    code = """
+        def note(plan, record):
+            plan.demotions.append(record)
+            return plan
+    """
+    pkg_path = os.path.join(PKG, "resilience", "degrade.py")
+    assert check.check_source(pkg_path, textwrap.dedent(code),
+                              rules=["PIF115"]) == []
+    assert rule_ids(run(code, "PIF115",
+                        "pkg/resilience/retry.py")) == ["PIF115"]
+
+
+def test_pif115_noqa_suppresses():
+    found = run("""
+        def serve(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")  # pifft: noqa[PIF115]: tagged by the dispatcher at delivery
+            return outcome
+    """, "PIF115")
+    assert found == []
+
+
+# ==================================== shipped-package-clean capstones
+
+
+@pytest.mark.parametrize("rule, paths", [
+    ("PIF302", ("ops",)),
+    ("PIF303", ("ops",)),
+    ("PIF304", ("ops",)),
+    ("PIF112", ("serve",)),
+    ("PIF113", ("serve",)),
+    ("PIF114", ("serve", "resilience", "obs")),
+    ("PIF115", ("serve", "resilience", "plans", "parallel")),
+])
+def test_shipped_package_clean(rule, paths):
+    targets = [os.path.join(PKG, p) for p in paths]
+    found = check.check_paths(targets, rules=[rule])
+    assert found == [], engine.format_human(found)
+
+
+# ======================================= the PR-12 busy_s regression
+
+
+MESH_PATH = os.path.join(PKG, "serve", "mesh.py")
+LOCKED = """                with device._busy_lock:
+                    device.busy_s += dt"""
+UNLOCKED = """                device.busy_s += dt"""
+
+
+def test_mesh_busy_s_lock_revert_fails_pif112():
+    """Reverting the PR-12 lock around the utilization accounting must
+    make `pifft check` fail with PIF112 — the race class is now a
+    machine-checked invariant, not review prose."""
+    src = open(MESH_PATH, encoding="utf-8").read()
+    assert LOCKED in src, "mesh.py busy_s accounting moved; update test"
+    reverted = src.replace(LOCKED, UNLOCKED)
+    found = check.check_source(MESH_PATH, reverted, rules=["PIF112"])
+    assert "PIF112" in rule_ids(found), \
+        "unlocked busy_s += must fail PIF112"
+    assert any("busy_s" in f.message for f in found)
+
+
+def test_mesh_as_shipped_is_pif112_clean():
+    src = open(MESH_PATH, encoding="utf-8").read()
+    assert check.check_source(MESH_PATH, src, rules=["PIF112"]) == []
+
+
+# =========================================== registry / docs parity
+
+
+def test_flow_rules_registered_with_metadata():
+    rules = check.all_rules()
+    for rid in ("PIF302", "PIF303", "PIF304", "PIF112", "PIF113",
+                "PIF114", "PIF115"):
+        assert rid in rules
+        r = rules[rid]
+        assert r.name and r.summary and r.invariant
+
+
+# ================================ review-hardening regression tests
+
+
+def test_pif113_explicit_asyncio_acquire_is_clean():
+    """`await lock.acquire()` is an asyncio.Lock — the sanctioned
+    kind; only a BARE (sync) acquire counts as holding a threading
+    lock across an await."""
+    found = run("""
+        class D:
+            async def flush(self):
+                await self._lock.acquire()
+                try:
+                    await self.sink.drain()
+                finally:
+                    self._lock.release()
+    """, "PIF113")
+    assert found == []
+
+
+def test_pif112_same_attr_name_on_unrelated_class_is_clean():
+    """Lock-guarded `self.count` on one class must not indict a
+    same-named attribute on an unrelated class in the same file."""
+    found = run("""
+        class A:
+            def read(self):
+                with self._lock:
+                    return self.count
+
+        class B:
+            def reset(self):
+                self.count = 0
+    """, "PIF112")
+    assert found == []
+
+
+def test_pif112_unknown_receiver_still_matches_guarded_attr():
+    """The busy_s shape: the locked access uses a non-self receiver
+    (its class is statically unknown), so a bare write to the same
+    attribute anywhere in the file still flags."""
+    found = run("""
+        class Mesh:
+            def bump(self, device, dt):
+                with device._busy_lock:
+                    device.busy_s += dt
+
+            def skew(self, device):
+                device.busy_s = 0.0
+    """, "PIF112")
+    assert rule_ids(found) == ["PIF112"]
