@@ -8,7 +8,7 @@ SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
         bench-chaos serve-smoke serve-slo serve-mesh-smoke rfft-smoke \
-        precision-smoke multichip-smoke \
+        precision-smoke apps-smoke multichip-smoke \
         replicate run-experiments run-experiments-and-analyze-results \
         analyze analyze-datasets analyze-smoke check lint
 
@@ -256,6 +256,26 @@ precision-smoke:
 	  > /tmp/pifft-precision-shapes.jsonl && \
 	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
 	  serve --smoke --shapes /tmp/pifft-precision-shapes.jsonl
+
+# the CI spectral-operation check (docs/APPS.md): per-op gates —
+# conv: fftconv/overlap-save parity vs the numpy oracles at
+# 2^10..2^14 (block sweep: block == signal, block > signal,
+# non-divisible tails), the METERED fusion gate (the
+# pifft_hbm_bytes_total delta of a fused conv must sit at the op's
+# fused roofline floor while the deliberately unfused host-round-trip
+# control exceeds it — the gate discriminates), and one conv request
+# served END TO END over the socket protocol (op-tagged GroupKey,
+# coalescing from the obs counters, a fault-injected request
+# degrade-tagged, the op-tagged SLO row present); corr: correlate
+# parity incl. the conjugation mattering; solve: the PDE family
+# (3-D Poisson, Helmholtz const+variable, the exact heat step)
+apps-smoke:
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  apps conv --smoke
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  apps corr --smoke
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  apps solve --smoke
 
 # the CI multichip check (docs/MULTICHIP.md): the four sharding
 # dryruns on a forced 8-device CPU host platform (incl. the asserted

@@ -384,6 +384,158 @@ def measure_rfft_row(logn: int, smoke: bool = False) -> dict:
     return out
 
 
+def measure_conv_row(logn: int, smoke: bool = False) -> dict:
+    """One fused spectral-convolution reach row (docs/APPS.md) beside
+    the transform rows at the same n: the served circular conv
+    primitive — rfft(x) · cached-kernel-spectrum, irfft, all on
+    device — timed through its jitted fused pipeline, with the
+    METERED HBM-bytes delta the `make apps-smoke` gate holds at the
+    FUSED floor (an unfused host round-trip charges visibly more),
+    and the op-aware roofline utilization.  GFLOP/s uses the real-
+    transform count of what the timed pipeline RUNS — one rfft + one
+    irfft, 2 x 2.5 n log2 n (the kernel spectrum is cached, the
+    repeated-filtering serving reality).  Smoke rows record the
+    parity error vs the numpy oracle."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.apps.spectral import (
+        _fused_circular,
+        kernel_spectrum,
+        numpy_oracle,
+    )
+    from cs87project_msolano2_tpu.resilience import classify, maybe_fault
+    from cs87project_msolano2_tpu.utils.roofline import (
+        charge_spectral_traffic,
+        spectral_roofline_utilization,
+    )
+
+    import jax.numpy as jnp
+
+    nn = 1 << logn
+    tag = f"conv2^{logn}"
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal(nn).astype(np.float32)
+    k = rng.standard_normal(129).astype(np.float32)
+    try:
+        kr, ki = kernel_spectrum(k, nn)
+        fused = _fused_circular("conv", nn, None)
+        xp = jnp.asarray(x)
+
+        def run_cell():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(fused, xp, kr, ki) if smoke else \
+                _timed_op_ms(fused, xp, kr, ki)
+
+        ms = _retry(run_cell, smoke=smoke, label=f"conv n={nn}")
+    except Exception as e:
+        plans.warn(f"conv 2^{logn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return {}
+    # the timed pipeline runs TWO transforms (the kernel spectrum is
+    # cached — the repeated-filtering serving reality): one rfft of
+    # the signal + one irfft, 2 x 2.5 n log2 n real-transform flops
+    out = {f"{tag}_ms": round(ms, 4),
+           f"{tag}_gflops": round(
+               2 * 2.5 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1),
+           f"{tag}_op": "conv"}
+    _, hbm = _metered_hbm_delta(
+        lambda: charge_spectral_traffic("conv", nn))
+    if hbm:
+        out[f"{tag}_hbm_bytes"] = hbm
+    key = plans.make_key(nn, layout="natural", domain="r2c")
+    util = spectral_roofline_utilization("conv", nn, ms,
+                                         key.device_kind)
+    if util is not None:
+        out[f"{tag}_roofline_util"] = round(util, 3)
+    if smoke:
+        y = np.asarray(fused(xp, kr, ki))
+        ref = numpy_oracle("conv", x.astype(np.float64),
+                           np.pad(k, (0, nn - k.shape[0]))
+                           .astype(np.float64), nn)
+        out[f"{tag}_parity_relerr"] = float(
+            np.max(np.abs(y - ref)) / np.max(np.abs(ref)))
+    return out
+
+
+def measure_os_row(logn: int, smoke: bool = False) -> dict:
+    """One overlap-save streaming-convolution row (docs/APPS.md): a
+    signal 4x the block convolved through ONE cached plan pair at
+    block = 2^logn, reporting the row set's chunk-count and
+    overlap-waste columns — the two sides of the block-size trade the
+    tuned `block` axis races — plus wall time and the metered
+    per-chunk traffic.  Rows past stream.py's raced-candidate ceiling
+    (MAX_BLOCK) are SKIPPED with a diagnostic rather than silently
+    measured at a capped block the row tag would misname.  Smoke rows
+    record np.convolve parity."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.apps.stream import (
+        MAX_BLOCK,
+        chunk_count,
+        overlap_save,
+        overlap_waste,
+    )
+    from cs87project_msolano2_tpu.obs.spans import clock
+    from cs87project_msolano2_tpu.resilience import classify, maybe_fault
+
+    # the os2^K tag IS the block size (analyze/loader parses it that
+    # way): past stream.py's raced-candidate ceiling the row is
+    # skipped, never silently measured at a capped block the tag
+    # would misname (the hardware rows' 2^22..2^27 n land here)
+    if (1 << logn) > MAX_BLOCK:
+        plans.warn(f"overlap-save 2^{logn} skipped: block past the "
+                   f"raced-candidate ceiling MAX_BLOCK="
+                   f"2^{MAX_BLOCK.bit_length() - 1} "
+                   f"(docs/APPS.md block-size tuning)")
+        return {}
+    block = 1 << logn
+    m = 129
+    n_signal = 4 * block
+    tag = f"os2^{logn}"
+    rng = np.random.default_rng(10)
+    x = rng.standard_normal(n_signal).astype(np.float32)
+    k = rng.standard_normal(m).astype(np.float32)
+    try:
+        def run_cell():
+            maybe_fault("bench")  # resilience injection site
+            t0 = clock()
+            y = overlap_save(x, k, block=block)
+            return (clock() - t0) * 1e3, y
+
+        (ms, y), hbm = _metered_hbm_delta(
+            lambda: _retry(run_cell, smoke=smoke,
+                           label=f"overlap-save block={block}"))
+    except Exception as e:
+        plans.warn(f"overlap-save 2^{logn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return {}
+    out = {f"{tag}_ms": round(ms, 4),
+           f"{tag}_block": block,
+           f"{tag}_signal_n": n_signal,
+           f"{tag}_chunks": chunk_count(n_signal, m, block),
+           f"{tag}_overlap_waste": round(overlap_waste(block, m), 4),
+           f"{tag}_op": "conv"}
+    if hbm:
+        out[f"{tag}_hbm_bytes"] = hbm
+    if smoke:
+        ref = np.convolve(x.astype(np.float64), k.astype(np.float64),
+                          "full")
+        out[f"{tag}_parity_relerr"] = float(
+            np.max(np.abs(y - ref)) / np.max(np.abs(ref)))
+    return out
+
+
+def _timed_op_ms(fn, *args) -> float:
+    """Wall time of one compiled fused-op invocation (median of 5 —
+    the ops are whole pipelines, not single kernels; the loop-slope
+    discipline belongs to the transforms the pipeline is built
+    from)."""
+    from cs87project_msolano2_tpu.utils.timing import time_ms
+
+    ms, _ = time_ms(fn, *args, reps=5, warmup=2)
+    return ms
+
+
 def measure_precision_ms(n: int, mode: str, smoke: bool = False) -> tuple:
     """(ms, plan) for an n-point pi-layout key at precision `mode`
     (docs/PRECISION.md) — the flagship measurement path with the
@@ -884,6 +1036,18 @@ def main(argv=None) -> int:
                     probe_n=1 << logn)
         degraded_rows |= bool(prow.get(f"bf16_2^{logn}_degraded"))
         large.update(prow)
+        # the spectral-op rows at the SAME n (docs/APPS.md): the fused
+        # conv cell whose metered HBM delta the apps-smoke gate holds
+        # at the fused floor, and the overlap-save streaming cell with
+        # its chunk-count / overlap-waste columns
+        large.update(cell(f"conv2^{logn}",
+                          lambda logn=logn: measure_conv_row(
+                              logn, smoke=args.smoke),
+                          probe_n=1 << logn))
+        large.update(cell(f"os2^{logn}",
+                          lambda logn=logn: measure_os_row(
+                              logn, smoke=args.smoke),
+                          probe_n=1 << logn))
     if args.smoke:
         # the interpret-safe sixstep cell (docs/KERNELS.md): rides only
         # in smoke mode — on hardware the 2^25..2^27 rows above exercise

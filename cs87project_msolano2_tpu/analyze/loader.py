@@ -101,7 +101,12 @@ class Sample:
     (docs/PRECISION.md): precision-mode rows ("bf16_2^K_*" metrics)
     carry their mode, and every record that predates the precision
     axis — the whole committed r01-r06 trajectory — backfills
-    "split3", the mode those rounds actually ran."""
+    "split3", the mode those rounds actually ran.  ``op`` tags the
+    served spectral operation (docs/APPS.md): op rows ("conv2^K_*",
+    "corr2^K_*", "solve2^K_*", and the overlap-save "os2^K_*" set)
+    carry their op, and every record that predates the op axis —
+    the whole committed BENCH_r01-r06 trajectory — backfills "fft",
+    the only op those rounds served."""
 
     source: str               # "tsv" | "bench" | "obs"
     metric: str               # "total_ms", "funnel_ms", "n2^24_gflops", ...
@@ -114,6 +119,7 @@ class Sample:
     degraded: bool = False
     domain: str = "c2c"
     precision: str = "split3"
+    op: str = "fft"
     #: mesh-serving rows (docs/SERVING.md): per-device ``serve_mesh``
     #: samples carry the device id they were measured on; every other
     #: sample (and every pre-mesh committed round) stays None
@@ -340,6 +346,12 @@ _RFFT_METRIC = re.compile(r"^rfft2\^(\d+)_")
 #: rides the metric name exactly as the domain does for rfft rows
 _PRECISION_METRIC = re.compile(
     r"^(bf16|fp32|highest|default)_2\^(\d+)_")
+#: spectral-op row prefixes (docs/APPS.md): the conv/corr/solve cells
+#: plus the overlap-save streaming set ("os" = streaming conv; its
+#: 2^K is the BLOCK size, the row's tuned chunk length)
+_OP_METRIC = re.compile(r"^(conv|corr|solve|os)2\^(\d+)_")
+_OP_PREFIX = {"conv": "conv", "corr": "corr", "solve": "solve",
+              "os": "conv"}
 
 
 def bench_samples(rnd: BenchRound) -> list:
@@ -347,9 +359,11 @@ def bench_samples(rnd: BenchRound) -> list:
     row prefix where one exists; ``rfft2^K_`` rows parse the same n
     and tag ``domain="r2c"``; ``bf16_2^K_`` (and any other
     precision-mode prefix) rows parse the same n and tag their
-    ``precision`` — everything else, including every pre-domain /
-    pre-precision committed round (BENCH_r01-r06), backfills "c2c" /
-    "split3"; replicated metrics flatten with rep indices)."""
+    ``precision``; ``conv2^K_`` / ``corr2^K_`` / ``solve2^K_`` /
+    ``os2^K_`` op rows (docs/APPS.md) tag ``op`` — everything else,
+    including every pre-domain / pre-precision / pre-op committed
+    round (BENCH_r01-r06), backfills "c2c" / "split3" / "fft";
+    replicated metrics flatten with rep indices)."""
     out = []
     for name, val in rnd.metrics.items():
         if name == "serve_mesh_utilization":
@@ -366,6 +380,7 @@ def bench_samples(rnd: BenchRound) -> list:
             continue
         domain = "c2c"
         precision = "split3"
+        op = "fft"
         m = _LOGN_METRIC.match(name)
         if m is None:
             m = _RFFT_METRIC.match(name)
@@ -377,13 +392,19 @@ def bench_samples(rnd: BenchRound) -> list:
             if pm is not None:
                 precision = pm.group(1)
                 n = 1 << int(pm.group(2))
+        if m is None and n is None:
+            om = _OP_METRIC.match(name)
+            if om is not None:
+                op = _OP_PREFIX[om.group(1)]
+                domain = "r2c"  # the ops ride the half-spectrum path
+                n = 1 << int(om.group(2))
         values = val if isinstance(val, list) else [val]
         for rep, v in enumerate(values):
             out.append(Sample(
                 source="bench", metric=name, value=v, n=n,
                 rep=rep if isinstance(val, list) else None,
                 round_index=rnd.index, fingerprint=rnd.fingerprint,
-                domain=domain, precision=precision))
+                domain=domain, precision=precision, op=op))
     return out
 
 

@@ -1,0 +1,50 @@
+"""The spectral operation suite (docs/APPS.md): production traffic
+buys *operations* — filtering, correlation, PDE solves — not bare
+transforms, and this package turns the tuned plan ladder into exactly
+those:
+
+* :mod:`.spectral` — fused spectral convolution / cross-correlation
+  (one rfft of each operand, a pointwise half-spectrum multiply ON
+  DEVICE, one irfft — composed from the planned executors so the
+  intermediate never materializes on host) with a kernel-spectrum
+  cache, plus the op executors and numpy oracles the serving layer's
+  op-tagged groups ride;
+* :mod:`.stream` — overlap-save / overlap-add block convolution for
+  signals longer than any transform: ONE cached plan pair per chunk
+  shape, a plan-chosen (autotune-raced) block size, an eager array
+  API, a generator/push API serve can drain incrementally, and a
+  journaled kill-safe variant;
+* :mod:`.pde` — the spectral solver family generalizing
+  ``parallel/poisson3d.py``: one spectral pipeline parameterized by
+  its multiplier (Poisson, constant- and variable-coefficient
+  Helmholtz, an exact spectral time-stepper), single-device and
+  slab-sharded.
+
+Every op has a roofline minimum-traffic model
+(``utils.roofline.spectral_min_hbm_bytes``) charged through the same
+``pifft_hbm_bytes_total`` meter the transforms use, so the fused-op
+win is enforced by ``make apps-smoke`` from the meter, not asserted
+in prose — an implementation that round-trips the half-spectrum
+through host trips the gate (and check rule PIF116 flags it
+statically).
+"""
+
+from __future__ import annotations
+
+from .spectral import (  # noqa: F401
+    OPS,
+    fftconv,
+    fftcorr,
+    kernel_spectrum,
+    numpy_oracle,
+    op_executor,
+    solve_spectral_1d,
+)
+from .stream import (  # noqa: F401
+    OverlapSave,
+    choose_block,
+    overlap_add,
+    overlap_save,
+    overlap_save_journaled,
+    overlap_save_stream,
+)

@@ -1,0 +1,386 @@
+"""`pifft apps {conv,corr,solve}` — the spectral operation suite's
+front door and its CI smokes (docs/APPS.md).
+
+``--smoke`` is the ``make apps-smoke`` gate, one op per invocation:
+
+* **conv**: (1) ``fftconv`` / overlap-save parity vs the
+  numpy/scipy-class oracles at 2^10..2^14 (block sweep included);
+  (2) the METERED fusion gate — the ``pifft_hbm_bytes_total`` delta
+  of a fused conv must sit within tolerance of the op's fused
+  roofline floor while the deliberately UNFUSED control (a host
+  round-trip between the transforms) exceeds it, so the gate
+  actually discriminates; (3) a conv request served END TO END over
+  the socket protocol — op-tagged GroupKey, coalescing asserted from
+  the obs counters, a fault-injected request degrade-tagged on its
+  fallback rung, the op-tagged SLO row present, every event
+  schema-valid.
+* **corr**: ``fftcorr`` vs ``numpy.correlate`` across modes plus the
+  circular oracle, and the conjugation actually mattering (corr !=
+  conv on asymmetric kernels).
+* **solve**: the solver family — 1-D served solve vs its oracle,
+  3-D Poisson vs the spectral reference, constant- and
+  variable-coefficient Helmholtz residuals, the exact heat step —
+  and the poisson3d shim still matching the family (one pipeline,
+  not two).
+
+Without ``--smoke`` the subcommand runs a small demo of the op and
+prints the result summary (a quick by-hand check, not a gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+#: parity tolerance for the float32 fused pipelines vs float64 oracles
+TOL = 1e-4
+
+#: metered-fusion gate tolerance: the fused cell must charge within
+#: this factor of the fused floor (the charge IS the op's declared
+#: model, so this is slack for future carry accounting, not noise)
+FUSED_TOL = 1.05
+
+SMOKE_LOGNS = (10, 12, 14)
+
+
+def _parity_problems(op: str) -> list:
+    """Oracle-parity sweep for one op at the smoke sizes."""
+    from .spectral import fftconv, fftcorr, numpy_oracle
+    from .stream import overlap_add, overlap_save
+
+    problems = []
+    rng = np.random.default_rng(0)
+    for logn in SMOKE_LOGNS:
+        n = 1 << logn
+        x = rng.standard_normal(n).astype(np.float32)
+        k = rng.standard_normal(33).astype(np.float32)
+        if op == "solve":
+            from .spectral import solve_spectral_1d
+
+            got = solve_spectral_1d(x)
+            ref = numpy_oracle("solve", x.astype(np.float64), None, n)
+            err = float(np.max(np.abs(got - ref))
+                        / max(np.max(np.abs(ref)), 1e-30))
+            if err > TOL:
+                problems.append(f"solve n=2^{logn}: rel err {err:.2e} "
+                                f"> {TOL:.0e} vs spectral oracle")
+            continue
+        fn = fftconv if op == "conv" else fftcorr
+        oracle = np.convolve if op == "conv" else np.correlate
+        for mode in ("full", "same", "valid"):
+            got = fn(x, k, mode)
+            ref = oracle(x.astype(np.float64), k.astype(np.float64),
+                         mode)
+            err = float(np.max(np.abs(got - ref))
+                        / np.max(np.abs(ref)))
+            if err > TOL:
+                problems.append(f"{op} n=2^{logn} mode={mode}: rel "
+                                f"err {err:.2e} > {TOL:.0e} vs "
+                                f"numpy.{oracle.__name__}")
+        if op == "conv" and logn == SMOKE_LOGNS[0]:
+            # the streaming path across block sizes, including block
+            # == padded signal, block > signal, non-divisible tails
+            ref = np.convolve(x.astype(np.float64),
+                              k.astype(np.float64), "full")
+            for block in (64, 256, n, 2 * n):
+                for stitcher, name in ((overlap_save, "overlap-save"),
+                                       (overlap_add, "overlap-add")):
+                    y = stitcher(x, k, block=block)
+                    err = float(np.max(np.abs(y - ref))
+                                / np.max(np.abs(ref)))
+                    if err > TOL:
+                        problems.append(
+                            f"{name} block={block}: rel err "
+                            f"{err:.2e} > {TOL:.0e}")
+    if op == "corr":
+        # the conjugation must matter: an asymmetric kernel's corr
+        # and conv differ — a sign bug that served conv for corr
+        # would otherwise sail through symmetric-ish noise
+        x = rng.standard_normal(256).astype(np.float32)
+        k = np.zeros(9, np.float32)
+        k[1] = 1.0
+        from .spectral import fftconv as _conv
+
+        if np.allclose(fftcorr(x, k, "full"), _conv(x, k, "full"),
+                       atol=1e-3):
+            problems.append("corr == conv on an asymmetric kernel — "
+                            "the conjugation is not applied")
+    return problems
+
+
+def _fusion_gate_problems() -> list:
+    """The metered-fusion gate (docs/APPS.md): read the
+    pifft_hbm_bytes_total delta for a fused conv and the unfused
+    control FROM THE METER and hold the fused one at the floor."""
+    from .. import obs
+    from ..obs import metrics
+    from ..utils.roofline import spectral_min_hbm_bytes
+    from .spectral import fftconv, fftconv_unfused
+
+    problems = []
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    try:
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1 << 12) - 32).astype(np.float32)
+        k = rng.standard_normal(33).astype(np.float32)
+        n_pad = 1 << 12  # next_pow2(len(x) + len(k) - 1)
+
+        def delta(fn):
+            before = metrics.counter_value("pifft_hbm_bytes_total")
+            y = fn(x, k)
+            return y, int(metrics.counter_value(
+                "pifft_hbm_bytes_total") - before)
+
+        y_fused, fused_bytes = delta(fftconv)
+        y_unfused, unfused_bytes = delta(fftconv_unfused)
+        floor = spectral_min_hbm_bytes("conv", n_pad)
+        gate = int(floor * FUSED_TOL)
+        if not fused_bytes:
+            problems.append("fused conv charged ZERO metered bytes — "
+                            "the op meter is not wired")
+        elif fused_bytes > gate:
+            problems.append(
+                f"fused conv metered {fused_bytes} B > fused floor "
+                f"{floor} B x {FUSED_TOL} — the pipeline is moving "
+                f"more than the fused model (a host round trip?)")
+        if unfused_bytes <= gate:
+            problems.append(
+                f"UNFUSED control metered {unfused_bytes} B <= the "
+                f"gate bound {gate} B — the gate does not "
+                f"discriminate")
+        if not np.allclose(y_fused, y_unfused, atol=1e-3):
+            problems.append("fused and unfused conv disagree — the "
+                            "control is not computing the same thing")
+    finally:
+        if owned:
+            obs.disable()
+    return problems
+
+
+def _served_conv_problems() -> list:
+    """A conv request served end to end through the SOCKET protocol
+    (acceptance: op-tagged GroupKey, coalesced, degrade-tagged on
+    fallback, visible in SLO rows, schema-valid events)."""
+    import asyncio
+
+    from .. import obs
+    from ..obs import events as obs_events
+    from ..obs import metrics
+    from ..resilience import inject
+    from ..serve import Dispatcher, ServeConfig
+    from ..serve.batcher import GroupKey
+    from ..serve.protocol import handle_connection, request_over_socket
+    from ..serve.shapes import ShapeSpec
+    from .spectral import numpy_oracle
+
+    problems = []
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    n = 1 << 10
+    k_burst = 6
+    rng = np.random.default_rng(2)
+    spec = ShapeSpec(n=n, op="conv")
+    label = GroupKey(n=n, domain="r2c", op="conv").label()
+    inputs = [(rng.standard_normal(n).astype(np.float32),
+               rng.standard_normal(n).astype(np.float32))
+              for _ in range(k_burst)]
+
+    async def main():
+        d = Dispatcher(ServeConfig(max_wait_ms=25.0), [spec])
+        await asyncio.get_running_loop().run_in_executor(None, d.warm)
+        server = await asyncio.start_server(
+            lambda r, w: handle_connection(d, r, w), "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        replies = await asyncio.gather(*[
+            request_over_socket("127.0.0.1", port, xr, xi, op="conv")
+            for xr, xi in inputs])
+        # one more, with the serve site armed: the batch must fall to
+        # a rung that still speaks conv, degrade-tagged on the wire
+        with inject("serve", "capacity", count=1):
+            degraded = await request_over_socket(
+                "127.0.0.1", port, inputs[0][0], inputs[0][1],
+                op="conv")
+        server.close()
+        await server.wait_closed()
+        await d.close()
+        return d, replies, degraded
+
+    try:
+        d, replies, degraded = asyncio.run(main())
+        for (xr, xi), rep in zip(inputs, replies):
+            if not rep.get("ok"):
+                problems.append(f"served conv failed: {rep}")
+                break
+            ref = numpy_oracle("conv", xr.astype(np.float64),
+                               xi.astype(np.float64), n)
+            err = float(np.max(np.abs(np.asarray(rep["yr"]) - ref))
+                        / np.max(np.abs(ref)))
+            if err > TOL:
+                problems.append(f"served conv wrong: rel err "
+                                f"{err:.2e} > {TOL:.0e}")
+                break
+        batches = int(metrics.counter_value(
+            "pifft_serve_batches_total", shape=label))
+        if not (0 < batches < k_burst):
+            problems.append(
+                f"no coalescing: {k_burst} concurrent conv requests "
+                f"-> {batches} invocation(s) on group {label!r}")
+        if not degraded.get("ok"):
+            problems.append(f"fault-injected conv request FAILED "
+                            f"instead of degrading: {degraded}")
+        elif not degraded.get("degraded") or not degraded.get("degrade"):
+            problems.append(
+                f"fault-injected conv served UNTAGGED "
+                f"(degraded={degraded.get('degraded')}, "
+                f"trail={degraded.get('degrade')})")
+        if label not in d.stats.summary():
+            problems.append(f"op-tagged SLO row {label!r} missing "
+                            f"from {sorted(d.stats.summary())}")
+        ops_served = metrics.counter_value("pifft_serve_ops_total",
+                                           op="conv")
+        if ops_served < k_burst:
+            problems.append(f"pifft_serve_ops_total{{op=conv}} = "
+                            f"{ops_served} < {k_burst}")
+        bad = [p for rec in obs_events.snapshot()
+               for p in obs_events.validate_event(rec)]
+        if bad:
+            problems.append(f"{len(bad)} schema-invalid event(s): "
+                            f"{bad[:3]}")
+    finally:
+        if owned:
+            obs.disable()
+    return problems
+
+
+def _solve_family_problems() -> list:
+    """The pde family beyond the served 1-D solve: 3-D Poisson vs the
+    spectral reference, the poisson3d-shim equivalence, Helmholtz
+    residuals (constant and variable coefficient), the exact heat
+    step."""
+    from .pde import (
+        helmholtz_solve,
+        helmholtz_solve_variable,
+        poisson_solve,
+        spectral_step,
+    )
+
+    problems = []
+    rng = np.random.default_rng(3)
+    f = rng.standard_normal((16, 16, 32)).astype(np.float32)
+    f -= f.mean()
+    axes = [np.fft.fftfreq(m) * m for m in f.shape]
+    ksq = (axes[0][:, None, None] ** 2 + axes[1][None, :, None] ** 2
+           + axes[2][None, None, :] ** 2)
+
+    def spectral_ref(mult):
+        return np.real(np.fft.ifftn(np.fft.fftn(f.astype(np.float64))
+                                    * mult))
+
+    u = np.asarray(poisson_solve(f))
+    with np.errstate(divide="ignore"):
+        m_poi = np.where(ksq > 0, -1.0 / np.maximum(ksq, 1e-30), 0.0)
+    err = float(np.max(np.abs(u - spectral_ref(m_poi))))
+    if err > TOL:
+        problems.append(f"3-D poisson: abs err {err:.2e} > {TOL:.0e}")
+    uh = np.asarray(helmholtz_solve(f, 2.5))
+    err = float(np.max(np.abs(uh - spectral_ref(1.0 / (2.5 + ksq)))))
+    if err > TOL:
+        problems.append(f"helmholtz alpha=2.5: abs err {err:.2e}")
+    us = np.asarray(spectral_step(f, nu=0.05, dt=0.02, steps=4))
+    err = float(np.max(np.abs(
+        us - spectral_ref(np.exp(-0.05 * ksq * 0.08)))))
+    if err > TOL:
+        problems.append(f"heat step: abs err {err:.2e}")
+    alpha = (2.0 + 0.5 * np.cos(
+        np.linspace(0, 2 * np.pi, 16))[:, None, None]
+        * np.ones_like(f)).astype(np.float32)
+    uv = np.asarray(helmholtz_solve_variable(f, alpha, iters=60))
+    lap = np.real(np.fft.ifftn(np.fft.fftn(uv.astype(np.float64))
+                               * (-ksq)))
+    res = float(np.max(np.abs(alpha * uv - lap - f))
+                / np.max(np.abs(f)))
+    if res > 1e-3:
+        problems.append(f"variable helmholtz residual {res:.2e} > "
+                        f"1e-3 — the fixed point did not converge")
+    return problems
+
+
+def apps_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu apps",
+        description="the spectral operation suite: fused conv/corr, "
+                    "streaming overlap-save, the spectral PDE family "
+                    "(docs/APPS.md)",
+    )
+    ap.add_argument("op", choices=("conv", "corr", "solve"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="the make apps-smoke CI gate for this op: "
+                         "oracle parity, the metered fusion gate "
+                         "(conv), a served socket round trip (conv)")
+    ap.add_argument("-n", type=int, default=1 << 12,
+                    help="demo size (no --smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+
+    if not args.smoke:
+        return _demo(args)
+
+    problems = _parity_problems(args.op)
+    checks = [f"{args.op} oracle parity at "
+              + ",".join(f"2^{g}" for g in SMOKE_LOGNS)]
+    if args.op == "conv":
+        problems += _fusion_gate_problems()
+        checks.append("metered fusion gate (fused floor vs unfused "
+                      "control)")
+        problems += _served_conv_problems()
+        checks.append("served socket conv (op-tagged, coalesced, "
+                      "degrade-tagged)")
+    if args.op == "solve":
+        problems += _solve_family_problems()
+        checks.append("pde family (3-D poisson, helmholtz, variable "
+                      "helmholtz, heat step)")
+
+    if args.json:
+        print(json.dumps({"op": args.op, "ok": not problems,
+                          "checks": checks, "problems": problems},
+                         indent=1, sort_keys=True))
+    else:
+        for p in problems:
+            print(f"# FAIL: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"# apps {args.op} smoke ok ({'; '.join(checks)})",
+          file=sys.stderr)
+    return 0
+
+
+def _demo(args) -> int:
+    """The no-smoke path: run the op once and summarize."""
+    rng = np.random.default_rng(0)
+    n = args.n
+    if args.op == "solve":
+        from .spectral import solve_spectral_1d
+
+        f = rng.standard_normal(n).astype(np.float32)
+        u = solve_spectral_1d(f)
+        print(f"solve: n={n} |u|_max={np.max(np.abs(u)):.4f} "
+              f"mean={u.mean():.2e} (mean-free)")
+        return 0
+    from .spectral import fftconv, fftcorr
+    from .stream import choose_block
+
+    x = rng.standard_normal(n).astype(np.float32)
+    k = rng.standard_normal(65).astype(np.float32)
+    fn = fftconv if args.op == "conv" else fftcorr
+    y = fn(x, k)
+    print(f"{args.op}: n={n} m=65 -> {y.shape[0]} samples, "
+          f"|y|_max={np.max(np.abs(y)):.4f}; streaming block choice "
+          f"for m=65: {choose_block(65, n)}")
+    return 0

@@ -1,0 +1,461 @@
+"""Fused spectral convolution / correlation on the plan ladder
+(docs/APPS.md).
+
+The convolution theorem makes filtering three transforms and one
+elementwise multiply — and on a memory-bound kernel family the whole
+game is keeping that multiply ON DEVICE, in the half-spectrum, between
+the paired transforms:
+
+    y = irfft( rfft(x) · rfft(k) )          (conv)
+    c = irfft( rfft(x) · conj(rfft(k)) )    (corr)
+
+Everything here composes the EXISTING planned executors
+(``plans.plan_for(..., domain="r2c"/"c2r")`` — docs/REAL.md): the
+forward and inverse plans' traceable ``fn``s are fused into one jitted
+callable, so the half-spectrum intermediate lives in device memory for
+exactly the life of the pointwise multiply and never round-trips
+through host (check rule PIF116 watches for the round trip; the
+``make apps-smoke`` meter gate catches it dynamically).  Repeated
+filtering with the same kernel pays ONE forward transform: the kernel
+spectrum is cached per (kernel hash, n, domain, precision).
+
+Linear-convolution semantics (``numpy.convolve`` /
+``numpy.correlate`` parity) ride on the circular core by padding to
+the next even power of two >= len(x)+len(k)-1 and slicing the mode's
+window — the classic identity, with the padded length chosen from the
+plan ladder's domain.  The circular core itself is also the SERVED
+primitive: an op-tagged serve group (``op="conv"|"corr"|"solve"``,
+docs/SERVING.md) coalesces requests into one batched fused invocation
+through :func:`op_executor`, with ``jnp-fft`` and ``numpy-ref``
+degradation rungs that speak each op natively — a fallback that
+quietly served a bare transform would be a wrong answer merely tagged
+degraded.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import plans
+from ..obs import metrics
+from ..obs.spans import span
+from ..utils.roofline import SPECTRAL_OPS as OPS
+from ..utils.roofline import charge_spectral_traffic
+
+
+def check_op(op: str) -> str:
+    """Validate an op name, returning it; raises ``ValueError`` naming
+    the vocabulary — the one refusal every op-accepting surface
+    (shapes files, the wire, the CLI) routes through so an unknown op
+    is a structured error, never a silently-warmed bare FFT."""
+    if op not in OPS:
+        raise ValueError(f"op={op!r} not in {OPS} (docs/APPS.md)")
+    return op
+
+
+def next_pow2(v: int) -> int:
+    """Smallest power of two >= max(v, 2) (the plan ladder's domain —
+    real-domain keys additionally need even n, which >= 2 gives)."""
+    n = 2
+    while n < v:
+        n *= 2
+    return n
+
+
+def _mul_half_spectrum(ar, ai, br, bi, conj: bool):
+    """(a · b) or (a · conj(b)) on split half-spectrum planes."""
+    if conj:
+        return ar * br + ai * bi, ai * br - ar * bi
+    return ar * br - ai * bi, ar * bi + ai * br
+
+
+def poisson_multiplier_1d(n: int) -> np.ndarray:
+    """The 1-D periodic Poisson symbol on the n//2+1 half-spectrum
+    bins: u'' = f on [0, 2*pi) -> u_hat = -f_hat / k^2, zero mode -> 0
+    (the mean-free solution — the served ``solve`` op's contract)."""
+    k = np.arange(n // 2 + 1, dtype=np.float64)
+    with np.errstate(divide="ignore"):
+        m = np.where(k > 0, -1.0 / np.maximum(k * k, 1e-30), 0.0)
+    return m.astype(np.float32)
+
+
+# ------------------------------------------------ kernel-spectrum cache
+
+_KSPEC_LOCK = threading.Lock()
+_KSPEC_CACHE: dict = {}
+
+#: bound on cached kernel spectra: per-request distinct kernels at
+#: serving rates must not grow device memory without limit — past the
+#: bound the least-recently-USED entry is evicted (dict insertion
+#: order; hits re-append)
+KSPEC_CACHE_MAX = 64
+
+
+def _kernel_hash(k: np.ndarray) -> str:
+    return hashlib.sha1(np.ascontiguousarray(k, np.float32)
+                        .tobytes()).hexdigest()
+
+
+def kernel_spectrum(k, n: int, precision: Optional[str] = None) -> tuple:
+    """The half-spectrum planes of `k` zero-padded to `n`, through the
+    r2c plan at n — cached per (kernel hash, n, domain, precision) so
+    repeated filtering with one kernel pays ONE forward transform
+    (the ``pifft_apps_kspec_cache_total`` counter says which).  The
+    returned planes are device arrays; they never leave the device on
+    the fused path."""
+    k = np.ascontiguousarray(np.asarray(k, np.float32))
+    if k.ndim != 1 or not 1 <= k.shape[0] <= n:
+        raise ValueError(f"kernel must be 1-D with 1 <= len <= n={n}, "
+                         f"got shape {k.shape}")
+    ck = (_kernel_hash(k), n, "r2c", precision or "split3")
+    with _KSPEC_LOCK:
+        hit = _KSPEC_CACHE.pop(ck, None)
+        if hit is not None:
+            _KSPEC_CACHE[ck] = hit  # re-append: LRU recency
+    if hit is not None:
+        metrics.inc("pifft_apps_kspec_cache_total", result="hit")
+        return hit
+    metrics.inc("pifft_apps_kspec_cache_total", result="miss")
+    kp = np.zeros(n, np.float32)
+    kp[: k.shape[0]] = k
+    rfft_plan = plans.plan_for((n,), layout="natural",
+                               precision=precision, domain="r2c")
+    kr, ki = rfft_plan.execute(jnp.asarray(kp), jnp.zeros(n, jnp.float32))
+    with _KSPEC_LOCK:
+        _KSPEC_CACHE[ck] = (kr, ki)
+        while len(_KSPEC_CACHE) > KSPEC_CACHE_MAX:
+            _KSPEC_CACHE.pop(next(iter(_KSPEC_CACHE)))
+    return kr, ki
+
+
+def kernel_spectrum_cache_clear() -> None:
+    """Drop the cached kernel spectra (tests, memory pressure)."""
+    with _KSPEC_LOCK:
+        _KSPEC_CACHE.clear()
+
+
+# ------------------------------------------------- fused circular core
+
+#: jitted fused callables per (op, batch, n, precision, rung) — one
+#: compiled program per served shape, the serving-rate discipline
+#: (PIF2xx) the batcher applies to bare transforms
+_FUSED_LOCK = threading.Lock()
+_FUSED_CACHE: dict = {}
+
+
+def _build_fused(op: str, batch: tuple, n: int,
+                 precision: Optional[str]) -> tuple:
+    """(traceable run(xr, xi) -> (yr, yi), forward plan) for one op at
+    the transform length n over `batch` leading dims: rfft of each
+    operand, the pointwise half-spectrum multiply, irfft — all inside
+    ONE traced function, so the spectrum never leaves the device.
+
+    conv/corr: ``xr`` is the signal plane(s), ``xi`` the kernel
+    plane(s) (both real — the op rides the half-spectrum domain).
+    solve: ``xr`` is the field, ``xi`` ignored; the multiplier is the
+    1-D periodic Poisson symbol (the served solve contract; the
+    richer family lives in :mod:`.pde`)."""
+    shape = tuple(batch) + (n,)
+    fwd = plans.plan_for(shape, layout="natural", precision=precision,
+                         domain="r2c")
+    # serve at the forward plan's EFFECTIVE mode: a precision
+    # promotion (resilience.degrade.promote_precision) lands in the
+    # plan's params, and the rebuilt fused executor must pick it up
+    # for BOTH directions
+    eff = fwd.effective_precision()
+    inv = plans.plan_for(shape, layout="natural", precision=eff,
+                         domain="c2r")
+    if op == "solve":
+        mult = jnp.asarray(poisson_multiplier_1d(n))
+
+        def run(xr, xi):
+            del xi  # the field is real by declaration
+            ar, ai = fwd.fn(xr, jnp.zeros_like(xr))
+            yr, yi = inv.fn(ar * mult, ai * mult)
+            return yr, yi
+
+        return run, fwd
+    conj = op == "corr"
+
+    def run(xr, xi):  # xr = signal plane(s), xi = kernel plane(s)
+        zeros = jnp.zeros_like(xr)
+        ar, ai = fwd.fn(xr, zeros)
+        br, bi = fwd.fn(xi, zeros)
+        pr, pi = _mul_half_spectrum(ar, ai, br, bi, conj)
+        yr, yi = inv.fn(pr, pi)
+        return yr, yi
+
+    return run, fwd
+
+
+def op_executor(op: str, batch: tuple, n: int,
+                precision: Optional[str] = None,
+                rung: Optional[str] = None) -> tuple:
+    """(callable, plan) serving one op-tagged group (docs/SERVING.md):
+    the fused planned pipeline by default, or a degradation rung that
+    speaks the OP natively — ``jnp-fft`` via ``jnp.fft.rfft/irfft``,
+    ``numpy-ref`` via a ``pure_callback`` numpy pipeline — so a
+    fallback stays the same operation, just slower.  The returned
+    plan is the forward r2c plan (the variant/degradation identity
+    the batch outcome reports)."""
+    check_op(op)
+    if op == "fft":
+        raise ValueError("op='fft' is the plain transform — it is "
+                         "served by the plan executor, not an op "
+                         "pipeline")
+    shape = tuple(batch) + (n,)
+    fwd_plan = plans.plan_for(shape, layout="natural",
+                              precision=precision, domain="r2c")
+    if rung is None:
+        run, plan = _build_fused(op, tuple(batch), n, precision)
+        return run, plan
+    if rung == "jnp-fft":
+        if op == "solve":
+            mult = jnp.asarray(poisson_multiplier_1d(n))
+
+            def jnp_solve_run(xr, xi):
+                del xi
+                s = jnp.fft.rfft(xr.astype(jnp.float32), axis=-1)
+                y = jnp.fft.irfft(s * mult, n=n, axis=-1)
+                yr = y.astype(jnp.float32)
+                return yr, jnp.zeros_like(yr)
+
+            return jnp_solve_run, fwd_plan
+        conj = op == "corr"
+
+        def jnp_conv_run(xr, xi):
+            a = jnp.fft.rfft(xr.astype(jnp.float32), axis=-1)
+            b = jnp.fft.rfft(xi.astype(jnp.float32), axis=-1)
+            if conj:
+                b = jnp.conj(b)
+            y = jnp.fft.irfft(a * b, n=n, axis=-1)
+            yr = y.astype(jnp.float32)
+            return yr, jnp.zeros_like(yr)
+
+        return jnp_conv_run, fwd_plan
+    if rung == "numpy-ref":
+        import jax
+
+        out_shape = shape
+
+        def host_op(ar, ai):
+            yr = numpy_oracle(op, np.asarray(ar), np.asarray(ai), n)
+            yr = yr.astype(np.float32)
+            return yr, np.zeros_like(yr)
+
+        out_struct = (jax.ShapeDtypeStruct(out_shape, np.float32),
+                      jax.ShapeDtypeStruct(out_shape, np.float32))
+
+        def numpy_run(xr, xi):
+            return jax.pure_callback(host_op, out_struct, xr, xi)
+
+        return numpy_run, fwd_plan
+    raise ValueError(f"unknown op rung {rung!r}")
+
+
+def numpy_oracle(op: str, xr, xi, n: int) -> np.ndarray:
+    """The float64 numpy reference of one CIRCULAR op at n — the
+    oracle the serve smokes, the precision contract sampling, and
+    ``make apps-smoke`` verify against.  ``xr``/``xi`` follow the op's
+    served plane contract (signal/kernel for conv+corr; field/ignored
+    for solve); trailing axis is the transform axis."""
+    check_op(op)
+    x64 = np.asarray(xr, np.float64)
+    if op == "solve":
+        return np.fft.irfft(
+            np.fft.rfft(x64, axis=-1)
+            * poisson_multiplier_1d(n).astype(np.float64),
+            n=n, axis=-1)
+    k64 = np.asarray(xi, np.float64)
+    spec = np.fft.rfft(x64, axis=-1) * (
+        np.conj(np.fft.rfft(k64, axis=-1)) if op == "corr"
+        else np.fft.rfft(k64, axis=-1))
+    return np.fft.irfft(spec, n=n, axis=-1)
+
+
+def _fused_circular(op: str, n: int,
+                    precision: Optional[str]) -> Callable:
+    """The jitted single-signal fused circular pipeline at n, cached —
+    conv/corr against a PRE-TRANSFORMED kernel spectrum (the cache's
+    planes ride as arguments so one compiled program serves every
+    kernel)."""
+    import jax
+
+    ck = (op, n, precision or "split3")
+    with _FUSED_LOCK:
+        hit = _FUSED_CACHE.get(ck)
+    if hit is not None:
+        return hit
+    shape = (n,)
+    fwd = plans.plan_for(shape, layout="natural", precision=precision,
+                         domain="r2c")
+    inv = plans.plan_for(shape, layout="natural",
+                         precision=fwd.effective_precision(),
+                         domain="c2r")
+    conj = op == "corr"
+
+    def run(xp, kr, ki):
+        ar, ai = fwd.fn(xp, jnp.zeros_like(xp))
+        pr, pi = _mul_half_spectrum(ar, ai, kr, ki, conj)
+        yr, _ = inv.fn(pr, pi)
+        return yr
+
+    fn = jax.jit(run)
+    with _FUSED_LOCK:
+        _FUSED_CACHE[ck] = fn
+    return fn
+
+
+def circular_conv(x, k, op: str = "conv",
+                  precision: Optional[str] = None,
+                  n: Optional[int] = None) -> np.ndarray:
+    """Circular convolution (or correlation, ``op="corr"``) of real
+    `x` with real `k` at length ``n`` (default: len(x), which must
+    then be an even power of two) — the fused served primitive.  The
+    kernel spectrum comes from the cache; the half-spectrum product
+    never leaves the device."""
+    if op not in ("conv", "corr"):
+        raise ValueError(f"circular_conv serves conv/corr, not {op!r}")
+    x = np.ascontiguousarray(np.asarray(x, np.float32))
+    if x.ndim != 1:
+        raise ValueError(f"signal must be 1-D, got shape {x.shape}")
+    n = int(n) if n is not None else x.shape[0]
+    if n < 2 or n & (n - 1):
+        raise ValueError(f"circular length n={n} must be a power of "
+                         f"two >= 2 (the plan ladder's domain)")
+    if x.shape[0] > n:
+        raise ValueError(f"signal of {x.shape[0]} exceeds n={n}")
+    kr, ki = kernel_spectrum(k, n, precision)
+    xp = np.zeros(n, np.float32)
+    xp[: x.shape[0]] = x
+    fused = _fused_circular(op, n, precision)
+    with span("spectral_op", cell={"op": op, "n": n}):
+        y = fused(jnp.asarray(xp), kr, ki)
+        metrics.inc("pifft_apps_ops_total", op=op)
+        charge_spectral_traffic(op, n)
+    return np.asarray(y)
+
+
+def _mode_slice(full: np.ndarray, la: int, lv: int, mode: str,
+                op: str) -> np.ndarray:
+    """Slice a full linear conv/corr (length la+lv-1) into numpy's
+    mode windows.  ``same`` follows numpy: length max(la, lv),
+    centered — with correlate's swapped-operand convention honored
+    (``numpy.correlate(a, v)`` with len(v) > len(a) computes the
+    reversed correlate(v, a), which shifts the same-window start by
+    one when the shorter length is even)."""
+    if mode == "full":
+        return full
+    if mode == "same":
+        out_len = max(la, lv)
+        if op == "corr" and lv > la:
+            # reversed-swap centering: reverse(corr(v, a, same)) in
+            # full_av coordinates starts at (la-1) - (la-1)//2
+            start = (la - 1) - (la - 1) // 2
+        else:
+            start = (min(la, lv) - 1) // 2
+        return full[start:start + out_len]
+    if mode == "valid":
+        out_len = max(la, lv) - min(la, lv) + 1
+        start = min(la, lv) - 1
+        return full[start:start + out_len]
+    raise ValueError(f"mode={mode!r} not in ('full', 'same', 'valid')")
+
+
+def fftconv(x, k, mode: str = "full",
+            precision: Optional[str] = None) -> np.ndarray:
+    """Linear convolution of real 1-D `x` with real 1-D `k` via the
+    fused spectral pipeline — ``numpy.convolve(x, k, mode)`` parity,
+    at O(n log n): pad to the next power of two >= len(x)+len(k)-1,
+    run the fused circular core (one cached kernel transform, the
+    pointwise multiply on device), slice the mode window."""
+    x = np.asarray(x, np.float32)
+    k = np.asarray(k, np.float32)
+    la, lv = x.shape[-1], k.shape[-1]
+    n = next_pow2(la + lv - 1)
+    full = circular_conv(x, k, "conv", precision, n)[: la + lv - 1]
+    return _mode_slice(full, la, lv, mode, "conv")
+
+
+def fftcorr(x, k, mode: str = "full",
+            precision: Optional[str] = None) -> np.ndarray:
+    """Cross-correlation of real 1-D `x` with real 1-D `k` —
+    ``numpy.correlate(x, k, mode)`` parity via the conjugated kernel
+    spectrum (one rfft each, conj-multiply on device, one irfft).
+    The negative lags live at the top of the circular buffer; the
+    full window re-assembles them in numpy's order."""
+    x = np.asarray(x, np.float32)
+    k = np.asarray(k, np.float32)
+    la, lv = x.shape[-1], k.shape[-1]
+    n = next_pow2(la + lv - 1)
+    circ = circular_conv(x, k, "corr", precision, n)
+    # full output lag t - (lv-1), t = 0..la+lv-2: negative lags wrap
+    full = np.concatenate([circ[n - (lv - 1):], circ[:la]]) \
+        if lv > 1 else circ[:la]
+    return _mode_slice(full, la, lv, mode, "corr")
+
+
+def solve_spectral_1d(f, precision: Optional[str] = None) -> np.ndarray:
+    """The served 1-D periodic Poisson solve (op="solve"): u'' = f on
+    [0, 2*pi), mean-free — one fused rfft·symbol·irfft pipeline.  The
+    full solver family (3-D, Helmholtz, time-stepping) lives in
+    :mod:`.pde`."""
+    import jax
+
+    f = np.ascontiguousarray(np.asarray(f, np.float32))
+    n = f.shape[-1]
+    ck = ("solve", (), n, precision or "split3")
+    with _FUSED_LOCK:
+        fn = _FUSED_CACHE.get(ck)
+    if fn is None:
+        run, _plan = _build_fused("solve", (), n, precision)
+        fn = jax.jit(run)
+        with _FUSED_LOCK:
+            _FUSED_CACHE[ck] = fn
+    with span("spectral_op", cell={"op": "solve", "n": n}):
+        yr, _ = fn(jnp.asarray(f), jnp.zeros(n, jnp.float32))
+        metrics.inc("pifft_apps_ops_total", op="solve")
+        charge_spectral_traffic("solve", n)
+    return np.asarray(yr)
+
+
+def fftconv_unfused(x, k, mode: str = "full",
+                    precision: Optional[str] = None) -> np.ndarray:
+    """The DELIBERATELY UNFUSED control for the ``make apps-smoke``
+    meter gate (docs/APPS.md): same math as :func:`fftconv`, but the
+    half-spectrum product round-trips through HOST between the paired
+    transforms — exactly the anti-pattern the fused path exists to
+    kill, charged honestly as one extra spectrum round trip so the
+    metered delta EXCEEDS the fused floor and the gate discriminates.
+    Never serve this; it exists so the gate has a failing side."""
+    from ..models.real import irfft_planes_fast, rfft_planes_fast
+
+    x = np.asarray(x, np.float32)
+    k = np.asarray(k, np.float32)
+    la, lv = x.shape[-1], k.shape[-1]
+    n = next_pow2(la + lv - 1)
+    xp = np.zeros(n, np.float32)
+    xp[:la] = x
+    kp = np.zeros(n, np.float32)
+    kp[:lv] = k
+    ar, ai = rfft_planes_fast(jnp.asarray(xp), precision=precision)
+    br, bi = rfft_planes_fast(jnp.asarray(kp), precision=precision)
+    # the host round trip between the transforms — the PIF116 finding
+    # shape, suppressed here because being the gate's failing control
+    # is this function's entire purpose
+    har, hai, hbr, hbi = (np.asarray(ar), np.asarray(ai), np.asarray(br), np.asarray(bi))  # pifft: noqa[PIF116]: the metered-fusion gate's deliberately unfused control — the host round trip IS the point
+    pr = har * hbr - hai * hbi
+    pi = har * hbi + hai * hbr
+    yr = irfft_planes_fast(jnp.asarray(pr.astype(np.float32)),
+                           jnp.asarray(pi.astype(np.float32)), n=n,
+                           precision=precision)
+    metrics.inc("pifft_apps_ops_total", op="conv")
+    charge_spectral_traffic("conv", n, host_round_trips=1)
+    full = np.asarray(yr)[: la + lv - 1]
+    return _mode_slice(full, la, lv, mode, "conv")

@@ -1,0 +1,379 @@
+"""Overlap-save / overlap-add streaming convolution (docs/APPS.md).
+
+A signal longer than any transform — or one that has not finished
+ARRIVING — is served by the classic block-convolution identities
+(Oppenheim & Schafer): chunk the input, convolve each chunk against
+the kernel through ONE cached plan pair at the block length, and
+stitch.  Overlap-save slides a ``block``-long window by
+``L = block - (m-1)`` samples and keeps the L circularly-valid
+outputs per chunk; overlap-add convolves disjoint L-chunks to
+``L+m-1`` and adds the overhangs.  Both reuse one compiled fused
+pipeline (one r2c plan, one c2r plan, the cached kernel spectrum) for
+EVERY chunk — the per-chunk cost is a dispatch, not a trace.
+
+The block size is a tuned axis: a big block amortizes the transform
+(cost ~ block·log2(block) per chunk) but the last chunk wastes its
+padding, a small block wastes ``(m-1)/block`` of every transform on
+overlap.  :func:`choose_block` minimizes the analytic total;
+:func:`tune_block` RACES the candidate blocks with real timings on
+tunable devices (the autotune discipline — every candidate's fate is
+reported) and falls back to the analytic choice offline, exactly like
+``plans.tune_or_static``.
+
+Three front doors:
+
+* :func:`overlap_save` / :func:`overlap_add` — eager
+  ``numpy.convolve(x, k, "full")`` parity for arbitrary lengths;
+* :func:`overlap_save_stream` / :class:`OverlapSave` — the
+  generator/push API: feed chunks as they arrive, drain outputs
+  incrementally (what a served streaming op drains);
+* :func:`overlap_save_journaled` — the kill-safe variant on the
+  resilience journal: each chunk's output is checkpointed atomically,
+  a re-run resumes at the first chunk the kill took.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..obs import metrics
+from ..obs.spans import span
+from ..utils.roofline import charge_spectral_traffic
+from .spectral import _fused_circular, kernel_spectrum, next_pow2
+
+#: hard cap on raced/chosen block sizes (2^18 keeps every candidate
+#: inside the carry-free plan regime on current devices)
+MAX_BLOCK = 1 << 18
+
+
+def overlap_waste(block: int, m: int) -> float:
+    """Fraction of each transform spent re-computing the overlap:
+    (m-1)/block — the bench ``os2^K_overlap_waste`` column."""
+    return (m - 1) / block
+
+
+def chunk_count(n: int, m: int, block: int) -> int:
+    """Chunks an n-sample signal needs at this block size (full-
+    convolution output, n+m-1 samples) — the ``os2^K_chunks``
+    column."""
+    step = block - (m - 1)
+    return max(1, -(-(n + m - 1) // step))
+
+
+def block_candidates(m: int, n: Optional[int] = None) -> list:
+    """The raced block-size ladder for an m-tap kernel: powers of two
+    from the smallest useful block (>= 2·(m-1), so at least half of
+    every transform is new samples) up to MAX_BLOCK — truncated to one
+    size past the whole padded signal when `n` is known (a block
+    bigger than the signal is a single-chunk transform; racing ten of
+    them is pure waste)."""
+    lo = next_pow2(max(2 * (m - 1), 2))
+    cands = []
+    b = lo
+    while b <= MAX_BLOCK:
+        cands.append(b)
+        if n is not None and b >= n + m - 1:
+            break
+        b *= 2
+    return cands
+
+
+def block_cost(block: int, m: int, n: Optional[int] = None) -> float:
+    """Analytic cost of serving at this block size: chunk count times
+    the O(block log block) transform work when the signal length is
+    known, per-useful-output-sample transform work otherwise — the
+    FFT-cost-vs-overlap-waste trade the block axis tunes."""
+    step = block - (m - 1)
+    if step < 1:
+        return math.inf
+    per_chunk = block * math.log2(block)
+    if n is None:
+        return per_chunk / step
+    return chunk_count(n, m, block) * per_chunk
+
+
+def choose_block(m: int, n: Optional[int] = None) -> int:
+    """The analytic block choice: argmin of :func:`block_cost` over
+    the candidate ladder — the offline policy (and the seed ordering
+    of :func:`tune_block`'s race)."""
+    cands = block_candidates(m, n)
+    return min(cands, key=lambda b: block_cost(b, m, n))
+
+
+def tune_block(m: int, n: Optional[int] = None,
+               reps: int = 3, verbose: bool = False) -> int:
+    """The RACED block choice: on a tunable device, time one fused
+    chunk convolution per candidate block (the plan ladder's
+    loop-discipline timer is overkill for a whole-op race; best-of
+    `reps` wall time suffices at these sizes) and pick the lowest
+    measured per-useful-sample cost; offline, serve the analytic
+    choice — the ``tune_or_static`` policy applied to the block
+    axis.  Every candidate's fate lands in the
+    ``pifft_apps_block_race_total`` counter and, with `verbose`, on
+    stderr."""
+    from .. import plans
+
+    cands = block_candidates(m, n)
+    if len(cands) == 1 or not plans.device_is_tunable():
+        return choose_block(m, n)
+    from ..resilience import FaultKind, classify
+    from ..utils.timing import time_ms
+
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal(m).astype(np.float32)
+    best, best_cost = None, math.inf
+    for block in cands:
+        kr, ki = kernel_spectrum(k, block)
+        # _fused_circular returns the jitted (and cached) pipeline:
+        # every candidate's compiled program is reused by the serving
+        # path that follows the race
+        fused = _fused_circular("conv", block, None)
+        xp = jnp.asarray(rng.standard_normal(block).astype(np.float32))
+        try:
+            ms, _ = time_ms(fused, xp, kr, ki, reps=reps, warmup=1)
+        except Exception as e:
+            kind = classify(e)
+            if kind is FaultKind.TRANSIENT:
+                raise  # the moment failed, not the block: retry layers own it
+            metrics.inc("pifft_apps_block_race_total",
+                        block=str(block), fate="rejected")
+            plans.warn(f"block race: block={block} rejected "
+                       f"({kind.value} {type(e).__name__}: "
+                       f"{str(e)[:120]})")
+            continue
+        cost = ms * chunk_count(n, m, block) if n is not None \
+            else ms / (block - (m - 1))
+        won = cost < best_cost
+        metrics.inc("pifft_apps_block_race_total", block=str(block),
+                    fate="timed")
+        if verbose:
+            plans.warn(f"block race: block={block} {ms:.4f} ms/chunk "
+                       f"cost={cost:.6f}{' <- best' if won else ''}")
+        if won:
+            best, best_cost = block, cost
+    return best if best is not None else choose_block(m, n)
+
+
+# ------------------------------------------------------ the push API
+
+
+class OverlapSave:
+    """Streaming overlap-save convolver: push input chunks of ANY
+    size, drain full-convolution output incrementally.
+
+        conv = OverlapSave(k, block=4096)
+        for piece in arriving_signal:
+            out.append(conv.push(piece))   # maybe-empty arrays
+        out.append(conv.flush())           # the tail
+
+    ``concatenate(out) == np.convolve(signal, k, "full")``.  ONE plan
+    pair (r2c + c2r at ``block``) and one cached kernel spectrum
+    serve every chunk; per-chunk work under an obs span, per-chunk
+    traffic on the meter."""
+
+    def __init__(self, k, block: Optional[int] = None,
+                 precision: Optional[str] = None):
+        self.k = np.ascontiguousarray(np.asarray(k, np.float32))
+        if self.k.ndim != 1 or self.k.shape[0] < 1:
+            raise ValueError(f"kernel must be a non-empty 1-D array, "
+                             f"got shape {self.k.shape}")
+        self.m = self.k.shape[0]
+        self.block = int(block) if block is not None \
+            else choose_block(self.m)
+        if self.block < 2 or self.block & (self.block - 1):
+            raise ValueError(f"block={self.block} must be a power of "
+                             f"two >= 2 (the plan ladder's domain)")
+        if self.block < self.m:
+            raise ValueError(f"block={self.block} < kernel length "
+                             f"{self.m}: no valid outputs per chunk")
+        self.step = self.block - (self.m - 1)
+        self.precision = precision
+        self._kr, self._ki = kernel_spectrum(self.k, self.block,
+                                             precision)
+        self._fused = _fused_circular("conv", self.block, precision)
+        #: the saved overlap: the last m-1 input samples (zeros before
+        #: the signal starts — the textbook prefix)
+        self._tail = np.zeros(self.m - 1, np.float32)
+        self._buffer = np.zeros(0, np.float32)
+        self._consumed = 0      # input samples fully processed
+        self.chunks = 0         # fused invocations so far
+
+    def _convolve_block(self, seg: np.ndarray) -> np.ndarray:
+        """One fused circular conv of a block-length window; returns
+        the step valid output samples."""
+        with span("overlap_save_chunk",
+                  cell={"op": "conv", "n": self.block},
+                  chunk=self.chunks):
+            y = self._fused(jnp.asarray(seg), self._kr, self._ki)
+            metrics.inc("pifft_apps_ops_total", op="conv")
+            charge_spectral_traffic("conv", self.block)
+        self.chunks += 1
+        return np.asarray(y)[self.m - 1:]
+
+    def push(self, chunk) -> np.ndarray:
+        """Feed more signal; returns every output sample that is now
+        final (possibly empty).  Outputs arrive in order; sample i of
+        the concatenated stream is ``np.convolve(x, k, 'full')[i]``."""
+        chunk = np.asarray(chunk, np.float32).reshape(-1)
+        self._buffer = np.concatenate([self._buffer, chunk])
+        out = []
+        while self._buffer.shape[0] >= self.step:
+            head, self._buffer = (self._buffer[:self.step],
+                                  self._buffer[self.step:])
+            seg = np.concatenate([self._tail, head])
+            out.append(self._convolve_block(seg))
+            self._tail = seg[self.step:]
+            self._consumed += self.step
+        return np.concatenate(out) if out \
+            else np.zeros(0, np.float32)
+
+    def flush(self) -> np.ndarray:
+        """Close the stream: convolve the zero-padded remainder and
+        return the final output samples (the convolution tail).  The
+        convolver is spent afterwards."""
+        pending = self._buffer.shape[0]
+        # total output owed is n + m - 1; push emitted one sample per
+        # consumed input sample, so the tail owes the rest
+        want = pending + self.m - 1
+        out = []
+        emitted = 0
+        while emitted < want:
+            head = np.zeros(self.step, np.float32)
+            head[:self._buffer.shape[0]] = self._buffer
+            self._buffer = np.zeros(0, np.float32)
+            seg = np.concatenate([self._tail, head])
+            out.append(self._convolve_block(seg))
+            self._tail = seg[self.step:]
+            emitted += self.step
+        y = np.concatenate(out) if out else np.zeros(0, np.float32)
+        return y[:want]
+
+
+def overlap_save_stream(chunks: Iterable, k,
+                        block: Optional[int] = None,
+                        precision: Optional[str] = None):
+    """Generator form of :class:`OverlapSave`: yields maybe-empty
+    output arrays as input chunks arrive, then the tail — the shape a
+    served streaming op drains incrementally."""
+    conv = OverlapSave(k, block=block, precision=precision)
+    for chunk in chunks:
+        y = conv.push(chunk)
+        if y.size:
+            yield y
+    tail = conv.flush()
+    if tail.size:
+        yield tail
+
+
+# ------------------------------------------------------ the eager API
+
+
+def overlap_save(x, k, block: Optional[int] = None,
+                 precision: Optional[str] = None) -> np.ndarray:
+    """``np.convolve(x, k, "full")`` for arbitrary signal lengths via
+    overlap-save block convolution: ONE cached plan pair at `block`
+    serves every chunk (block defaults to the analytic
+    :func:`choose_block` choice)."""
+    x = np.asarray(x, np.float32).reshape(-1)
+    conv = OverlapSave(k, block=block, precision=precision)
+    head = conv.push(x)
+    tail = conv.flush()
+    return np.concatenate([head, tail])
+
+
+def overlap_add(x, k, block: Optional[int] = None,
+                precision: Optional[str] = None) -> np.ndarray:
+    """``np.convolve(x, k, "full")`` via overlap-ADD: disjoint
+    L-sample chunks each convolved to L+m-1 outputs (zero-padded into
+    one block-length fused circular conv), overhangs summed.  Same
+    plan reuse, different stitching — the pair every DSP text
+    teaches, both offered so the bench can race them."""
+    x = np.asarray(x, np.float32).reshape(-1)
+    k = np.ascontiguousarray(np.asarray(k, np.float32))
+    m = k.shape[0]
+    block = int(block) if block is not None else choose_block(m)
+    if block < 2 or block & (block - 1):
+        raise ValueError(f"block={block} must be a power of two >= 2")
+    step = block - (m - 1)
+    if step < 1:
+        raise ValueError(f"block={block} < kernel length {m}")
+    n = x.shape[0]
+    kr, ki = kernel_spectrum(k, block, precision)
+    fused = _fused_circular("conv", block, precision)
+    y = np.zeros(n + m - 1, np.float32)
+    for start in range(0, max(n, 1), step):
+        seg = np.zeros(block, np.float32)
+        piece = x[start:start + step]
+        seg[:piece.shape[0]] = piece
+        with span("overlap_add_chunk", cell={"op": "conv", "n": block},
+                  chunk=start // step):
+            yc = np.asarray(fused(jnp.asarray(seg), kr, ki))
+            metrics.inc("pifft_apps_ops_total", op="conv")
+            charge_spectral_traffic("conv", block)
+        hi = min(start + block, y.shape[0])
+        y[start:hi] += yc[:hi - start]
+    return y
+
+
+# -------------------------------------------------- journaled resume
+
+
+def overlap_save_journaled(x, k, journal_path: str,
+                           block: Optional[int] = None,
+                           precision: Optional[str] = None) -> tuple:
+    """Kill-safe overlap-save: each chunk's valid outputs are
+    checkpointed to the resilience journal (atomic fsynced JSONL —
+    docs/RESILIENCE.md) before the next chunk runs, and a re-run with
+    the same journal resumes at the first chunk the kill took —
+    recomputing ONLY those, byte-identical for the rest.  The journal
+    is configuration-guarded (``Journal.guard_config``): resuming
+    with a different signal/kernel/block refuses instead of splicing.
+
+    Returns ``(y, computed_chunks)`` — the full convolution and how
+    many chunks actually ran this time (a clean resume of a finished
+    journal computes zero)."""
+    from ..resilience.journal import Journal
+
+    from .spectral import _kernel_hash
+
+    x = np.asarray(x, np.float32).reshape(-1)
+    k = np.ascontiguousarray(np.asarray(k, np.float32))
+    m = k.shape[0]
+    block = int(block) if block is not None else choose_block(m, x.shape[0])
+    conv = OverlapSave(k, block=block, precision=precision)
+    total = chunk_count(x.shape[0], m, block)
+    journal = Journal(journal_path)
+    # the kernel HASH rides the guard: a resume with a different
+    # same-length kernel must refuse, not splice mixed-kernel chunks
+    journal.guard_config(
+        {"n": int(x.shape[0]), "m": int(m), "block": int(block),
+         "kernel": _kernel_hash(k),
+         "x_sum": float(np.float32(x.sum()))},
+        label="overlap-save")
+    xp = np.concatenate([x, np.zeros(total * conv.step - x.shape[0],
+                                     np.float32)])
+    pieces, computed = [], 0
+    for i in range(total):
+        cell = f"os:{i}"
+        rec = journal.get(cell)
+        head = xp[i * conv.step:(i + 1) * conv.step]
+        if rec is not None:
+            pieces.append(np.asarray(rec["y"], np.float32))
+            # the overlap memory must advance even over skipped
+            # chunks, so the first recomputed chunk sees the right
+            # saved samples
+            seg = np.concatenate([conv._tail, head])
+            conv._tail = seg[conv.step:]
+            continue
+        y = conv._convolve_block(np.concatenate([conv._tail, head]))
+        conv._tail = np.concatenate([conv._tail, head])[conv.step:]
+        journal.record(cell, {"y": [float(v) for v in y]})
+        pieces.append(y)
+        computed += 1
+    y = np.concatenate(pieces)[: x.shape[0] + m - 1]
+    return y, computed

@@ -557,8 +557,10 @@ class BareCollectiveCall(Rule):
         # an INCLUDE list like PIF107's: the collective funnel is the
         # parallel package's discipline (kernel/model code never
         # dispatches collectives; if it starts to, widening this list
-        # is the fix, not silence)
-        "paths": ("*/parallel/*",),
+        # is the fix, not silence).  apps/ is in scope since the
+        # spectral solver family (apps/pde.py) took over the sharded
+        # slab pipeline — its transposes ride the same funnel
+        "paths": ("*/parallel/*", "*/apps/*"),
         # the funnel itself is the one sanctioned call site
         "exempt": ("*parallel/collectives.py",),
         "collectives": ("jax.lax.all_to_all", "jax.lax.psum",

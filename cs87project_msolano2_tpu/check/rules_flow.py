@@ -773,6 +773,166 @@ class UnpairedResource(Rule):
         return False
 
 
+# ================================== PIF116 host round trip between transforms
+
+
+@register
+class HostRoundTripBetweenTransforms(Rule):
+    id = "PIF116"
+    name = "host-round-trip-between-transforms"
+    summary = ("flow: a forward-transform result reaches a host "
+               "(numpy) call before the paired inverse on some path — "
+               "the half-spectrum round-trips through host between "
+               "the transforms")
+    invariant = ("the fused spectral ops (docs/APPS.md) exist so the "
+                 "half-spectrum intermediate of rfft -> multiply -> "
+                 "irfft stays ON DEVICE: one np.asarray between the "
+                 "paired transforms forfeits exactly the bytes-halving "
+                 "PRs 10-11 fought for, at serving rates, invisibly — "
+                 "the answer stays right, the traffic doubles.  A "
+                 "variable bound from a forward transform (rfft-family "
+                 "call, or .execute/.fn on a receiver whose name "
+                 "declares the forward direction) that is consumed by "
+                 "a resolved numpy.* call on a path from which a "
+                 "paired inverse is still reachable is the round trip; "
+                 "host math AFTER the inverse (materializing results "
+                 "for clients) is fine, and declared host-side "
+                 "reference/oracle functions are exempt — being host "
+                 "is their whole point.  The `make apps-smoke` meter "
+                 "gate catches the traffic dynamically; this rule "
+                 "catches the code shape statically")
+    default_config = {
+        "paths": ("*/apps/*", "*/serve/*"),
+        # name-form forward/inverse vocabulary (matched on the last
+        # segment of the import-map-resolved call target, so aliasing
+        # and numpy's own rfft both count)
+        "forward_calls": ("rfft", "rfft_planes_fast"),
+        "inverse_calls": ("irfft", "irfft_planes_fast", "ifft"),
+        # method-form vocabulary: plan-executor calls whose receiver
+        # name declares the direction (the apps idiom: fwd.fn /
+        # rfft_plan.execute vs inv.fn / c2r_plan.execute)
+        "methods": ("execute", "fn"),
+        "forward_recv_globs": ("*rfft*", "*fwd*", "*r2c*"),
+        "inverse_recv_globs": ("*irfft*", "*inv*", "*c2r*"),
+        # the host vocabulary: resolved call targets that force the
+        # value onto the host
+        "host_call_globs": ("numpy.*",),
+        # declared host-side reference functions: a numpy oracle IS
+        # host math end to end, by design
+        "exempt_defs": ("*oracle*", "*reference*"),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        if not _in_scope(ctx, config):
+            return
+        for fn in flow.function_defs(ctx.tree):
+            if _matches(fn.name, config["exempt_defs"]):
+                continue
+            yield from self._check_fn(ctx, fn, config)
+
+    # -- vocabulary matching
+
+    def _call_kind(self, ctx, call: ast.Call,
+                   config: dict) -> Optional[str]:
+        """"forward" / "inverse" / "host" / None for one call."""
+        target = ctx.resolve_call(call)
+        last = _last_segment(target) if target else ""
+        if last in config["forward_calls"]:
+            return "forward"
+        if last in config["inverse_calls"]:
+            return "inverse"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in config["methods"]:
+            recv = dotted_name(call.func.value) or ""
+            recv_last = _last_segment(recv)
+            if _matches(recv_last, config["forward_recv_globs"]):
+                return "forward"
+            if _matches(recv_last, config["inverse_recv_globs"]):
+                return "inverse"
+        if target and _matches(target, config["host_call_globs"]):
+            return "host"
+        return None
+
+    def _check_fn(self, ctx, fn, config) -> Iterator:
+        # cheap pre-scan: a function with no forward-transform call
+        # has nothing to round-trip
+        has_forward = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and self._call_kind(ctx, node, config) == "forward":
+                has_forward = True
+                break
+        if not has_forward:
+            return
+        cfg = flow.build_cfg(fn)
+
+        # pass 1: spectrum variables — names bound from a forward call
+        spectrum: set = set()
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub in flow.shallow_walk(root):
+                    if not (isinstance(sub, ast.Assign)
+                            and isinstance(sub.value, ast.Call)
+                            and self._call_kind(ctx, sub.value, config)
+                            == "forward"):
+                        continue
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            spectrum.add(t.id)
+                        elif isinstance(t, ast.Tuple):
+                            spectrum.update(e.id for e in t.elts
+                                            if isinstance(e, ast.Name))
+        if not spectrum:
+            return
+
+        # pass 2: host uses of spectrum vars, and inverse sites
+        host_uses: list = []    # (node_idx, call, var)
+        inverse_nodes: set = set()
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub in flow.shallow_walk(root):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    kind = self._call_kind(ctx, sub, config)
+                    if kind == "inverse":
+                        inverse_nodes.add(node.idx)
+                    elif kind == "host":
+                        args = list(sub.args) \
+                            + [kw.value for kw in sub.keywords]
+                        for arg in args:
+                            hit = next(
+                                (v.id for v in ast.walk(arg)
+                                 if isinstance(v, ast.Name)
+                                 and v.id in spectrum), None)
+                            if hit is not None:
+                                host_uses.append((node.idx, sub, hit))
+                                break
+        if not host_uses or not inverse_nodes:
+            return
+        for idx, call, var in host_uses:
+            if idx in inverse_nodes:
+                # the host call feeds the inverse on the same
+                # statement (an oracle-style one-liner): the spectrum
+                # is consumed, not round-tripped past the pairing
+                continue
+            onward = cfg.reachable(idx)
+            if inverse_nodes & onward:
+                yield self.finding(
+                    ctx, call,
+                    f"forward-transform result `{var}` reaches the "
+                    f"host here while the paired inverse is still "
+                    f"ahead on this path — the half-spectrum "
+                    f"round-trips through host between the "
+                    f"transforms, forfeiting the fused pipeline's "
+                    f"traffic win (docs/APPS.md); keep the pointwise "
+                    f"work on device, or noqa with a reason if the "
+                    f"round trip is the point")
+
+
 # ================================================ PIF115 untagged demotion
 
 

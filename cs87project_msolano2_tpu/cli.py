@@ -13,6 +13,8 @@
                                          [files ...] [--json]
     python -m cs87project_msolano2_tpu serve [--smoke | --host H --port P]
                                          [--shapes FILE] [...]
+    python -m cs87project_msolano2_tpu apps {conv | corr | solve}
+                                         [--smoke] [-n N]
     python -m cs87project_msolano2_tpu multichip smoke [-n N]
                                          [--deadline S] [--stall S]
 
@@ -65,6 +67,12 @@ batched kernel invocations over bounded backpressured queues, warmed
 from a served shape set (`--shapes`, the same JSONL `plan warm
 --shapes` takes) — a socket front by default, `--smoke` for the
 in-process CI gate (`make serve-smoke`).
+
+The `apps` subcommand fronts the spectral operation suite
+(docs/APPS.md): fused spectral convolution/correlation, streaming
+overlap-save, and the spectral PDE family, with `--smoke` the
+per-op `make apps-smoke` CI gate (oracle parity, the metered fusion
+gate, a served op-tagged socket round trip).
 
 The `multichip` subcommand fronts the self-healing multichip layer
 (docs/MULTICHIP.md): `smoke` injects a stall into a supervised
@@ -563,6 +571,10 @@ def main(argv=None) -> int:
         from .serve.cli import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "apps":
+        from .apps.cli import apps_main
+
+        return apps_main(argv[1:])
     if argv and argv[0] == "check":
         from .check.cli import main as check_main
 

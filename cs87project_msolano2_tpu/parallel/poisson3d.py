@@ -1,111 +1,53 @@
 """3-D spectral Poisson solver with slab decomposition (BASELINE.json
 config 5): solve lap(u) = f on a periodic [0, 2*pi)^3 grid.
 
-Slabs are sharded along axis 0.  Per slab: local FFT over axes 1-2, one
-all_to_all transpose to localize axis 0, FFT over axis 0, multiply by
--1/|k|^2 (zero mode -> 0: the mean-free solution), then invert the
-pipeline.  Two ICI transposes per solve — the textbook slab pattern —
-both dispatched through the sanctioned ``parallel.collectives`` funnel
-(PIF108); :func:`poisson_solve_sharded_resilient` adds the
-supervision/consensus/escape recovery loop (docs/MULTICHIP.md).
+THIN SHIM (docs/APPS.md): the slab pipeline — per-slab local FFTs
+over axes 1-2, one all_to_all transpose to localize axis 0, the
+axis-0 FFT, a real spectral multiplier, the inverted pipeline — now
+lives in :mod:`..apps.pde` as :func:`~..apps.pde.solve_spectral_sharded`,
+parameterized by its multiplier so Poisson, Helmholtz, and the
+spectral time-stepper are ONE code path.  This module keeps the
+Poisson names (and the private helpers ``parallel/escape.py``'s
+collective-free replay imports) bound to the family with the Poisson
+symbol — same plan keys, same multiplier expression, bit-identical
+results; existing callers and tests are untouched.
 
-All spectral arithmetic runs on split re/im float32 planes: the
-multiplier is real, so the whole pipeline is float ops — TPU-native and
-loop-compatible (the axon relay cannot lower complex in While bodies).
-
-Kernel dispatch: every axis pass transforms a different per-shard shape
-((n1/p, n2) rows of n3, (n1/p, n3) rows of n2, (n2/p, n3) rows of n1…),
-and each fetches the plan for ITS shape's key — no shared module-level
-tile/cb defaults.
+:func:`poisson_solve_sharded_resilient` still adds the supervision/
+consensus/escape recovery loop (docs/MULTICHIP.md).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import PartitionSpec as P
-
-from .. import plans
-from ..utils.compat import shard_map
-from .collectives import all_to_all as _a2a
 
 
-def _wavenumbers(m: int) -> np.ndarray:
-    """Integer wavenumbers for an m-point periodic axis (fftfreq * m)."""
-    k = np.arange(m)
-    k[k > m // 2] -= m
-    return k.astype(np.float32)
+def _wavenumbers(m: int):
+    """Integer wavenumbers for an m-point periodic axis (fftfreq * m)
+    — re-exported from the family (escape.py's replay imports it
+    here)."""
+    from ..apps.pde import wavenumbers
+
+    return wavenumbers(m)
 
 
 def _fft_axis(vr, vi, ax: int, inverse: bool):
-    vr = jnp.moveaxis(vr, ax, -1)
-    vi = jnp.moveaxis(vi, ax, -1)
-    plan = plans.plan_for(vr.shape)
-    if inverse:
-        yr, yi = plan.execute_inverse(vr, vi)
-    else:
-        yr, yi = plan.execute(vr, vi)
-    return jnp.moveaxis(yr, -1, ax), jnp.moveaxis(yi, -1, ax)
+    """One planned FFT pass over `ax` — the family's per-axis-shape
+    dispatch (escape.py's replay imports it here)."""
+    from ..apps.pde import fft_axis
+
+    return fft_axis(vr, vi, ax, inverse)
 
 
 def poisson_solve_sharded(f, mesh, axis: str = "p"):
-    """u with lap(u) = f, zero-mean; f real (n1, n2, n3) sharded on axis 0.
+    """u with lap(u) = f, zero-mean; f real (n1, n2, n3) sharded on
+    axis 0.  Returns real u, same sharding.  n1 and n2 must be
+    divisible by the mesh axis size.  Dispatches through the spectral
+    solver family (apps/pde.py) with the Poisson multiplier — the
+    identical dataflow this module used to own."""
+    from ..apps.pde import poisson_multiplier, solve_spectral_sharded
 
-    Returns real u, same sharding.  n1 and n2 must be divisible by the
-    mesh axis size.
-    """
-    p = mesh.shape[axis]
-    n1, n2, n3 = f.shape
-    k1 = _wavenumbers(n1)
-    k2 = _wavenumbers(n2)
-    k3 = _wavenumbers(n3)
-
-    def a2a(v, split_axis, concat_axis):
-        return _a2a(v, axis, split_axis, concat_axis)
-
-    def device_fn(fb):  # (n1/p, n2, n3) real
-        gr, gi = fb, jnp.zeros_like(fb)
-        gr, gi = _fft_axis(gr, gi, 2, False)
-        gr, gi = _fft_axis(gr, gi, 1, False)
-        # localize axis 0: (n1/p, n2, n3) -> (n1, n2/p, n3)
-        gr, gi = a2a(gr, 1, 0), a2a(gi, 1, 0)
-        gr, gi = _fft_axis(gr, gi, 0, False)
-
-        # spectral inverse Laplacian on the (n1, n2/p, n3) block —
-        # a REAL multiplier, so planes never recombine
-        i = jax.lax.axis_index(axis)
-        k2_loc = jax.lax.dynamic_slice_in_dim(
-            jnp.asarray(k2), i * (n2 // p), n2 // p
-        )
-        ksq = (
-            jnp.asarray(k1)[:, None, None] ** 2
-            + k2_loc[None, :, None] ** 2
-            + jnp.asarray(k3)[None, None, :] ** 2
-        )
-        inv = jnp.where(ksq > 0, -1.0 / jnp.maximum(ksq, 1e-30), 0.0)
-        gr, gi = gr * inv, gi * inv
-
-        gr, gi = _fft_axis(gr, gi, 0, True)
-        gr, gi = a2a(gr, 0, 1), a2a(gi, 0, 1)
-        gr, gi = _fft_axis(gr, gi, 1, True)
-        gr, gi = _fft_axis(gr, gi, 2, True)
-        return gr
-
-    fn = shard_map(
-        device_fn, mesh=mesh, in_specs=(P(axis, None, None),),
-        out_specs=P(axis, None, None),
-        # check=False (vma checking off): the Pallas HLO interpreter
-        # (CPU test path) cannot carry varying-manual-axes through its
-        # grid while-loop (jax hlo_interpreter.py; the error text itself
-        # prescribes this workaround).  With the checker off HERE, the
-        # kernels' vma declarations (_out_struct/_pvary_like in ops) are
-        # inert on this entry point — they exist to keep EXTERNAL
-        # check_vma=True embeddings of these kernels working, not to
-        # protect this path.
-        check=False,
-    )
-    return fn(f)
+    return solve_spectral_sharded(f, mesh, axis, poisson_multiplier)
 
 
 def poisson_solve_sharded_resilient(f, mesh, axis: str = "p",

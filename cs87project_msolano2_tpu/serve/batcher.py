@@ -52,21 +52,26 @@ SERVE_FALLBACK_RUNGS = ("jnp-fft", "numpy-ref")
 @dataclasses.dataclass(frozen=True)
 class GroupKey:
     """The coalescing identity: requests may share a kernel invocation
-    iff they share all five fields.  ``domain`` separates the
+    iff they share all six fields.  ``domain`` separates the
     half-spectrum real paths (r2c/c2r — docs/REAL.md) from c2c at the
     same n: an r2c group's coalesced invocation runs the HALF-WIDTH
     packed kernel, so mixing the domains would stage the wrong
-    planes."""
+    planes.  ``op`` is the served OPERATION (docs/APPS.md): "fft" is
+    the bare transform; "conv"/"corr"/"solve" groups coalesce into
+    one batched FUSED spectral pipeline (apps/spectral.py) — mixing
+    ops would multiply the wrong spectra."""
 
     n: int
     layout: str = "natural"
     precision: str = "split3"
     inverse: bool = False
     domain: str = "c2c"
+    op: str = "fft"
 
     def label(self) -> str:
         d = ":inv" if self.inverse else ""
         d += f":{self.domain}" if self.domain != "c2c" else ""
+        d += f":{self.op}" if self.op != "fft" else ""
         return f"{self.n}:{self.layout}:{self.precision}{d}"
 
     def input_width(self) -> int:
@@ -147,13 +152,30 @@ class BatchRunner:
         key.  Direction is applied OUTSIDE the forward/rung choice: an
         inverse group stays an inverse on every rung (a fallback that
         quietly served the forward transform would be a wrong answer
-        tagged merely degraded)."""
+        tagged merely degraded).
+
+        Op-tagged groups (docs/APPS.md) serve the batched FUSED
+        spectral pipeline from apps/spectral.py — and their rungs
+        speak the OP natively (a jnp/numpy fallback that served a
+        bare transform instead of the convolution would be a wrong
+        answer merely tagged degraded), exactly like the inverse
+        rule above."""
         import jax
 
         ck = (group, bucket, rung)
         hit = self._callables.get(ck)
         if hit is not None:
             return hit
+        if group.op != "fft":
+            from ..apps.spectral import op_executor
+
+            run, plan = op_executor(group.op, (bucket,), group.n,
+                                    precision=group.precision,
+                                    rung=rung)
+            donate = (0, 1) if plans.device_is_tunable() else ()
+            fn = jax.jit(run, donate_argnums=donate)
+            self._callables[ck] = (fn, plan)
+            return fn, plan
         plan = self._plan_for(group, bucket)
         forward = build_rung(plan.key, rung) if rung is not None \
             else plan.fn
@@ -204,7 +226,8 @@ class BatchRunner:
             degrade.append(f"overload:{rung}")
         try:
             with span("serve_batch", cell={"n": group.n, "size": size},
-                      bucket=bucket, rung=rung or "plan") as sp:
+                      bucket=bucket, rung=rung or "plan",
+                      op=group.op) as sp:
                 outcome = self._invoke(group, bucket, rung, sxr, sxi,
                                        degrade)
                 if rung is None and planes:
@@ -232,6 +255,14 @@ class BatchRunner:
         metrics.inc("pifft_serve_batches_total", shape=group.label())
         metrics.inc("pifft_serve_batched_requests_total", value=size,
                     shape=group.label())
+        # per-OP accounting (docs/APPS.md): how much of the served
+        # traffic is operations vs bare transforms, and the fused-op
+        # traffic the batch moved on the shared meter
+        metrics.inc("pifft_serve_ops_total", value=size, op=group.op)
+        if group.op != "fft":
+            from ..utils.roofline import charge_spectral_traffic
+
+            charge_spectral_traffic(group.op, group.n, count=size)
         metrics.observe("pifft_serve_batch_size", size,
                         shape=group.label())
         return outcome
@@ -242,9 +273,16 @@ class BatchRunner:
     def _reference(group: GroupKey, sample):
         """(ref_r, ref_i) float64 oracle planes for one request of this
         group, in the group's own layout — or None for combinations
-        with no cheap oracle (inverse real domains)."""
+        with no cheap oracle (inverse real domains).  Op-tagged groups
+        (docs/APPS.md) verify against their OP's numpy oracle — the
+        circular conv/corr/solve pipeline, not a bare transform."""
         xr = np.asarray(sample[0], dtype=np.float64)
         xi = np.asarray(sample[1], dtype=np.float64)
+        if group.op != "fft":
+            from ..apps.spectral import numpy_oracle
+
+            y = numpy_oracle(group.op, xr, xi, group.n)
+            return y, np.zeros_like(y)
         if group.domain == "r2c":
             if group.inverse:
                 return None
@@ -275,6 +313,16 @@ class BatchRunner:
 
         xr = np.asarray(sample[0])[None, :]
         xi = np.asarray(sample[1])[None, :]
+        if group.op != "fft":
+            # the fused op pipeline at the promoted mode, through the
+            # CACHED jitted bucket-1 callable (the promotion loop
+            # dropped the stale entry, so this rebuild reads the
+            # forward plan's promoted effective precision — and later
+            # samples reuse the compiled program)
+            fn, _plan = self._callable(group, 1, None)
+            yr, yi = fn(xr, xi)
+            return prec_mod.rel_err(np.asarray(yr)[0],
+                                    np.asarray(yi)[0], ref[0], ref[1])
         if group.inverse:
             yr, yi = plan.fn(xr, -xi)  # the conj trick (plans.core)
             got_r = np.asarray(yr)[0] / np.float32(group.n)
@@ -320,7 +368,13 @@ class BatchRunner:
         got_i = np.asarray(outcome.yi)[0]
         err = prec_mod.rel_err(got_r, got_i, ref[0], ref[1])
         mode = plan.effective_precision()
-        budget = prec_mod.error_budget(mode)
+        # an op group's fused pipeline composes TWO transforms
+        # (rfft + irfft), so its roundoff is ~2x a bare transform's:
+        # the budget scales with the pipeline depth — otherwise a
+        # healthy split3 conv flaps at the single-transform bound
+        # (docs/APPS.md)
+        op_scale = 2.0 if group.op != "fft" else 1.0
+        budget = prec_mod.error_budget(mode) * op_scale
         metrics.set_gauge("pifft_precision_rel_err", err,
                           shape=group.label(), mode=mode)
         promoted = False
@@ -333,11 +387,13 @@ class BatchRunner:
             outcome.degrade.append(f"precision:{nxt}")
             # the jitted callable bakes the old executor: drop it so
             # the recompute below (and this group's next batch) builds
-            # at the promoted mode
+            # at the promoted mode — the bucket-1 sampling callable
+            # included, or _sample_err would measure the stale mode
             self._callables.pop(ck, None)
+            self._callables.pop((group, 1, None), None)
             err = self._sample_err(plan, group, sample, ref)
             mode = nxt
-            budget = prec_mod.error_budget(mode)
+            budget = prec_mod.error_budget(mode) * op_scale
             metrics.set_gauge("pifft_precision_rel_err", err,
                               shape=group.label(), mode=mode)
         if promoted:

@@ -181,9 +181,17 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
     rng = np.random.default_rng(0)
 
     def planes_for(spec):
-        """(xr, xi) request planes for one spec's domain: both planes
-        for c2c, a real signal + zeros for r2c, half-spectrum bins
-        for c2r (docs/REAL.md)."""
+        """(xr, xi) request planes for one spec's domain AND op: both
+        planes for c2c, a real signal + zeros for r2c, half-spectrum
+        bins for c2r (docs/REAL.md); op specs send their operands —
+        signal + kernel for conv/corr, the field for solve
+        (docs/APPS.md)."""
+        if spec.op in ("conv", "corr"):
+            return (rng.standard_normal(spec.n).astype(np.float32),
+                    rng.standard_normal(spec.n).astype(np.float32))
+        if spec.op == "solve":
+            xr = rng.standard_normal(spec.n).astype(np.float32)
+            return xr, np.zeros_like(xr)
         if spec.domain == "c2r":
             spec_ref = np.fft.rfft(
                 rng.standard_normal(spec.n).astype(np.float64))
@@ -196,10 +204,24 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
 
     def check_response(spec, xr, xi, resp):
         """Problem string, or None: natural-layout responses verify
-        against the numpy oracle of their DOMAIN, and half-spectrum
-        responses must actually be half-width (a full-width r2c
-        answer means the packed path never ran)."""
+        against the numpy oracle of their DOMAIN (and OP — an
+        op-tagged shape verifies the fused pipeline, docs/APPS.md),
+        and half-spectrum responses must actually be half-width (a
+        full-width r2c answer means the packed path never ran)."""
         if spec.layout != "natural":
+            return None
+        if spec.op != "fft":
+            from ..apps.spectral import numpy_oracle
+            from ..ops.precision import error_budget
+
+            ref = numpy_oracle(spec.op, xr.astype(np.float64),
+                               xi.astype(np.float64), spec.n)
+            err = verify.rel_err(np.asarray(resp.yr, np.float64), ref)
+            tol = max(1e-4, error_budget(spec.precision))
+            if err > tol:
+                return (f"response {resp.rid} wrong: rel err "
+                        f"{err:.3e} > {tol:.0e} vs numpy {spec.op} "
+                        f"oracle ({spec.precision} budget)")
             return None
         got = np.asarray(resp.yr) + 1j * np.asarray(resp.yi)
         if spec.domain == "r2c":
@@ -236,10 +258,11 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
         async with Dispatcher(cfg, specs) as d:
             calls = [d.submit(xr, xi, layout=burst.layout,
                               precision=burst.precision,
-                              domain=burst.domain)
+                              domain=burst.domain, op=burst.op)
                      for xr, xi in inputs]
             calls += [d.submit(xr, xi, layout=s.layout,
-                               precision=s.precision, domain=s.domain)
+                               precision=s.precision, domain=s.domain,
+                               op=s.op)
                       for s, xr, xi in mixed]
             responses = await asyncio.gather(*calls)
             return d, responses
@@ -264,7 +287,7 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
 
     label = GroupKey(n=burst.n, layout=burst.layout,
                      precision=burst.precision,
-                     domain=burst.domain).label()
+                     domain=burst.domain, op=burst.op).label()
     reqs = int(metrics.counter_value("pifft_serve_requests_total",
                                      shape=label))
     batches = int(metrics.counter_value("pifft_serve_batches_total",
@@ -360,7 +383,7 @@ def _mesh_smoke(cfg: ServeConfig, specs, args) -> int:
                 resp = await mesh.submit(
                     xr, xi, layout=specs[0].layout,
                     precision=specs[0].precision,
-                    domain=specs[0].domain)
+                    domain=specs[0].domain, op=specs[0].op)
                 if resp.device != home.id:
                     problems.append(
                         f"affinity broken: warmed {g0.label()} served "
@@ -440,10 +463,11 @@ def _mesh_smoke(cfg: ServeConfig, specs, args) -> int:
             spec = next(s for s in specs
                         if _group_for(s) == drain_group)
             dxr = rng.standard_normal(spec.n).astype(np.float32)
-            dxi = rng.standard_normal(spec.n).astype(np.float32)
+            dxi = np.zeros_like(dxr) if spec.op == "solve" \
+                else rng.standard_normal(spec.n).astype(np.float32)
             resp = await mesh.submit(dxr, dxi, layout=spec.layout,
                                      precision=spec.precision,
-                                     domain=spec.domain)
+                                     domain=spec.domain, op=spec.op)
             want = successors.get(drain_group.label())
             if resp.device != want:
                 problems.append(
@@ -455,7 +479,7 @@ def _mesh_smoke(cfg: ServeConfig, specs, args) -> int:
                     f"a planned drain must not cost quality")
             problem = verify_response(spec.n, spec.layout, spec.domain,
                                       False, spec.precision, dxr, dxi,
-                                      resp)
+                                      resp, op=spec.op)
             if problem:
                 problems.append(f"post-drain {problem}")
             return report, drain_report, mesh.utilization(), victim_id

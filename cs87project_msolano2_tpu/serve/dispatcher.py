@@ -56,6 +56,7 @@ import numpy as np
 from ..obs import events, metrics
 from ..obs.spans import clock
 from ..resilience import classify
+from ..utils.roofline import SPECTRAL_OPS as OPS
 from . import shapes as shapes_mod
 from .batcher import BatchRunner, GroupKey
 from .buffers import BufferPool
@@ -216,7 +217,8 @@ class Dispatcher:
         self._ema_ms: dict = {}
         self._rid = itertools.count()
         self._closing = False
-        self._served = {(s.n, s.layout, s.precision, s.domain)
+        self._served = {(s.n, s.layout, s.precision, s.domain,
+                         getattr(s, "op", "fft"))
                         for s in self.specs}
 
     # ----------------------------------------------------- lifecycle
@@ -272,18 +274,60 @@ class Dispatcher:
     # ----------------------------------------------------- admission
 
     def _validated(self, xr, xi, layout: str, precision: Optional[str],
-                   inverse: bool, domain: str, priority: str) -> tuple:
+                   inverse: bool, domain: str, priority: str,
+                   op: str = "fft") -> tuple:
         """Shared request validation (single-device and mesh
         dispatchers): returns ``(xr, xi, group)`` float32 planes plus
         the coalescing key, or raises a structured
-        :class:`ServeError`."""
+        :class:`ServeError`.
+
+        Op-tagged requests (``op`` in "conv"/"corr"/"solve" —
+        docs/APPS.md) are REAL-input operations on the half-spectrum
+        path: the planes are the op's operands (signal + kernel for
+        conv/corr, the field for solve), the group is keyed
+        ``domain="r2c"``, and the served semantics are CIRCULAR at
+        the group's n (linear semantics pad client-side or through
+        apps.fftconv)."""
         from ..plans.core import DOMAINS
 
+        if op not in OPS:
+            raise ServeError(f"op={op!r} not in {OPS} (docs/APPS.md)")
         if domain not in DOMAINS:
             raise ServeError(f"domain={domain!r} not in {DOMAINS}")
         if priority not in PRIORITIES:
             raise ServeError(f"priority={priority!r} not in {PRIORITIES}")
         xr = np.asarray(xr, np.float32)
+        if op != "fft":
+            if inverse:
+                raise ServeError(f"op={op!r} has no inverse form; the "
+                                 f"op already pairs its transforms")
+            if layout != "natural":
+                raise ServeError(f"op={op!r} requires natural layout "
+                                 f"(the half-spectrum has no pi order)")
+            if domain not in ("c2c", "r2c"):
+                raise ServeError(f"op={op!r} rides the half-spectrum "
+                                 f"forward path; domain={domain!r} "
+                                 f"does not apply")
+            if op in ("conv", "corr"):
+                if xi is None:
+                    raise ServeError(f"op={op!r} needs the kernel "
+                                     f"plane in xi (signal in xr)")
+            elif xi is not None and np.any(np.asarray(xi)):
+                raise ServeError("op='solve' takes one real field in "
+                                 "xr — a nonzero xi would be silently "
+                                 "dropped; send zeros or omit it")
+            xi = np.zeros_like(xr) if xi is None \
+                else np.asarray(xi, np.float32)
+            if xr.ndim != 1 or xr.shape != xi.shape:
+                raise ServeError(f"request planes must be matching 1-D "
+                                 f"arrays, got {xr.shape} / {xi.shape}")
+            n = xr.shape[0]
+            if n < 2 or n & (n - 1):
+                raise ServeError(f"n={n} is not a power of two >= 2")
+            group = GroupKey(n=n, layout=layout,
+                             precision=precision or "split3",
+                             inverse=False, domain="r2c", op=op)
+            return xr, xi, group
         if xi is None:
             if domain != "r2c":
                 raise ServeError(f"domain={domain!r} requests need both "
@@ -329,7 +373,7 @@ class Dispatcher:
         """Strict-shape refusal (shared with the mesh dispatcher)."""
         if self.config.strict_shapes and \
                 (group.n, group.layout, group.precision,
-                 group.domain) not in self._served:
+                 group.domain, group.op) not in self._served:
             raise ShapeNotServed(
                 f"shape {group.label()} is not in the warmed set "
                 f"({len(self.specs)} shape(s)); add it to the shape "
@@ -365,7 +409,8 @@ class Dispatcher:
                      inverse: bool = False,
                      domain: str = "c2c",
                      priority: str = "normal",
-                     tenant: str = "default") -> Response:
+                     tenant: str = "default",
+                     op: str = "fft") -> Response:
         """Serve one n-point transform of float planes ``(n,)``.
         Raises a :class:`ServeError` subclass — never hangs — when the
         request cannot be admitted or no rung could serve it.
@@ -379,6 +424,14 @@ class Dispatcher:
         inverse: the planes carry the n//2+1 half-spectrum bins and
         the response is the length-n real signal).
 
+        `op` picks the served OPERATION (docs/APPS.md): "fft" (the
+        bare transform, default), or the fused spectral ops "conv" /
+        "corr" (`xr` = the real signal, `xi` = the real kernel,
+        CIRCULAR semantics at n) and "solve" (`xr` = the real field;
+        the 1-D periodic Poisson solve).  Op requests coalesce per
+        (op, shape, domain, precision) into one batched fused
+        pipeline invocation.
+
         `priority` is the admission class (PRIORITIES): low-priority
         load sheds first under pressure with a harder retry backoff.
         `tenant` names the quota bucket; the mesh dispatcher enforces
@@ -386,7 +439,7 @@ class Dispatcher:
         if self._closing:
             raise DispatcherClosed("dispatcher is shut down")
         xr, xi, group = self._validated(xr, xi, layout, precision,
-                                        inverse, domain, priority)
+                                        inverse, domain, priority, op)
         self._check_served(group)
         q = self._ensure_worker(group)
         self._admit(group, q, priority)

@@ -37,13 +37,16 @@ from .slo import percentile_or_none
 
 
 def verify_response(n: int, layout: str, domain: str, inverse: bool,
-                    precision: str, xr, xi, resp) -> Optional[str]:
+                    precision: str, xr, xi, resp,
+                    op: str = "fft") -> Optional[str]:
     """Problem string, or None: one served response checked against
     its domain's ``numpy.fft`` oracle (pi-layout answers are mapped
     back to natural order first; the tolerance is the precision
-    mode's error budget, docs/PRECISION.md).  Shared by the serve
-    smokes and the mesh chaos driver — a coalesced, padded, re-routed
-    path that returns the wrong rows must FAIL, not just look slow."""
+    mode's error budget, docs/PRECISION.md).  Op-tagged responses
+    (docs/APPS.md) verify against the OP's numpy oracle — the fused
+    circular conv/corr/solve pipeline.  Shared by the serve smokes
+    and the mesh chaos driver — a coalesced, padded, re-routed path
+    that returns the wrong rows must FAIL, not just look slow."""
     from ..ops.precision import error_budget
     from ..utils import verify
 
@@ -51,6 +54,19 @@ def verify_response(n: int, layout: str, domain: str, inverse: bool,
     got_i = np.asarray(resp.yi, np.float64)
     xr64 = np.asarray(xr, np.float64)
     xi64 = np.asarray(xi, np.float64) if xi is not None else None
+    if op != "fft":
+        from ..apps.spectral import numpy_oracle
+
+        ref = numpy_oracle(op, xr64,
+                           xi64 if xi64 is not None
+                           else np.zeros_like(xr64), n)
+        err = verify.rel_err(got_r, ref)
+        tol = max(1e-4, error_budget(precision))
+        if err > tol:
+            return (f"response {resp.rid} wrong: rel err {err:.3e} > "
+                    f"{tol:.0e} vs numpy {op} oracle ({precision} "
+                    f"budget)")
+        return None
     if domain == "r2c":
         if got_r.shape[-1] != n // 2 + 1:
             return (f"response {resp.rid}: r2c answer is "
@@ -82,14 +98,23 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
                            seed: int = 0, domain: str = "c2c",
                            inverse: bool = False,
                            priority: str = "normal",
-                           tenant: str = "default") -> dict:
+                           tenant: str = "default",
+                           op: str = "fft") -> dict:
     """One (shape, offered-rps) cell: fire ``rps * duration_s``
     arrivals on the open-loop schedule, await them all, and roll up
     the SLO row.  Rejections and failures are counted, never raised —
     a load test's job is to record the service's behavior at
-    saturation, not to die of it."""
+    saturation, not to die of it.  `op` drives op-tagged load
+    (docs/APPS.md): conv/corr cells send a real signal + kernel
+    pair, solve cells a real field — the SLO row carries the op."""
     rng = np.random.default_rng(seed)
-    if domain == "c2r":
+    if op in ("conv", "corr"):
+        xr = rng.standard_normal(n).astype(np.float32)
+        xi = rng.standard_normal(n).astype(np.float32)
+    elif op == "solve":
+        xr = rng.standard_normal(n).astype(np.float32)
+        xi = np.zeros_like(xr)
+    elif domain == "c2r":
         spec = np.fft.rfft(rng.standard_normal(n))
         xr = spec.real.astype(np.float32)
         xi = spec.imag.astype(np.float32)
@@ -112,7 +137,7 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
                                            inverse=inverse,
                                            domain=domain,
                                            priority=priority,
-                                           tenant=tenant)
+                                           tenant=tenant, op=op)
         except QueueFull as e:
             rejected.append(e)
             return
@@ -140,8 +165,10 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
         return round(v * scale, 4) if v is not None else None
 
     return {
-        "shape": f"n2^{n.bit_length() - 1}:{layout}",
+        "shape": f"n2^{n.bit_length() - 1}:{layout}"
+                 + (f":{op}" if op != "fft" else ""),
         "n": n,
+        "op": op,
         "offered_rps": round(rps, 1),
         "duration_s": round(elapsed, 4),
         "requests": total,
@@ -168,7 +195,8 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
 
 def _group_for(spec) -> GroupKey:
     return GroupKey(n=spec.n, layout=spec.layout,
-                    precision=spec.precision, domain=spec.domain)
+                    precision=spec.precision, domain=spec.domain,
+                    op=getattr(spec, "op", "fft"))
 
 
 async def run_mesh_chaos_load(mesh, specs, rps: float,
@@ -197,7 +225,16 @@ async def run_mesh_chaos_load(mesh, specs, rps: float,
     rng = np.random.default_rng(seed)
     inputs = []
     for spec in specs:
-        if spec.domain == "c2r":
+        op = getattr(spec, "op", "fft")
+        if op in ("conv", "corr"):
+            inputs.append((rng.standard_normal(spec.n)
+                           .astype(np.float32),
+                           rng.standard_normal(spec.n)
+                           .astype(np.float32)))
+        elif op == "solve":
+            inputs.append((rng.standard_normal(spec.n)
+                           .astype(np.float32), None))
+        elif spec.domain == "c2r":
             sp = np.fft.rfft(rng.standard_normal(spec.n))
             inputs.append((sp.real.astype(np.float32),
                            sp.imag.astype(np.float32)))
@@ -219,7 +256,8 @@ async def run_mesh_chaos_load(mesh, specs, rps: float,
             xr, xi = inputs[si]
             await mesh.submit(xr, xi, layout=spec.layout,
                               precision=spec.precision,
-                              domain=spec.domain)
+                              domain=spec.domain,
+                              op=getattr(spec, "op", "fft"))
 
     ok: list = []        # (t_done_rel_s, total_s, spec_idx, resp)
     rejected: list = []
@@ -235,7 +273,8 @@ async def run_mesh_chaos_load(mesh, specs, rps: float,
         try:
             resp = await mesh.submit(xr, xi, layout=spec.layout,
                                      precision=spec.precision,
-                                     domain=spec.domain)
+                                     domain=spec.domain,
+                                     op=getattr(spec, "op", "fft"))
         except QueueFull as e:
             rejected.append(e)
             return
@@ -269,7 +308,8 @@ async def run_mesh_chaos_load(mesh, specs, rps: float,
         spec = specs[si]
         xr, xi = inputs[si]
         problem = verify_response(spec.n, spec.layout, spec.domain,
-                                  False, spec.precision, xr, xi, resp)
+                                  False, spec.precision, xr, xi, resp,
+                                  op=getattr(spec, "op", "fft"))
         if problem:
             problems.append(problem)
             if len(problems) >= 5:

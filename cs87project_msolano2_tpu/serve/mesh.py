@@ -199,7 +199,8 @@ class MeshDispatcher(Dispatcher):
             out.extend(shapes_mod.warm([spec], force=force))
             group = GroupKey(n=spec.n, layout=spec.layout,
                              precision=spec.precision,
-                             domain=spec.domain)
+                             domain=spec.domain,
+                             op=getattr(spec, "op", "fft"))
             device.warm_groups.add(group)
             events.emit("serve_warm_assignment", device=device.id,
                         shape=group.label())
@@ -239,16 +240,19 @@ class MeshDispatcher(Dispatcher):
                      inverse: bool = False,
                      domain: str = "c2c",
                      priority: str = "normal",
-                     tenant: str = "default"):
+                     tenant: str = "default",
+                     op: str = "fft"):
         """:meth:`Dispatcher.submit`, mesh-routed: validation and the
         class-aware bounded admission are the shared base logic; the
         queue is the ROUTED device's, and the tenant-quota layer runs
         before enqueue (released when the response future resolves,
-        whatever it resolves to)."""
+        whatever it resolves to).  Op-tagged requests (docs/APPS.md)
+        route exactly like transforms — the GroupKey carries the op,
+        so warmth and affinity are op-aware for free."""
         if self._closing:
             raise DispatcherClosed("dispatcher is shut down")
         xr, xi, group = self._validated(xr, xi, layout, precision,
-                                        inverse, domain, priority)
+                                        inverse, domain, priority, op)
         self._check_served(group)
         # choose first, RECORD only after admission passes: a shed
         # request must not inflate the placement counter the

@@ -5,8 +5,16 @@ One frame = a 4-byte big-endian length + a UTF-8 JSON body.  Requests:
     {"op": "fft", "id": 7, "xr": [...], "xi": [...],
      "layout": "natural", "precision": "split3", "inverse": false,
      "domain": "c2c", "priority": "normal", "tenant": "acme"}
+    {"op": "conv", "id": 8, "xr": [...signal...], "xi": [...kernel...]}
     {"op": "stats"}
     {"op": "ping"}
+
+``op`` names the served operation (docs/APPS.md): "fft" (the bare
+transform), or the fused spectral ops — "conv"/"corr" take the real
+signal in ``xr`` and the real kernel in ``xi`` (CIRCULAR semantics at
+n), "solve" takes the real field in ``xr``.  An op outside the
+vocabulary is refused with a structured ``bad_request``, never
+silently served as a bare transform.
 
 ``domain`` is optional (default "c2c"); ``"r2c"`` requests may omit
 ``xi`` entirely — the input is real by declaration (docs/REAL.md).
@@ -78,6 +86,8 @@ async def read_frame(reader) -> Optional[dict]:
 
 
 async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
+    from ..utils.roofline import SPECTRAL_OPS
+
     rid = msg.get("id")
     op = msg.get("op")
     if op == "ping":
@@ -86,10 +96,13 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
         return {"id": rid, "ok": True,
                 "stats": dispatcher.stats.summary(),
                 "buffers": dispatcher.buffer_stats()}
-    if op != "fft":
+    if op not in SPECTRAL_OPS:
+        # unknown ops are refused with a structured error — never
+        # silently served as a bare transform (docs/APPS.md)
         return {"id": rid, "ok": False,
                 "error": {"type": "bad_request",
-                          "message": f"unknown op {op!r}"}}
+                          "message": f"unknown op {op!r} (serveable: "
+                                     f"{SPECTRAL_OPS + ('ping', 'stats')})"}}
     try:
         xi = msg.get("xi")
         resp = await dispatcher.submit(
@@ -100,7 +113,8 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
             inverse=bool(msg.get("inverse", False)),
             domain=msg.get("domain", "c2c"),
             priority=msg.get("priority") or "normal",
-            tenant=msg.get("tenant") or "default")
+            tenant=msg.get("tenant") or "default",
+            op=op)
     except ServeError as e:
         return {"id": rid, "ok": False, "error": e.to_record()}
     rec = resp.to_record(arrays=True)
@@ -222,13 +236,16 @@ async def request_over_socket(host: str, port: int, xr, xi=None,
                               layout: str = "natural",
                               precision: Optional[str] = None,
                               inverse: bool = False,
-                              domain: str = "c2c") -> dict:
-    """Client helper: one fft request over a fresh connection (tests
-    and the CLI demo; a real client keeps the connection open)."""
+                              domain: str = "c2c",
+                              op: str = "fft") -> dict:
+    """Client helper: one request over a fresh connection (tests and
+    the CLI demo; a real client keeps the connection open).  `op`
+    rides the frame's op field — "fft" (default) or the spectral ops
+    "conv"/"corr"/"solve" (docs/APPS.md)."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
         frame = {
-            "op": "fft", "id": 0,
+            "op": op, "id": 0,
             "xr": np.asarray(xr, np.float64).tolist(),
             "layout": layout, "precision": precision,
             "inverse": inverse, "domain": domain}

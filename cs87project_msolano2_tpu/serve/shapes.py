@@ -12,6 +12,12 @@ JSONL file, one shape per line:
     {"n": 4096, "domain": "r2c"}  # half-spectrum real shape (docs/REAL.md)
     {"n": 4096, "precision": "bf16"}  # bytes-halving bf16 storage
                                       # (docs/PRECISION.md)
+    {"n": 4096, "op": "conv"}    # fused spectral conv group — warms
+                                 # both half-spectrum plans and the
+                                 # fused executor (docs/APPS.md);
+                                 # an UNKNOWN op is refused with a
+                                 # structured error, never silently
+                                 # warmed as a bare FFT
 
 ``pifft plan warm --shapes FILE`` warms the whole set in one call
 (instead of one ``plan warm`` invocation per shape), and
@@ -36,27 +42,52 @@ class ShapeSpec:
     shape file serves every host).  ``domain`` declares the transform
     family: "c2c" (default) or the half-spectrum real paths
     "r2c"/"c2r" — n is the real-side length either way
-    (docs/REAL.md)."""
+    (docs/REAL.md).  ``op`` declares the served OPERATION
+    (docs/APPS.md): "fft" (default) or the fused spectral ops
+    "conv"/"corr"/"solve" — an op shape warms BOTH the forward and
+    inverse half-spectrum plans its fused pipeline rides.  An unknown
+    op is a structured refusal, never silently warmed as a bare
+    FFT."""
 
     n: int
     batch: tuple = ()
     layout: str = "natural"
     precision: str = "split3"
     domain: str = "c2c"
+    op: str = "fft"
 
     def __post_init__(self):
         if self.n < 2 or self.n & (self.n - 1):
             raise ValueError(f"served n={self.n} must be a power of two "
                              f">= 2 (the plan ladder's domain)")
         from ..plans.core import DOMAINS
+        from ..utils.roofline import SPECTRAL_OPS
 
         if self.domain not in DOMAINS:
             raise ValueError(f"served domain={self.domain!r} not in "
                              f"{DOMAINS}")
+        if self.op not in SPECTRAL_OPS:
+            raise ValueError(f"served op={self.op!r} not in "
+                             f"{SPECTRAL_OPS} (docs/APPS.md) — an "
+                             f"unknown op must be refused, not warmed "
+                             f"as a bare FFT")
         if self.domain != "c2c" and self.layout != "natural":
             raise ValueError(f"domain={self.domain!r} requires natural "
                              f"layout (the half-spectrum has no pi "
                              f"order)")
+        if self.op != "fft":
+            if self.layout != "natural":
+                raise ValueError(f"op={self.op!r} requires natural "
+                                 f"layout (docs/APPS.md)")
+            if self.domain not in ("c2c", "r2c"):
+                raise ValueError(f"op={self.op!r} rides the "
+                                 f"half-spectrum forward path; "
+                                 f"domain={self.domain!r} does not "
+                                 f"apply")
+            # normalize to the domain the op's GroupKey actually
+            # carries, so strict-shape membership and SLO labels agree
+            # with the dispatcher's keying
+            object.__setattr__(self, "domain", "r2c")
 
     @classmethod
     def from_record(cls, rec: dict) -> "ShapeSpec":
@@ -69,26 +100,33 @@ class ShapeSpec:
             layout=rec.get("layout", "natural"),
             precision=rec.get("precision") or "split3",
             domain=rec.get("domain") or "c2c",
+            op=rec.get("op") or "fft",
         )
 
     def to_record(self) -> dict:
         return {"n": self.n, "batch": list(self.batch),
                 "layout": self.layout, "precision": self.precision,
-                "domain": self.domain}
+                "domain": self.domain, "op": self.op}
 
     def key(self) -> plans.PlanKey:
-        """The PlanKey this shape resolves to on the current device."""
+        """The PlanKey this shape resolves to on the current device
+        (an op shape's PRIMARY key — the forward r2c plan its fused
+        pipeline enters through; :func:`warm` also resolves the c2r
+        side)."""
+        domain = "r2c" if self.op != "fft" else self.domain
         return plans.make_key(self.n, self.batch, layout=self.layout,
                               precision=self.precision,
-                              domain=self.domain)
+                              domain=domain)
 
     def label(self) -> str:
         """Stable human/metric label (the per-shape SLO row key).  The
         domain column rides every non-c2c label so a half-spectrum SLO
         row is never mistaken for its full-spectrum sibling at the
-        same n."""
+        same n; the op column rides every non-fft label the same
+        way (matching GroupKey.label for batch-free shapes)."""
         b = "x".join(str(d) for d in self.batch) + "x" if self.batch else ""
         d = f":{self.domain}" if self.domain != "c2c" else ""
+        d += f":{self.op}" if self.op != "fft" else ""
         return f"{b}{self.n}:{self.layout}:{self.precision}{d}"
 
 
@@ -128,10 +166,24 @@ def warm(specs, force: bool = False, verbose: bool = False) -> list:
         plan = plans.tune_or_static(spec.key(), force=force,
                                     verbose=verbose)
         plan.fn  # build (and cache) the executor now, not per-request
+        if spec.op != "fft":
+            # an op shape's fused pipeline rides BOTH half-spectrum
+            # directions: resolve the c2r side too, and build the
+            # fused executor so the first request pays dispatch
+            inv_plan = plans.tune_or_static(
+                plans.make_key(spec.n, spec.batch, layout=spec.layout,
+                               precision=spec.precision, domain="c2r"),
+                force=force, verbose=verbose)
+            inv_plan.fn
+            from ..apps.spectral import op_executor
+
+            op_executor(spec.op, spec.batch, spec.n,
+                        precision=spec.precision)
         from ..obs import events
 
         events.emit("serve_warm", cell={"n": spec.n,
                                         "variant": plan.variant},
-                    shape=spec.label(), source=plan.source)
+                    shape=spec.label(), source=plan.source,
+                    op=spec.op)
         out.append(plan)
     return out

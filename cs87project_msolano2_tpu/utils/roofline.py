@@ -120,6 +120,102 @@ def fft_hbm_bytes(n: int, carry_passes: int = 0,
         * (1 + carry_passes)
 
 
+# ---------------------------------------------------- spectral ops
+#
+# Fused-op minimum-traffic models (docs/APPS.md): what a spectral
+# OPERATION — convolution, correlation, a spectral solve — must move
+# through HBM when its half-spectrum intermediate NEVER materializes
+# outside the pipeline.  The floor is the op's own I/O plus the kernel
+# spectrum it reads (conv/corr); the internal transforms' extra
+# traffic is implementation choice, exactly like twiddle tables in
+# fft_min_hbm_bytes.  An UNFUSED implementation — one that round-trips
+# the half-spectrum through host between the rfft and the irfft —
+# moves the spectrum out and back in on top of the floor, which is
+# what `spectral_hbm_bytes(..., host_round_trips=1)` charges and what
+# the `make apps-smoke` gate catches from the METER: a fused conv
+# cell's metered delta must sit at the fused floor, the deliberately
+# unfused control must exceed it.
+
+#: the served spectral operations (docs/APPS.md); "fft" is the bare
+#: transform every other op composes
+SPECTRAL_OPS = ("fft", "conv", "corr", "solve")
+
+
+def spectral_min_hbm_bytes(op: str, n: int,
+                           storage_bytes: int = 4) -> int:
+    """The fused floor of one n-point spectral op on real input:
+    conv/corr read the signal (n), read the cached kernel half-
+    spectrum (2·(n/2+1) plane values), and write the real output (n);
+    solve reads the field and writes the solution (its spectral
+    multiplier is a table, excluded like twiddles).  "fft" delegates
+    to the transform's own domain-aware floor (r2c — the apps ops are
+    real-input by construction)."""
+    if op == "fft":
+        return fft_min_hbm_bytes(n, "r2c", storage_bytes)
+    if op in ("conv", "corr"):
+        return storage_bytes * (2 * n + 2 * (n // 2 + 1))
+    if op == "solve":
+        return storage_bytes * 2 * n
+    raise ValueError(f"op={op!r} not in {SPECTRAL_OPS}")
+
+
+def spectral_hbm_bytes(op: str, n: int, host_round_trips: int = 0,
+                       storage_bytes: int = 4) -> int:
+    """The traffic an n-point spectral op actually moves: the fused
+    floor plus one full write+read of the half-spectrum planes
+    (2 × 2·(n/2+1) values) per host round trip between the paired
+    transforms.  A fused pipeline charges zero round trips; the
+    unfused control charges one per spectrum it materializes —
+    this is what the bytes-moved meter charges per op execution."""
+    trip = 2 * 2 * storage_bytes * (n // 2 + 1)
+    return spectral_min_hbm_bytes(op, n, storage_bytes) \
+        + host_round_trips * trip
+
+
+def charge_spectral_traffic(op: str, n: int,
+                            host_round_trips: int = 0,
+                            storage_bytes: int = 4,
+                            count: int = 1) -> int:
+    """Meter `count` spectral-op executions: the op-declared traffic
+    lands on ``pifft_hbm_bytes_total`` (and the floor on the min
+    counter), op-labeled on ``pifft_apps_hbm_bytes_total`` — so the
+    apps-smoke fusion gate reads the SAME meter the rfft/precision
+    gates do.  Returns the charged bytes (0-cost no-op while obs is
+    disarmed — the counters are, like every metric, per-armed-run)."""
+    from ..obs import metrics
+
+    charged = count * spectral_hbm_bytes(op, n, host_round_trips,
+                                         storage_bytes)
+    metrics.inc("pifft_hbm_min_bytes_total",
+                count * spectral_min_hbm_bytes(op, n, storage_bytes))
+    metrics.inc("pifft_hbm_bytes_total", charged)
+    metrics.inc("pifft_apps_hbm_bytes_total", charged, op=op)
+    return charged
+
+
+def spectral_roofline_utilization(op: str, n: int, ms: float,
+                                  device_kind: str,
+                                  storage_bytes: int = 4
+                                  ) -> Optional[float]:
+    """Achieved fraction of the HBM roofline for one fused spectral
+    op measured at `ms` per call, charging the op's fused floor (the
+    bench conv rows' utilization figure).  Does NOT meter — the op
+    execution paths already charged their declared traffic through
+    :func:`charge_spectral_traffic`.  None when the device peak is
+    unknown or the measurement degenerate."""
+    from ..obs import metrics
+
+    peak = hbm_peak_bytes_per_s(device_kind)
+    if peak is None or ms is None or ms <= 0.0:
+        return None
+    util = spectral_min_hbm_bytes(op, n, storage_bytes) \
+        / (ms * 1e-3) / peak
+    metrics.set_gauge("pifft_roofline_util", util, op=op,
+                      n=f"2^{max(n, 1).bit_length() - 1}",
+                      storage=f"{storage_bytes}B")
+    return util
+
+
 def roofline_ceiling(carry_passes: Optional[int]) -> Optional[float]:
     """The utilization ceiling of a path with `carry_passes` declared
     intermediates: a perfectly overlapped pipeline moving (1+p) round
