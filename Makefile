@@ -8,7 +8,7 @@ SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
         bench-chaos serve-smoke serve-slo serve-mesh-smoke rfft-smoke \
-        precision-smoke apps-smoke multichip-smoke \
+        precision-smoke apps-smoke multichip-smoke obs-live-smoke \
         replicate run-experiments run-experiments-and-analyze-results \
         analyze analyze-datasets analyze-smoke check lint
 
@@ -289,6 +289,41 @@ multichip-smoke:
 	JAX_PLATFORMS=cpu PIFFT_PLAN_CACHE=off \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python3 -m cs87project_msolano2_tpu.cli multichip smoke
+
+# the CI live-telemetry check (docs/OBSERVABILITY.md, "The live
+# plane"): end-to-end request tracing + the streaming endpoints + the
+# burn-rate SLO loop, all asserted in one process — a no-trace socket
+# request gets a MINTED trace whose queue/window/compute children sum
+# (±5%) to the SLO row's total with every hop parented correctly, a
+# client-supplied trace id round-trips, the coalescing burst's batch
+# span carries links == coalesced request count, /metrics + /healthz
+# answer DURING load (and /slo reports the sliding window), a mid-run
+# device kill yields a failover span under the SAME trace, injected
+# serve-path latency fires a schema'd slo_alert that demotes the next
+# admission to the jnp rung tagged slo:* + degraded:true and RESOLVES
+# when the injection stops, the disabled path adds zero events, and
+# zero schema-invalid events overall.  The serve-load bench run then
+# proves the trace-derived tail-attribution table rides the record.
+obs-live-smoke:
+	set -o pipefail; \
+	JAX_PLATFORMS=cpu PIFFT_PLAN_CACHE=off \
+	  python3 -m cs87project_msolano2_tpu.serve.live_smoke && \
+	JAX_PLATFORMS=cpu PIFFT_PLAN_CACHE=off python3 bench.py \
+	  --serve-load --smoke --events /tmp/pifft-live-events.jsonl \
+	  | tee /tmp/pifft-live-slo.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-live-slo.json')); \
+	  tails = r['serve_tail_attribution']; \
+	  assert tails, r.keys(); \
+	  row = next(iter(tails.values())); \
+	  assert row['p99_owner'] in ('queue', 'window', 'compute'), row; \
+	  shares = row['p99_queue_share'] + row['p99_window_share'] + row['p99_compute_share']; \
+	  assert abs(shares - 1.0) < 0.01, row; \
+	  print('# tail attribution ok: ' + ', '.join('%s p99 owned by %s' % (k, v['p99_owner']) for k, v in tails.items()))" && \
+	python3 -m cs87project_msolano2_tpu.cli analyze report \
+	  --events /tmp/pifft-live-events.jsonl --json \
+	  | python3 -c "import json, sys; r = json.load(sys.stdin); \
+	  assert r.get('tail_attribution'), list(r); \
+	  print('# analyze tail table ok: %d shape(s)' % len(r['tail_attribution']))"
 
 # project static analysis (check/ subsystem, docs/CHECKS.md): the
 # timing/retrace/Mosaic/plan-key invariants as AST rules, gated on the

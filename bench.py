@@ -755,8 +755,28 @@ def serve_load_main(args) -> int:
         record["degraded"] = True
     if obs.enabled():
         record["run"] = obs.run_id()
+        from cs87project_msolano2_tpu.analyze.loader import (
+            tail_attribution,
+        )
         from cs87project_msolano2_tpu.obs import export, metrics
 
+        # the trace-derived tail-attribution table (docs/ANALYSIS.md):
+        # the serve trace plane ran under this load, so the record can
+        # say WHICH PHASE owned each shape's p99 — the span-level
+        # sequel to the funnel/tube shares
+        tails = tail_attribution(obs.snapshot())
+        if tails:
+            record["serve_tail_attribution"] = {
+                label: {"p99_owner": row["p99_owner"],
+                        "p99_ms": row["p99_ms"],
+                        "p99_queue_share": row["p99_queue_share"],
+                        "p99_window_share": row["p99_window_share"],
+                        "p99_compute_share": row["p99_compute_share"]}
+                for label, row in tails.items()}
+        if obs.events.dropped():
+            # an overflowed buffer means the attribution above is
+            # partial: say so in the record, not just the summary
+            record["obs_dropped_events"] = obs.events.dropped()
         obs.emit("env", **record["env"])
         obs.emit("metrics", snapshot=metrics.snapshot())
         obs.flush()

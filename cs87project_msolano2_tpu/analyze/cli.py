@@ -22,7 +22,7 @@ import argparse
 import sys
 
 from . import lawfit, phases, regress
-from .loader import build_table, load_bench_rounds
+from .loader import build_table, load_bench_rounds, tail_attribution
 from .records import dump_json
 
 __all__ = ["analyze_main"]
@@ -109,6 +109,21 @@ def _report_main(args) -> int:
                          phases.phase_shares_from_rows(obs_rows).items()}
     if shares:
         doc["phase_shares"] = shares
+    if args.events:
+        # the trace-derived tail-attribution table (loader.py,
+        # docs/ANALYSIS.md): which phase owns the p99, straight from
+        # the serve trace plane's span trees
+        from ..obs.events import load_events
+
+        tails = {}
+        for path in args.events:
+            try:
+                records, _dropped = load_events(path)
+            except OSError:
+                continue  # build_table already reported unreadables
+            tails.update(tail_attribution(records))
+        if tails:
+            doc["tail_attribution"] = tails
     if table.rounds:
         doc["change_points"] = regress.change_points(table.rounds)
         _, _, skipped = regress.detect_regressions(table.rounds)
@@ -132,6 +147,18 @@ def _report_main(args) -> int:
         for cell, v in cells.items():
             print(f"  {cell:<18} funnel {v['funnel']:.3f}  "
                   f"tube {v['tube']:.3f}  ({v['runs']} run(s))")
+    tails = doc.get("tail_attribution") or {}
+    if tails:
+        print("tail attribution (trace-derived; which phase owns "
+              "the p99):")
+        for label, row in tails.items():
+            print(f"  {label:<30} p50 {row['p50_ms']:.3f} ms  "
+                  f"p99 {row['p99_ms']:.3f} ms  owner "
+                  f"{row['p99_owner']} "
+                  f"(q {row['p99_queue_share']:.2f} / "
+                  f"w {row['p99_window_share']:.2f} / "
+                  f"c {row['p99_compute_share']:.2f}; "
+                  f"{row['requests']} traced)")
     for metric, cp in sorted(doc.get("change_points", {}).items()):
         print(f"change-point {metric}: r{cp['from_round']:02d}->"
               f"r{cp['to_round']:02d} {cp['prev']:g} -> {cp['cur']:g} "

@@ -40,7 +40,7 @@ import numpy as np
 
 __all__ = ["BenchRound", "Fingerprint", "Sample", "SampleTable",
            "load_bench_round", "load_bench_rounds", "load_obs_samples",
-           "load_tsv_samples", "build_table"]
+           "load_tsv_samples", "build_table", "tail_attribution"]
 
 #: the jax platform banner the relay prints into captured bench tails —
 #: the backfill source for pre-``env`` committed rounds
@@ -429,6 +429,80 @@ def load_obs_samples(path: str) -> tuple:
             fp = Fingerprint.from_env(rec["payload"])
     samples = phase_samples_from_events(records, fingerprint=fp)
     return samples, fp, dropped
+
+
+# ----------------------------------------------- trace tail attribution
+
+#: the request-tree phase children the serve trace plane emits
+#: (obs/trace.py): queue (submit->dequeue), window (dequeue->batch
+#: execution), compute (the kernel seconds)
+_TRACE_PHASES = ("queue", "window", "compute")
+
+
+def tail_attribution(records, q: float = 99.0) -> dict:
+    """WHICH PHASE OWNS THE TAIL: the span-level sequel to the
+    funnel/tube shares (docs/ANALYSIS.md).
+
+    Reassembles every ``serve_request`` span tree in an obs event
+    stream (the serve trace plane, obs/trace.py) by trace id, then per
+    shape label compares the MEDIAN request's phase split against the
+    p-th-percentile request's: the row names the phase that owns the
+    tail request's latency (``p99_owner``) and carries both splits, so
+    "the p99 is queue wait, not kernel" is a table lookup instead of a
+    spelunking session.  Requests whose tree is incomplete (sampled-out
+    children, kill-truncated stream) are skipped, not guessed at."""
+    from ..obs.export import spans_from_events
+    from ..utils.stats import percentile_nearest_rank
+
+    spans = spans_from_events(records)
+    roots: dict = {}       # (trace, sid) -> root span
+    children: dict = {}    # (trace, parent_sid) -> {phase: dur_s}
+    for sp in spans:
+        trace = sp.get("trace")
+        if not trace:
+            continue
+        if sp.get("name") == "serve_request" and sp.get("sid"):
+            if not (sp.get("args") or {}).get("shed"):
+                roots[(trace, sp["sid"])] = sp
+        elif sp.get("name") in _TRACE_PHASES and sp.get("parent_sid"):
+            bucket = children.setdefault((trace, sp["parent_sid"]), {})
+            bucket[sp["name"]] = float(sp.get("dur_s", 0.0))
+    requests: dict = {}    # label -> [(total_s, {phase: dur_s})]
+    for key, root in roots.items():
+        phases = children.get(key)
+        if not phases or any(p not in phases for p in _TRACE_PHASES):
+            continue
+        label = (root.get("args") or {}).get("shape", "?")
+        total = sum(phases[p] for p in _TRACE_PHASES)
+        requests.setdefault(label, []).append((total, phases))
+    out = {}
+    for label, rows in sorted(requests.items()):
+        totals = sorted(t for t, _ in rows)
+        p50 = percentile_nearest_rank(totals, 50)
+        p_tail = percentile_nearest_rank(totals, q)
+        # the ACTUAL tail request (nearest rank: it happened), not an
+        # interpolated phantom — its split is the attribution
+        tail_total, tail_phases = min(
+            (r for r in rows if r[0] >= p_tail), key=lambda r: r[0])
+        med_total, med_phases = min(
+            (r for r in rows if r[0] >= p50), key=lambda r: r[0])
+        row = {
+            "requests": len(rows),
+            "p50_ms": round(p50 * 1e3, 4),
+            f"p{q:g}_ms": round(p_tail * 1e3, 4),
+        }
+        for name, total, phases in (("p50", med_total, med_phases),
+                                    (f"p{q:g}", tail_total,
+                                     tail_phases)):
+            for phase in _TRACE_PHASES:
+                row[f"{name}_{phase}_ms"] = round(
+                    phases[phase] * 1e3, 4)
+                row[f"{name}_{phase}_share"] = round(
+                    phases[phase] / total, 4) if total > 0 else 0.0
+        row[f"p{q:g}_owner"] = max(
+            _TRACE_PHASES, key=lambda p: tail_phases[p])
+        out[label] = row
+    return out
 
 
 # -------------------------------------------------------------- merging

@@ -7,8 +7,9 @@
     python -m cs87project_msolano2_tpu check [path ...] [--rule ID]
                                          [--json] [--baseline FILE]
     python -m cs87project_msolano2_tpu faults {list | inject <spec>}
-    python -m cs87project_msolano2_tpu obs {summary | export | validate}
-                                         [--events FILE] [--format F]
+    python -m cs87project_msolano2_tpu obs {summary | export | validate
+                                         | top} [--events FILE]
+                                         [--format F] [--url URL]
     python -m cs87project_msolano2_tpu analyze {fit | report | gate}
                                          [files ...] [--json]
     python -m cs87project_msolano2_tpu serve [--smoke | --host H --port P]
@@ -46,8 +47,10 @@ The `obs` subcommand fronts the observability subsystem
 file `bench.py --events` / `PIFFT_OBS_EVENTS` wrote) into a human
 table (`--json` for machines), `export --format {chrome,prom}`
 converts it to Chrome trace JSON (Perfetto) or the Prometheus textfile
-format, and `validate` schema-checks every event (the CI obs-smoke
-gate).
+format, `validate` schema-checks every event (the CI obs-smoke
+gate), and `top` renders the LIVE /slo + /healthz snapshot of a
+running `pifft serve --telemetry-port` as a refreshing terminal
+table (docs/OBSERVABILITY.md, "The live plane").
 
 The `analyze` subcommand fronts the statistical verification layer
 (docs/ANALYSIS.md): `fit` runs the complexity-law fit (confidence
@@ -371,15 +374,28 @@ def faults_main(argv) -> int:
 
 
 def obs_main(argv) -> int:
-    """`obs {summary|export|validate}` — post-process a structured
-    event stream (docs/OBSERVABILITY.md)."""
+    """`obs {summary|export|validate|top}` — post-process a structured
+    event stream, or watch the LIVE telemetry plane
+    (docs/OBSERVABILITY.md)."""
     ap = argparse.ArgumentParser(
         prog="cs87project_msolano2_tpu obs",
         description="summarize / export / validate an observability "
                     "event stream (a JSONL file written by "
-                    "bench.py --events or PIFFT_OBS_EVENTS)",
+                    "bench.py --events or PIFFT_OBS_EVENTS), or render "
+                    "the live /slo + /healthz snapshot of a running "
+                    "`pifft serve --telemetry-port` as a refreshing "
+                    "terminal table (top)",
     )
-    ap.add_argument("action", choices=("summary", "export", "validate"))
+    ap.add_argument("action", choices=("summary", "export", "validate",
+                                       "top"))
+    ap.add_argument("--url", default="http://127.0.0.1:8572",
+                    metavar="URL",
+                    help="top: base URL of the telemetry plane "
+                         "(pifft serve --telemetry-port)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="top: refresh period (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="top: print one frame and exit (scripts/CI)")
     ap.add_argument("--events", default="pifft-events.jsonl",
                     metavar="FILE",
                     help="the event-stream JSONL file (default: "
@@ -399,6 +415,9 @@ def obs_main(argv) -> int:
 
     from .obs import events as obs_events
     from .obs import export as obs_export
+
+    if args.action == "top":
+        return _obs_top(args)
 
     if not os.path.exists(args.events):
         print(f"error: no event stream at {args.events} (run with "
@@ -445,6 +464,49 @@ def obs_main(argv) -> int:
     else:
         sys.stdout.write(text)
     return 0
+
+
+def _obs_top(args) -> int:
+    """`obs top` — the live terminal view: poll a running telemetry
+    plane's /slo + /healthz and render the refreshing table
+    (docs/OBSERVABILITY.md, "The live plane")."""
+    import time
+    import urllib.error
+
+    from .obs.http import fetch_json, format_top
+
+    base = args.url.rstrip("/")
+    interval = max(args.interval, 0.2)
+    while True:
+        try:
+            slo = fetch_json(f"{base}/slo")
+            health = fetch_json(f"{base}/healthz")
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                print(f"error: {base}: HTTP {e.code}", file=sys.stderr)
+                return 1
+            # 503 still carries the health body — NOT SERVING is a
+            # frame, not a failure of the viewer
+            import json as _json
+
+            health = _json.loads(e.read().decode("utf-8"))
+            slo = {"rows": {}}
+        except (OSError, ValueError) as e:
+            print(f"error: no telemetry plane at {base} ({e}) — start "
+                  f"one with pifft serve --telemetry-port",
+                  file=sys.stderr)
+            return 1
+        frame = format_top(slo, health)
+        if args.once:
+            print(frame)
+            return 0
+        # clear + home, then the frame (the classic top discipline)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 def multichip_main(argv) -> int:

@@ -47,8 +47,20 @@ from .events import (  # noqa: F401
     snapshot,
     validate_event,
 )
-from .profiler import trace  # noqa: F401
 from .spans import span, traced  # noqa: F401
+
+# NOTE: ``obs.trace`` is now the TRACE-CONTEXT module (request
+# tracing, docs/OBSERVABILITY.md "The live plane"); the XProf deep
+# profiler stays at ``obs.profiler.trace`` (its import path since
+# PR 5 — nothing imported the short alias, verified by grep+tests)
+from .trace import NOOP_TRACE, TraceContext  # noqa: F401
+from .trace import current as current_trace  # noqa: F401
+
+# http (the live endpoints) and slomon (burn-rate alerting) are NOT
+# imported here: both are leaf modules with heavier import footprints
+# (http.server / config parsing) that the disabled-path contract has
+# no business paying — import cs87project_msolano2_tpu.obs.http /
+# .slomon where the live plane is actually armed.
 
 
 def _env_autoenable() -> None:

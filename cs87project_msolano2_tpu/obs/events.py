@@ -189,11 +189,13 @@ def _emit(st: _State, kind: str, cell, payload):
         rec["payload"] = payload
     sink_error = None
     sink_dead = False
+    dropped_now = first_drop = False
     with st.lock:
         rec["seq"] = st.seq
         st.seq += 1
         if len(st.buffer) == st.buffer_max:
             st.dropped += 1  # deque maxlen evicts the oldest in O(1)
+            dropped_now, first_drop = True, st.dropped == 1
         st.buffer.append(rec)
         if st.sink_fh is not None:
             try:
@@ -211,6 +213,21 @@ def _emit(st: _State, kind: str, cell, payload):
                 # events describe — drop the sink, keep the buffer
                 st.sink_fh = None
                 sink_error, sink_dead = e, True
+    if dropped_now:
+        # outside the lock (metrics holds its own lock; warn() emits
+        # back into this stream): buffer overflow is counted on a
+        # live series — silent event loss reads as "covered
+        # everything" when it didn't (docs/OBSERVABILITY.md)
+        from . import metrics
+
+        metrics.inc("pifft_obs_dropped_total")
+        if first_drop:
+            from ..plans.core import warn
+
+            warn(f"obs buffer overflowed (max {st.buffer_max}); "
+                 f"oldest events are being dropped — the count rides "
+                 f"pifft_obs_dropped_total and the summary (arm a "
+                 f"JSONL sink or raise buffer_max for full streams)")
     if sink_error is not None:
         # outside the lock: warn() mirrors into this event stream
         from ..plans.core import warn
@@ -285,6 +302,11 @@ _KIND_PAYLOAD = {
     "serve_device_failed": ("device", "kind"),
     "serve_failover": ("device", "requests"),
     "serve_handoff": ("device", "successor", "shape"),
+    # the burn-rate SLO monitor (obs/slomon.py, docs/OBSERVABILITY.md
+    # "The live plane"): an alert must name its objective, whether it
+    # is firing or resolved, and the burn pair that decided — the
+    # obs-live-smoke gate asserts the shape, not just the presence
+    "slo_alert": ("objective", "state", "burn"),
 }
 
 

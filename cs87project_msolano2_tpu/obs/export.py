@@ -50,26 +50,51 @@ def chrome_trace(spans: Optional[Iterable[dict]] = None,
     Each span becomes one complete event: ``ph="X"``, ``ts``/``dur`` in
     microseconds, ``tid`` = the recording thread, span attributes and
     cell identity under ``args`` — the keys Perfetto needs to render a
-    nested flame."""
+    nested flame.
+
+    Spans carrying ``links`` (the batcher's fan-in edge: the request
+    span ids its coalesced batch served — obs/trace.py) additionally
+    emit **flow events** (``ph: "s"`` at each linked source span,
+    ``ph: "f"`` with ``bp: "e"`` at the linking span), so Perfetto
+    draws the request→batch arrows across threads."""
     if spans is None:
         spans = events_mod.span_snapshot()
+    spans = list(spans)
+    by_sid = {sp["sid"]: sp for sp in spans if sp.get("sid")}
     trace = []
+    flow_seq = 0
     for sp in spans:
         args = dict(sp.get("args") or {})
-        for key in ("cell", "parent", "depth", "run", "error"):
+        for key in ("cell", "parent", "depth", "run", "error", "sid",
+                    "trace", "links"):
             if sp.get(key) is not None:
                 args[key] = sp[key]
+        ts = round(float(sp.get("ts_s", 0.0)) * 1e6, 3)
         trace.append({
             "name": sp.get("name", "span"),
             "ph": "X",
-            "ts": round(float(sp.get("ts_s", 0.0)) * 1e6, 3),
+            "ts": ts,
             "dur": round(float(sp.get("dur_s", 0.0)) * 1e6, 3),
             "pid": pid,
             "tid": sp.get("tid", 0),
             "cat": "pifft",
             "args": args,
         })
-    trace.sort(key=lambda e: (e["tid"], e["ts"], -e["dur"]))
+        for lid in (sp.get("links") or ()):
+            src = by_sid.get(lid)
+            if src is None:
+                continue  # the linked span fell outside this export
+            flow_seq += 1
+            common = {"name": "fanin", "cat": "pifft_flow",
+                      "id": flow_seq, "pid": pid}
+            trace.append({**common, "ph": "s",
+                          "ts": round(float(src.get("ts_s", 0.0))
+                                      * 1e6, 3),
+                          "tid": src.get("tid", 0)})
+            trace.append({**common, "ph": "f", "bp": "e", "ts": ts,
+                          "tid": sp.get("tid", 0)})
+    trace.sort(key=lambda e: (e["tid"], e["ts"],
+                              -e.get("dur", 0)))
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 
@@ -150,9 +175,17 @@ def summarize(records: list, dropped_lines: int = 0) -> dict:
         for key in ("total_s", "max_s", "mean_s"):
             agg[key] = round(agg[key], 6)
     snap = last_metrics_snapshot(records)
+    # silent event loss is the one hole a summary must not paper over:
+    # surface the buffer-overflow drop count (live when this process
+    # is the armed one, else the counter the finished stream carries)
+    dropped_events = events_mod.dropped()
+    if not dropped_events and snap:
+        dropped_events = int((snap.get("counters") or {})
+                             .get("pifft_obs_dropped_total", 0))
     return {
         "event_count": len(records),
         "dropped_lines": dropped_lines,
+        "dropped_events": dropped_events,
         "runs": runs,
         "kinds": dict(sorted(kinds.items())),
         "spans": dict(sorted(spans.items())),
@@ -166,6 +199,10 @@ def format_summary(summary: dict) -> str:
     lines = [f"events: {summary['event_count']}"
              + (f" ({summary['dropped_lines']} corrupt line(s) skipped)"
                 if summary.get("dropped_lines") else "")]
+    if summary.get("dropped_events"):
+        lines.append(f"WARNING: {summary['dropped_events']} event(s) "
+                     f"DROPPED to buffer overflow — the stream is "
+                     f"incomplete (pifft_obs_dropped_total)")
     if summary.get("runs"):
         lines.append(f"runs:   {', '.join(summary['runs'])}")
     if summary["kinds"]:

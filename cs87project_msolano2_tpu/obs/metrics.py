@@ -37,10 +37,22 @@ _GAUGES: dict = {}
 _HISTOGRAMS: dict = {}
 
 
+def escape_label_value(value) -> str:
+    """A label value escaped per the Prometheus exposition format:
+    backslash, double-quote and newline are the three characters the
+    format reserves (in that order — escaping the escape first).  A
+    shape label carrying any of them would otherwise corrupt every
+    series on the same page, which is exactly the silent breakage a
+    scrape never reports."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _series(name: str, labels: dict) -> str:
     if not labels:
         return name
-    body = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    body = ",".join(f'{k}="{escape_label_value(labels[k])}"'
+                    for k in sorted(labels))
     return f"{name}{{{body}}}"
 
 

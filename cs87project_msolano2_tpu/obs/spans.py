@@ -65,6 +65,9 @@ class _NoopSpan:
     def set(self, **args):
         return self
 
+    def set_links(self, links):
+        return self
+
 
 NOOP_SPAN = _NoopSpan()
 
@@ -75,10 +78,13 @@ class Span:
     """
 
     __slots__ = ("name", "cell", "args", "annotate", "sync",
-                 "t0", "dur_s", "_parent", "_depth", "_ann")
+                 "t0", "dur_s", "_parent", "_depth", "_ann",
+                 "links", "sid", "trace_id")
 
     def __init__(self, name: str, cell: Optional[dict], annotate: bool,
-                 sync: Optional[Callable], args: dict):
+                 sync: Optional[Callable], args: dict,
+                 links=None, sid: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         self.name = name
         self.cell = cell
         self.args = args
@@ -89,11 +95,24 @@ class Span:
         self._parent = None
         self._depth = 0
         self._ann = None
+        #: trace-plane identity (obs/trace.py): ``links`` is the
+        #: fan-in edge — span ids this span serves (the batcher's
+        #: coalesced requests) — rendered as Perfetto flow arrows by
+        #: the Chrome exporter; ``sid``/``trace_id`` let other spans
+        #: link to THIS one
+        self.links = list(links) if links else None
+        self.sid = sid
+        self.trace_id = trace_id
 
     def set(self, **args):
         """Attach/overwrite span attributes mid-flight (they land in
         the record's ``args``)."""
         self.args.update(args)
+        return self
+
+    def set_links(self, links):
+        """Attach/replace the fan-in link ids mid-flight."""
+        self.links = list(links) if links else None
         return self
 
     def __enter__(self):
@@ -154,6 +173,12 @@ class Span:
                 rec["cell"] = dict(self.cell)
             if self.args:
                 rec["args"] = dict(self.args)
+            if self.links:
+                rec["links"] = list(self.links)
+            if self.sid:
+                rec["sid"] = self.sid
+            if self.trace_id:
+                rec["trace"] = self.trace_id
             if exc_type is not None:
                 rec["error"] = exc_type.__name__
             elif sync_error is not None:
@@ -166,7 +191,9 @@ class Span:
 
 
 def span(name: str, cell: Optional[dict] = None, annotate: bool = False,
-         sync: Optional[Callable] = None, **args):
+         sync: Optional[Callable] = None, links=None,
+         sid: Optional[str] = None, trace_id: Optional[str] = None,
+         **args):
     """A phase span context manager.
 
         with span("tube", cell={"n": n, "p": p}):
@@ -177,12 +204,15 @@ def span(name: str, cell: Optional[dict] = None, annotate: bool = False,
     additionally enters ``jax.profiler.TraceAnnotation(name)`` so the
     phase is named in an XProf trace; `sync` (a pytree or a callable
     returning one) closes the span over ``timing.block`` of that value.
-    """
+    `links`/`sid`/`trace_id` are the trace-plane identity fields
+    (obs/trace.py): ``links`` records the span ids this span fans in
+    from (the Chrome exporter draws them as flow arrows)."""
     from . import events
 
     if events._STATE is None:
         return NOOP_SPAN
-    return Span(name, cell, annotate, sync, args)
+    return Span(name, cell, annotate, sync, args, links=links,
+                sid=sid, trace_id=trace_id)
 
 
 def traced(name: Optional[str] = None, annotate: bool = False):

@@ -38,6 +38,10 @@ turns CONCURRENT REQUESTS into BATCHED KERNEL INVOCATIONS:
 * ``router``     — the placement (warmth + least-loaded) and
                    admission (priority classes, per-tenant quota)
                    policies the mesh runs on.
+* ``live_smoke`` — the ``make obs-live-smoke`` gate: end-to-end
+                   request tracing, the streaming telemetry
+                   endpoints, and the burn-rate SLO loop
+                   (docs/OBSERVABILITY.md, "The live plane").
 
 Check rule PIF107 (docs/CHECKS.md) polices this package: no blocking
 ``time.sleep``/sync I/O inside its async paths — all waiting funnels

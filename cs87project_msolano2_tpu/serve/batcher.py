@@ -212,22 +212,31 @@ class BatchRunner:
     # --------------------------------------------------- execution
 
     def run(self, group: GroupKey, planes,
-            rung: Optional[str] = None) -> BatchOutcome:
+            rung: Optional[str] = None,
+            rung_tag: Optional[str] = None,
+            links: Optional[list] = None) -> BatchOutcome:
         """Execute one coalesced batch (list of (xr, xi) float planes of
         shape (n,)).  `rung` forces a degradation rung up front (the
         dispatcher's overload fallback); otherwise the tuned plan runs
         and only a CAPACITY/PERMANENT fault walks the serve fallback
-        rungs.  Raises only for faults no rung could absorb."""
+        rungs.  Raises only for faults no rung could absorb.
+
+        `rung_tag` names a forced rung's trigger on the degrade trail
+        (default ``overload:<rung>``; the burn-rate monitor passes
+        ``slo:<rung>`` — docs/OBSERVABILITY.md).  `links` is the
+        trace fan-in edge: the coalesced requests' span ids, recorded
+        on the ONE serve_batch span (obs/trace.py)."""
         size = len(planes)
         bucket = batch_bucket(size)
         sxr, sxi = self._stage(group, planes, bucket)
         degrade: list = []
         if rung is not None:
-            degrade.append(f"overload:{rung}")
+            degrade.append(rung_tag if rung_tag is not None
+                           else f"overload:{rung}")
         try:
             with span("serve_batch", cell={"n": group.n, "size": size},
                       bucket=bucket, rung=rung or "plan",
-                      op=group.op) as sp:
+                      op=group.op, links=links) as sp:
                 outcome = self._invoke(group, bucket, rung, sxr, sxi,
                                        degrade)
                 if rung is None and planes:

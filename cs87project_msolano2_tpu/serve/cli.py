@@ -6,6 +6,10 @@ startup, and serves until interrupted.  ``--devices N`` puts the
 :class:`~.mesh.MeshDispatcher` behind the same socket: per-device
 worker pools, shape-affinity routing, priority admission, and
 self-healing failover (docs/SERVING.md, mesh section).
+``--telemetry-port`` arms the live plane (streaming /metrics,
+/healthz, /slo — docs/OBSERVABILITY.md) and ``--slo-objectives``
+the burn-rate monitor whose sustained-burn alerts force
+admission-time degradation, tagged ``slo:*``.
 
 ``--mesh-smoke`` is the mesh CI gate (``make serve-mesh-smoke``): a
 virtual 8-device CPU mesh warmed with an 8-shape set, driven by the
@@ -82,6 +86,7 @@ def _build_config(args) -> ServeConfig:
     if args.queue_depth is not None:
         cfg.queue_depth = args.queue_depth
     cfg.strict_shapes = bool(args.strict)
+    cfg.slo_objectives = getattr(args, "slo_objectives", None)
     return cfg
 
 
@@ -110,6 +115,18 @@ def serve_main(argv) -> int:
                     help="mesh-smoke: seconds of offered load")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8571)
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve the live telemetry plane (/metrics "
+                         "/healthz /slo) on this HTTP port "
+                         "(docs/OBSERVABILITY.md; 0 = ephemeral); "
+                         "arms in-process observability when not "
+                         "already enabled")
+    ap.add_argument("--slo-objectives", default=None, metavar="FILE",
+                    help="burn-rate SLO objectives (YAML/JSON, "
+                         "obs/slomon.py): sustained error-budget burn "
+                         "forces admission-time degradation, tagged "
+                         "slo:*")
     ap.add_argument("--shapes", default=None, metavar="FILE",
                     help="served shape set (JSONL of {n, batch, "
                          "precision, layout}); warmed at startup")
@@ -151,6 +168,19 @@ def serve_main(argv) -> int:
     else:
         dispatcher = Dispatcher(cfg, specs)
 
+    telemetry = None
+    if args.telemetry_port is not None:
+        # the live plane reads the metrics registry and the streaming
+        # SLO reservoir: without observability armed both are empty,
+        # so a telemetry request implies at least in-process buffering
+        from .. import obs
+        from ..obs.http import TelemetryServer
+
+        if not obs.enabled():
+            obs.enable()
+        telemetry = TelemetryServer(dispatcher, host=args.host,
+                                    port=args.telemetry_port).start()
+
     async def main():
         async with dispatcher:
             await serve_socket(dispatcher, args.host, args.port)
@@ -159,6 +189,9 @@ def serve_main(argv) -> int:
         asyncio.run(main())
     except KeyboardInterrupt:
         print("# serve: interrupted, shutting down", file=sys.stderr)
+    finally:
+        if telemetry is not None:
+            telemetry.stop()
     return 0
 
 

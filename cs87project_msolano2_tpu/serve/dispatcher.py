@@ -54,6 +54,7 @@ from typing import Optional
 import numpy as np
 
 from ..obs import events, metrics
+from ..obs import trace as trace_mod
 from ..obs.spans import clock
 from ..resilience import classify
 from ..utils.roofline import SPECTRAL_OPS as OPS
@@ -142,6 +143,13 @@ class ServeConfig:
     pressure_watermark: float = 0.5   # fill fraction: window -> 0
     overload_watermark: float = 0.875  # fill fraction: cheap-rung mode
     strict_shapes: bool = False  # only serve the warmed shape set
+    #: burn-rate SLO objectives (docs/OBSERVABILITY.md, "The live
+    #: plane"): a config-file path for obs.slomon.load_objectives, a
+    #: ready list of Objective records, or a built SloMonitor — when
+    #: set, sustained error-budget burn forces the admission ladder
+    #: (window collapse -> jnp rung) BEFORE the queues saturate,
+    #: tagged slo:<level> like every demotion
+    slo_objectives: object = None
 
 
 @dataclasses.dataclass
@@ -160,6 +168,16 @@ class Request:
     #: mesh re-routes it off a dead device) — merged into the response's
     #: degrade trail on delivery, on top of whatever the batch earned
     trail: list = dataclasses.field(default_factory=list)
+    #: trace-plane identity (obs/trace.py): minted at submit or adopted
+    #: from the wire; NOOP_TRACE when observability is off
+    trace: trace_mod.TraceContext = trace_mod.NOOP_TRACE
+    #: stamped by the worker when it pops the request — splits the SLO
+    #: row's queue_wait into queue (submit->dequeue) vs window
+    #: (dequeue->execution) children in the span tree
+    t_dequeue: Optional[float] = None
+    #: instant trace marks ((name, t) pairs): failover/handoff re-route
+    #: hops land here and become children of the request span tree
+    marks: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -179,6 +197,11 @@ class Response:
     #: which mesh device served the batch (None on the single-device
     #: dispatcher — docs/SERVING.md, mesh section)
     device: Optional[str] = None
+    #: the request's trace (obs/trace.py): ids always when tracing is
+    #: armed, the span tree (queue/window/compute + degrade/failover
+    #: children) when the trace was sampled or tail-upgraded —
+    #: travels the wire so the CALLER holds its own attribution
+    trace: Optional[dict] = None
 
     def to_record(self, arrays: bool = False) -> dict:
         rec = {
@@ -193,6 +216,8 @@ class Response:
             rec["degrade"] = list(self.degrade)
         if self.device is not None:
             rec["device"] = self.device
+        if self.trace is not None:
+            rec["trace"] = self.trace
         if arrays:
             rec["yr"] = np.asarray(self.yr, np.float64).tolist()
             rec["yi"] = np.asarray(self.yi, np.float64).tolist()
@@ -220,6 +245,23 @@ class Dispatcher:
         self._served = {(s.n, s.layout, s.precision, s.domain,
                          getattr(s, "op", "fft"))
                         for s in self.specs}
+        self.slomon = self._build_slomon(self.config.slo_objectives)
+
+    @staticmethod
+    def _build_slomon(spec):
+        """The burn-rate monitor from a config-file path, a list of
+        Objective records, or a ready SloMonitor (None disables —
+        no per-batch evaluation cost)."""
+        if spec is None:
+            return None
+        from ..obs import slomon as slomon_mod
+
+        if isinstance(spec, slomon_mod.SloMonitor):
+            return spec
+        if isinstance(spec, str):
+            objectives, windows = slomon_mod.load_objectives(spec)
+            return slomon_mod.SloMonitor(objectives, windows)
+        return slomon_mod.SloMonitor(list(spec))
 
     # ----------------------------------------------------- lifecycle
 
@@ -410,7 +452,8 @@ class Dispatcher:
                      domain: str = "c2c",
                      priority: str = "normal",
                      tenant: str = "default",
-                     op: str = "fft") -> Response:
+                     op: str = "fft",
+                     trace=None) -> Response:
         """Serve one n-point transform of float planes ``(n,)``.
         Raises a :class:`ServeError` subclass — never hangs — when the
         request cannot be admitted or no rung could serve it.
@@ -435,18 +478,34 @@ class Dispatcher:
         `priority` is the admission class (PRIORITIES): low-priority
         load sheds first under pressure with a harder retry backoff.
         `tenant` names the quota bucket; the mesh dispatcher enforces
-        per-tenant quotas on it (docs/SERVING.md)."""
+        per-tenant quotas on it (docs/SERVING.md).
+
+        `trace` continues a caller's trace (a wire ``trace`` field or
+        an in-process :class:`~..obs.trace.TraceContext`); omitted, a
+        fresh trace is MINTED here — obs/trace.py, the no-op
+        singleton when observability is off."""
         if self._closing:
             raise DispatcherClosed("dispatcher is shut down")
         xr, xi, group = self._validated(xr, xi, layout, precision,
                                         inverse, domain, priority, op)
         self._check_served(group)
+        ctx = trace_mod.ensure(trace)
+        t_submit = clock()
         q = self._ensure_worker(group)
-        self._admit(group, q, priority)
+        try:
+            self._admit(group, q, priority)
+        except QueueFull:
+            # shed requests are in the tracing tail-upgrade class:
+            # the rejection leaves a (always-emitted) root span
+            trace_mod.shed_record(ctx, label=group.label(),
+                                  t_submit=t_submit,
+                                  reason="queue_full",
+                                  priority=priority)
+            raise
         req = Request(rid=next(self._rid), group=group, xr=xr, xi=xi,
-                      t_submit=clock(),
+                      t_submit=t_submit,
                       future=asyncio.get_running_loop().create_future(),
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, trace=ctx)
         metrics.inc("pifft_serve_requests_total", shape=group.label())
         q.put_nowait(req)
         return await req.future
@@ -475,12 +534,23 @@ class Dispatcher:
 
     def _admission(self, group: GroupKey, q) -> tuple:
         """(window_s, forced_rung, level_tag) for the batch about to be
-        drained — the admission-time degradation ladder."""
+        drained — the admission-time degradation ladder.  Two signals
+        feed it: queue FILL (the classic saturation ladder) and the
+        burn-rate SLO monitor (obs/slomon.py) — a sustained
+        error-budget burn forces the same rungs BEFORE the queues
+        fill, tagged ``slo:*`` so the trigger is never ambiguous in
+        the trail (queue fill wins the name when both fire)."""
         fill = q.qsize() / self.config.queue_depth
+        slo = self.slomon.forced_level() if self.slomon is not None \
+            else None
         if fill >= self.config.overload_watermark:
             return 0.0, "jnp-fft", "overload:jnp-fft"
+        if slo == "jnp-fft":
+            return 0.0, "jnp-fft", "slo:jnp-fft"
         if fill >= self.config.pressure_watermark:
             return 0.0, None, "pressure:window"
+        if slo == "window":
+            return 0.0, None, "slo:window"
         return self.config.max_wait_ms / 1e3, None, None
 
     # ------------------------------------------------------- workers
@@ -512,6 +582,7 @@ class Dispatcher:
             if req is _CLOSE:
                 closing = True
                 continue
+            req.t_dequeue = clock()
             batch = [req]
             window_s, rung, level = self._admission(group, q)
             if closing:
@@ -530,6 +601,7 @@ class Dispatcher:
                 if nxt is _CLOSE:
                     closing = True
                     continue  # keep collecting what is already queued
+                nxt.t_dequeue = clock()
                 batch.append(nxt)
             if level is not None:
                 metrics.inc("pifft_serve_admission_degrade_total",
@@ -546,14 +618,25 @@ class Dispatcher:
         failover)."""
         return False
 
+    @staticmethod
+    def _batch_links(batch) -> Optional[list]:
+        """The fan-in edge: the live request span ids this batch
+        serves — recorded on the ONE serve_batch span so Perfetto can
+        draw request→batch arrows (obs/trace.py)."""
+        links = [r.trace.span_id for r in batch
+                 if r.trace.live and r.trace.sampled]
+        return links or None
+
     async def _invoke_batch(self, group: GroupKey, batch, rung,
-                            device=None):
+                            device=None, level=None):
         """One coalesced kernel invocation in the executor (the event
         loop keeps admitting mid-kernel)."""
         return await asyncio.get_running_loop().run_in_executor(
             None,
             functools.partial(self.runner.run, group,
-                              [(r.xr, r.xi) for r in batch], rung))
+                              [(r.xr, r.xi) for r in batch], rung,
+                              rung_tag=level,
+                              links=self._batch_links(batch)))
 
     async def _run_batch(self, group: GroupKey, batch, rung, level,
                          device=None):
@@ -561,7 +644,7 @@ class Dispatcher:
         t_start = clock()
         try:
             outcome = await self._invoke_batch(group, batch, rung,
-                                               device)
+                                               device, level)
         except Exception as e:
             if self._is_device_failure(e):
                 raise  # the mesh's failover path owns these
@@ -597,6 +680,7 @@ class Dispatcher:
         tags = ([level] if level and rung is None else []) \
             + list(outcome.degrade)
         device_id = getattr(device, "id", None)
+        t_done = clock()
         for i, r in enumerate(batch):
             # the batch tags plus this request's OWN trail (failover
             # re-routes tag the request, not the batch it lands in)
@@ -610,8 +694,24 @@ class Dispatcher:
                 batch_size=outcome.size,
                 plan_variant=outcome.plan_variant,
                 degraded=degraded, degrade=rtags, device=device_id)
+            if r.trace.live:
+                # the request's span tree (obs/trace.py): queue/window/
+                # compute children summing exactly to this row's
+                # total, degrade tags and re-route hops as instants —
+                # emitted when head-sampled, ALWAYS when degraded (the
+                # tail upgrade), and returned on the response either
+                # way so the caller keeps the correlation ids
+                recs = trace_mod.request_span_records(
+                    r.trace, label=label, rid=r.rid,
+                    t_submit=r.t_submit, t_dequeue=r.t_dequeue,
+                    t_exec=t_start, compute_s=outcome.compute_s,
+                    t_done=t_done, tags=rtags, marks=r.marks,
+                    device=device_id, cell={"n": group.n})
+                emitted = trace_mod.emit_request_trace(
+                    r.trace, recs, forced=degraded)
+                resp.trace = trace_mod.wire_tree(r.trace, recs, emitted)
             self.stats.record(label, queue_s, outcome.compute_s,
-                              degraded=degraded)
+                              degraded=degraded, device=device_id)
             metrics.observe("pifft_serve_queue_wait_seconds", queue_s,
                             shape=label)
             if degraded:
@@ -622,8 +722,24 @@ class Dispatcher:
                         compute_ms=round(outcome.compute_s * 1e3, 4),
                         batch_size=outcome.size, degraded=degraded,
                         **({"degrade": rtags} if rtags else {}),
-                        **({"device": device_id} if device_id else {}))
+                        **({"device": device_id} if device_id else {}),
+                        **({"trace": r.trace.trace_id}
+                           if r.trace.live else {}))
+            if self.slomon is not None:
+                # the burn monitor judges the FULL server residence
+                # time (submit -> delivery): staging, retries and
+                # injected stalls all count — the latency the caller
+                # actually experienced, not just the split the row
+                # itemizes
+                self.slomon.observe(
+                    group.op, label, (t_done - r.t_submit) * 1e3,
+                    t=t_done)
             if not r.future.done():
                 r.future.set_result(resp)
+        if self.slomon is not None:
+            # one evaluation per delivered batch: the burn gauges stay
+            # live and the forced level the NEXT admission reads is
+            # current — recovery is as automatic as the alert
+            self.slomon.evaluate(t=t_done)
         metrics.observe("pifft_serve_compute_seconds", outcome.compute_s,
                         shape=label)

@@ -1,0 +1,348 @@
+"""``make obs-live-smoke`` — the live-telemetry-plane CI gate
+(docs/OBSERVABILITY.md, "The live plane").
+
+One process, four acts, every claim asserted:
+
+0. **The OFF state**: with observability disabled, traced submits mint
+   the shared NOOP trace, add ZERO events, and responses carry no
+   trace — the no-op-span contract extended to trace mint.
+1. **End-to-end tracing over the socket**: a request with no trace
+   field gets a MINTED trace whose response span tree has
+   queue/window/compute children summing (±5%) to the SLO row's
+   total, every child parented on the request root; a client-supplied
+   trace id ROUND-TRIPS; the coalescing burst's ``serve_batch`` span
+   carries ``links`` whose count equals the coalesced request count;
+   ``/metrics`` and ``/healthz`` answer DURING the load and ``/slo``
+   reports the sliding-window rows.
+2. **Failover under one trace**: a mid-run device kill on a virtual
+   mesh re-routes the in-flight request to a survivor and its span
+   tree carries the ``failover:<device>`` hop — same trace id,
+   explicitly visible re-route.
+3. **Burn-rate alerting with teeth**: under injected serve-path
+   latency every request blows the declared p99 target, the monitor
+   fires a schema'd ``slo_alert`` and the NEXT admission serves the
+   cheap rung tagged ``slo:jnp-fft`` with ``degraded: true``; when
+   the injection stops the burn drains, the alert RESOLVES, and the
+   forced level clears — recovery as automatic as the alarm.
+
+Plus the stream-wide invariant every gate in this project ends on:
+zero schema-invalid events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+
+import numpy as np
+
+from .. import obs
+from ..obs import events as obs_events
+from ..obs import metrics
+from ..obs import trace as trace_mod
+from ..obs.http import TelemetryServer, fetch_json, fetch_text
+from ..obs.slomon import Objective, SloMonitor
+from ..resilience.inject import inject
+from .batcher import GroupKey
+from .dispatcher import Dispatcher, ServeConfig
+from .protocol import handle_connection, request_over_socket
+from .shapes import ShapeSpec
+
+#: the traced burst: enough concurrency to coalesce deterministically
+BURST_K = 8
+
+#: the declared objective for act 3: tight enough that the injected
+#: stall (STALL_S) always violates it, loose enough that the healthy
+#: CPU path never does
+TARGET_MS = 25.0
+STALL_S = 0.06
+#: act-3 burn windows: CI-sized (seconds) — the production default is
+#: 5/60 s (obs/slomon.py)
+WINDOWS = (0.4, 1.0)
+
+
+def _sum_phases(tree: dict) -> float:
+    return sum(s["dur_ms"] for s in tree.get("spans", ())
+               if s["name"] in ("queue", "window", "compute"))
+
+
+def _act0_disabled(problems: list) -> None:
+    """Observability off: NOOP trace, zero events, no response trace."""
+    assert not obs.enabled()
+    if trace_mod.mint() is not trace_mod.NOOP_TRACE:
+        problems.append("disabled mint() is not the NOOP singleton")
+
+    async def run():
+        async with Dispatcher(ServeConfig()) as d:
+            xr = np.random.default_rng(0).standard_normal(256) \
+                .astype(np.float32)
+            return await d.submit(xr, np.zeros_like(xr), domain="r2c")
+
+    resp = asyncio.run(run())
+    if resp.trace is not None:
+        problems.append(f"disabled-path response carries a trace: "
+                        f"{resp.trace}")
+    if obs.snapshot():
+        problems.append(f"disabled path emitted "
+                        f"{len(obs.snapshot())} event(s); want 0")
+    snap = metrics.snapshot()
+    if snap["counters"] or snap["gauges"]:
+        problems.append(f"disabled path touched the metrics registry: "
+                        f"{snap}")
+
+
+async def _act1_socket(problems: list) -> None:
+    """Minted + round-tripped traces over the wire, batch links,
+    live endpoints under load."""
+    rng = np.random.default_rng(1)
+    spec = ShapeSpec(n=1024)
+    cfg = ServeConfig(max_wait_ms=25.0)
+    async with Dispatcher(cfg, [spec]) as d:
+        server = await asyncio.start_server(
+            lambda r, w: handle_connection(d, r, w), "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        telemetry = TelemetryServer(d).start()
+        try:
+            def planes():
+                return (rng.standard_normal(spec.n).astype(np.float32),
+                        rng.standard_normal(spec.n).astype(np.float32))
+
+            # --- the coalescing burst, no trace field -> minted
+            burst = [planes() for _ in range(BURST_K)]
+            replies = await asyncio.gather(*[
+                request_over_socket("127.0.0.1", port, xr, xi)
+                for xr, xi in burst])
+            loop = asyncio.get_running_loop()
+            # --- /metrics + /healthz DURING load: more traffic in
+            # flight while the endpoints answer from another thread
+            inflight = asyncio.gather(*[
+                request_over_socket("127.0.0.1", port, *planes())
+                for _ in range(4)])
+            base = telemetry.url()
+            prom = await loop.run_in_executor(
+                None, fetch_text, f"{base}/metrics")
+            health = await loop.run_in_executor(
+                None, fetch_json, f"{base}/healthz")
+            slo_doc = await loop.run_in_executor(
+                None, fetch_json, f"{base}/slo")
+            await inflight
+            if "pifft_serve_requests_total" not in prom:
+                problems.append("/metrics lacks the serve counters "
+                                "during load")
+            if not health.get("ok"):
+                problems.append(f"/healthz not ok during load: "
+                                f"{health}")
+            if "queues" not in health:
+                problems.append(f"/healthz lacks queue depths: "
+                                f"{sorted(health)}")
+            label = GroupKey(n=spec.n).label()
+            if label not in (slo_doc.get("rows") or {}):
+                problems.append(f"/slo lacks the served shape "
+                                f"{label}: {sorted(slo_doc.get('rows') or {})}")
+
+            # --- minted trace: span tree sums to the SLO row total
+            for reply in replies[:1]:
+                tree = reply.get("trace")
+                if not tree or not tree.get("trace_id"):
+                    problems.append(f"no minted trace on the wire "
+                                    f"reply: {sorted(reply)}")
+                    break
+                if not tree.get("spans"):
+                    problems.append("minted trace carries no span "
+                                    "tree (sampling should be on)")
+                    break
+                total = reply["queue_wait_ms"] + reply["compute_ms"]
+                got = _sum_phases(tree)
+                if total > 0 and abs(got - total) > 0.05 * total:
+                    problems.append(
+                        f"span tree sums to {got:.4f} ms, SLO row "
+                        f"total is {total:.4f} ms (>5% apart)")
+                root = tree["span_id"]
+                for s in tree["spans"]:
+                    if s["name"] != "serve_request" \
+                            and s.get("parent") != root:
+                        problems.append(f"child {s['name']} parented "
+                                        f"on {s.get('parent')}, want "
+                                        f"root {root}")
+
+            # --- client-supplied trace id round-trips
+            supplied = {"trace_id": "feedfacecafebeef0011223344556677",
+                        "span_id": "c11e9751"}
+            reply = await request_over_socket(
+                "127.0.0.1", port, *planes(), trace=supplied)
+            tree = reply.get("trace") or {}
+            if tree.get("trace_id") != supplied["trace_id"]:
+                problems.append(
+                    f"client trace id did not round-trip: sent "
+                    f"{supplied['trace_id']}, got "
+                    f"{tree.get('trace_id')}")
+
+            # --- batch fan-in links == coalesced request count
+            batch_spans = [s for s in obs_events.span_snapshot()
+                           if s.get("name") == "serve_batch"
+                           and (s.get("cell") or {}).get("n") == spec.n]
+            if not batch_spans:
+                problems.append("no serve_batch spans recorded")
+            linked = sum(len(s.get("links") or ()) for s in batch_spans)
+            served = sum((s.get("cell") or {}).get("size", 0)
+                         for s in batch_spans)
+            if linked != served:
+                problems.append(
+                    f"batch links ({linked}) != coalesced request "
+                    f"count ({served}) — the fan-in edge is lossy")
+            if not any(len(s.get("links") or ()) > 1
+                       for s in batch_spans):
+                problems.append("no batch carried >1 link — the burst "
+                                "never coalesced; the links assertion "
+                                "proved nothing")
+        finally:
+            telemetry.stop()
+            server.close()
+            await server.wait_closed()
+
+
+async def _act2_failover(problems: list) -> None:
+    """A mid-run device kill: the re-routed request's span tree shows
+    the failover hop, under the SAME trace."""
+    from .loadgen import _group_for
+    from .mesh import MeshConfig, MeshDispatcher
+
+    rng = np.random.default_rng(2)
+    specs = [ShapeSpec(n=512, layout=lay) for lay in ("natural", "pi")]
+    cfg = MeshConfig(devices=4, max_wait_ms=2.0)
+    async with MeshDispatcher(cfg, specs) as mesh:
+        spec = specs[0]
+        xr = rng.standard_normal(spec.n).astype(np.float32)
+        xi = rng.standard_normal(spec.n).astype(np.float32)
+        # prime: pay the compile before the kill
+        await mesh.submit(xr, xi, layout=spec.layout)
+        victim = mesh.router.route(_group_for(spec), record=False)
+        with inject(victim.site, "permanent", count=1):
+            resp = await mesh.submit(xr, xi, layout=spec.layout)
+        hop = f"failover:{victim.id}"
+        if hop not in resp.degrade:
+            problems.append(f"kill did not failover-tag the response "
+                            f"({resp.degrade})")
+        tree = resp.trace or {}
+        if not tree.get("spans"):
+            problems.append("failover response carries no span tree "
+                            "(tail upgrade should force emission)")
+            return
+        hops = [s for s in tree["spans"] if s["name"] == hop]
+        if not hops:
+            problems.append(
+                f"span tree lacks the {hop} re-route span: "
+                f"{[s['name'] for s in tree['spans']]}")
+        # the hop rides the request's OWN trace: every emitted record
+        # of this tree carries the same trace id
+        recs = [s for s in obs_events.span_snapshot()
+                if s.get("trace") == tree.get("trace_id")]
+        if not any(s.get("name") == hop for s in recs):
+            problems.append(f"emitted stream lacks the {hop} span "
+                            f"under trace {tree.get('trace_id')}")
+
+
+async def _act3_burn(problems: list) -> None:
+    """Injected latency -> slo_alert fires -> slo:jnp-fft demotion,
+    tagged; injection stops -> burn drains -> alert resolves."""
+    monitor = SloMonitor(
+        [Objective("fft-p99", TARGET_MS, error_budget=0.05,
+                   match="fft")],
+        windows=WINDOWS)
+    rng = np.random.default_rng(3)
+    spec = ShapeSpec(n=512)
+    cfg = ServeConfig(max_wait_ms=0.5, slo_objectives=monitor)
+    async with Dispatcher(cfg, [spec]) as d:
+        xr = rng.standard_normal(spec.n).astype(np.float32)
+        xi = rng.standard_normal(spec.n).astype(np.float32)
+        await d.submit(xr, xi)  # prime the compile outside the clock
+
+        async def drive(count):
+            out = []
+            for _ in range(count):
+                out.append(await d.submit(xr, xi))
+            return out
+
+        with inject("serve", "stall", prob=1.0, stall_s=STALL_S):
+            # burn both windows: every request blows the target
+            await drive(12)
+            if not monitor.alerting().get("fft-p99"):
+                problems.append("sustained burn never fired the alert")
+                return
+            demoted = await drive(3)
+        tagged = [r for r in demoted
+                  if r.degraded and "slo:jnp-fft" in r.degrade]
+        if not tagged:
+            problems.append(
+                f"alert did not demote: post-alert responses carry "
+                f"{[r.degrade for r in demoted]} (want slo:jnp-fft, "
+                f"degraded true)")
+        # the demoted rung skips the injection site, so latency is
+        # already healthy; keep serving until the windows drain
+        for _ in range(40):
+            await drive(2)
+            await asyncio.sleep(WINDOWS[0] / 4)
+            if not monitor.alerting().get("fft-p99"):
+                break
+        if monitor.alerting().get("fft-p99"):
+            problems.append("alert never resolved after the injection "
+                            "stopped")
+        if monitor.forced_level() is not None:
+            problems.append(f"forced level {monitor.forced_level()!r} "
+                            f"outlived the burn")
+        recovered = await d.submit(xr, xi)
+        if any(str(t).startswith("slo:") for t in recovered.degrade):
+            problems.append(f"post-recovery response still slo-tagged: "
+                            f"{recovered.degrade}")
+    alerts = [e for e in obs.snapshot() if e.get("kind") == "slo_alert"]
+    states = [e["payload"]["state"] for e in alerts]
+    if "firing" not in states or "resolved" not in states:
+        problems.append(f"slo_alert stream incomplete: {states}")
+
+
+def main(argv=None) -> int:
+    problems: list = []
+    _act0_disabled(problems)
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    try:
+        asyncio.run(_act1_socket(problems))
+        asyncio.run(_act2_failover(problems))
+        asyncio.run(_act3_burn(problems))
+        snapshot = obs.snapshot()
+        bad = 0
+        for rec in snapshot:
+            for p in obs_events.validate_event(rec):
+                bad += 1
+                problems.append(f"event seq={rec.get('seq')}: {p}")
+        summary = {
+            "ok": not problems,
+            "events": len(snapshot),
+            "schema_invalid_events": bad,
+            "slo_alerts": sum(1 for e in snapshot
+                              if e.get("kind") == "slo_alert"),
+            "traced_requests": sum(
+                1 for s in obs_events.span_snapshot()
+                if s.get("name") == "serve_request"),
+            "problems": problems,
+        }
+    finally:
+        if owned:
+            obs.disable()
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    for p in problems:
+        print(f"# FAIL: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("# obs live smoke ok: minted + round-tripped traces, "
+          "fan-in links, live endpoints under load, failover hop "
+          "under one trace, burn-rate alert fired -> demoted -> "
+          "recovered, zero schema-invalid events", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,9 +31,9 @@ from typing import Optional
 import numpy as np
 
 from ..obs.spans import clock
+from ..utils.stats import percentile_or_none
 from .batcher import GroupKey
 from .dispatcher import Dispatcher, QueueFull, ServeError
-from .slo import percentile_or_none
 
 
 def verify_response(n: int, layout: str, domain: str, inverse: bool,

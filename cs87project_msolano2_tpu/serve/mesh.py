@@ -49,6 +49,7 @@ import threading
 from typing import Optional
 
 from ..obs import events, metrics
+from ..obs import trace as trace_mod
 from ..obs.spans import clock
 from ..plans.core import warn
 from ..resilience import CollectiveAborted, CollectiveTimeout, classify
@@ -61,6 +62,7 @@ from .dispatcher import (
     _CLOSE,
     Dispatcher,
     DispatcherClosed,
+    QueueFull,
     Request,
     ServeConfig,
     ServeError,
@@ -241,25 +243,38 @@ class MeshDispatcher(Dispatcher):
                      domain: str = "c2c",
                      priority: str = "normal",
                      tenant: str = "default",
-                     op: str = "fft"):
+                     op: str = "fft",
+                     trace=None):
         """:meth:`Dispatcher.submit`, mesh-routed: validation and the
         class-aware bounded admission are the shared base logic; the
         queue is the ROUTED device's, and the tenant-quota layer runs
         before enqueue (released when the response future resolves,
         whatever it resolves to).  Op-tagged requests (docs/APPS.md)
         route exactly like transforms — the GroupKey carries the op,
-        so warmth and affinity are op-aware for free."""
+        so warmth and affinity are op-aware for free.  The trace
+        context (obs/trace.py) is minted/adopted exactly like the
+        base dispatcher's — placement, re-routes and the device all
+        land in the request's span tree."""
         if self._closing:
             raise DispatcherClosed("dispatcher is shut down")
         xr, xi, group = self._validated(xr, xi, layout, precision,
                                         inverse, domain, priority, op)
         self._check_served(group)
+        ctx = trace_mod.ensure(trace)
+        t_submit = clock()
         # choose first, RECORD only after admission passes: a shed
         # request must not inflate the placement counter the
         # affinity assertions read
         device, why, warmth, load = self.router.choose(group)
         q = self._ensure_device_worker(device, group)
-        self._admit(group, q, priority)
+        try:
+            self._admit(group, q, priority)
+        except QueueFull:
+            trace_mod.shed_record(ctx, label=group.label(),
+                                  t_submit=t_submit,
+                                  reason="queue_full",
+                                  priority=priority)
+            raise
         try:
             self.admission.charge(
                 tenant, self._retry_after_ms(group, q, priority))
@@ -270,12 +285,15 @@ class MeshDispatcher(Dispatcher):
             label = group.label()
             self.stats.record_rejected(label)
             metrics.inc("pifft_serve_rejected_total", shape=label)
+            trace_mod.shed_record(ctx, label=label, t_submit=t_submit,
+                                  reason="tenant_quota",
+                                  priority=priority)
             raise
         self.router.record_placement(device, group, why, warmth, load)
         req = Request(rid=next(self._rid), group=group, xr=xr, xi=xi,
-                      t_submit=clock(),
+                      t_submit=t_submit,
                       future=asyncio.get_running_loop().create_future(),
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant, trace=ctx)
         req.future.add_done_callback(
             lambda _f, t=tenant: self.admission.release(t))
         metrics.inc("pifft_serve_requests_total", shape=group.label())
@@ -303,7 +321,7 @@ class MeshDispatcher(Dispatcher):
                                 CollectiveTimeout))
 
     async def _invoke_batch(self, group: GroupKey, batch, rung,
-                            device=None):
+                            device=None, level=None):
         """One batch on `device`: the per-device injection probe fires
         first (a fault there is the DEVICE dying, not the kernel —
         the batcher's fallback rungs never see it), then the device's
@@ -312,6 +330,7 @@ class MeshDispatcher(Dispatcher):
         ABORTED (CollectiveAborted) instead of wedging its worker —
         the r05 lesson applied to serving (docs/MULTICHIP.md)."""
         planes = [(r.xr, r.xi) for r in batch]
+        links = self._batch_links(batch)
         cfg = self.config
 
         def execute():
@@ -323,7 +342,8 @@ class MeshDispatcher(Dispatcher):
                 raise DeviceFailure(device.id, e) from e
             t0 = clock()
             try:
-                return device.runner.run(group, planes, rung)
+                return device.runner.run(group, planes, rung,
+                                         rung_tag=level, links=links)
             finally:
                 dt = clock() - t0
                 with device._busy_lock:
@@ -458,11 +478,19 @@ class MeshDispatcher(Dispatcher):
         if not requests:
             return
         moved = stranded = 0
+        t_move = clock()
         for req in requests:
             if req.future.done():
                 continue
             if tag:
                 req.trail.append(f"{reason}:{from_device.id}")
+            if req.trace.live:
+                # the re-route is an EXPLICIT span in the request's
+                # own trace (obs/trace.py): the hop survives the
+                # re-enqueue because it rides the Request, and a
+                # failover-tagged tree is always emitted (the tail
+                # upgrade), so a post-kill p99 outlier shows its hop
+                req.marks.append((f"{reason}:{from_device.id}", t_move))
             try:
                 target = self.router.route(req.group,
                                            exclude={from_device.id},

@@ -22,6 +22,18 @@ silently served as a bare transform.
 classes and per-tenant quotas (docs/SERVING.md, mesh section); both
 default to the unprivileged values when omitted.
 
+``trace`` is the optional trace-context field (docs/OBSERVABILITY.md,
+"The live plane"): ``{"trace_id": "...", "span_id": "..."}`` (or the
+compact ``"<trace_id>-<span_id>"`` string) continues the CLIENT's
+trace — its trace_id round-trips on the response and its span_id
+becomes the server-side request span's parent.  Omitted, the
+dispatcher mints a fresh trace.  Successful responses carry
+``trace`` back: the ids always, and the request's span tree
+(queue/window/compute children, degrade/failover hops) when the
+trace was sampled or tail-upgraded.  A malformed trace field mints
+instead of failing — a bad trace header must never fail the request
+it describes.
+
 Responses mirror :meth:`~.dispatcher.Response.to_record` (with the
 result planes as ``yr``/``yi`` float lists) on success, or
 
@@ -114,7 +126,8 @@ async def _handle_one(dispatcher: Dispatcher, msg: dict) -> dict:
             domain=msg.get("domain", "c2c"),
             priority=msg.get("priority") or "normal",
             tenant=msg.get("tenant") or "default",
-            op=op)
+            op=op,
+            trace=msg.get("trace"))
     except ServeError as e:
         return {"id": rid, "ok": False, "error": e.to_record()}
     rec = resp.to_record(arrays=True)
@@ -237,11 +250,13 @@ async def request_over_socket(host: str, port: int, xr, xi=None,
                               precision: Optional[str] = None,
                               inverse: bool = False,
                               domain: str = "c2c",
-                              op: str = "fft") -> dict:
+                              op: str = "fft",
+                              trace=None) -> dict:
     """Client helper: one request over a fresh connection (tests and
     the CLI demo; a real client keeps the connection open).  `op`
     rides the frame's op field — "fft" (default) or the spectral ops
-    "conv"/"corr"/"solve" (docs/APPS.md)."""
+    "conv"/"corr"/"solve" (docs/APPS.md); `trace` the optional
+    trace-context field (module docstring)."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
         frame = {
@@ -251,6 +266,8 @@ async def request_over_socket(host: str, port: int, xr, xi=None,
             "inverse": inverse, "domain": domain}
         if xi is not None:
             frame["xi"] = np.asarray(xi, np.float64).tolist()
+        if trace is not None:
+            frame["trace"] = trace
         writer.write(encode_frame(frame))
         await writer.drain()
         reply = await read_frame(reader)

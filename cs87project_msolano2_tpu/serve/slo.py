@@ -12,29 +12,40 @@ round record.
 
 Percentiles use the nearest-rank method on the recorded population —
 no interpolation, so a p99 is always a latency that actually happened.
+The estimator itself is the ONE shared implementation in
+``utils/stats.py`` (property-tested against numpy's nearest-rank
+mode); this module re-exports it so serve-side callers keep their
+import path.
+
+Beyond the end-of-run summary, :class:`LatencyStats` now keeps a
+**streaming reservoir**: a bounded per-label deque of timestamped
+samples over a sliding window, so the live ``/slo`` endpoint
+(docs/OBSERVABILITY.md, "The live plane") reports p50/p99 per
+(op, shape, domain, precision, device) AS THE MESH RUNS — not only
+when a run ends.
 """
 
 from __future__ import annotations
 
 import threading
+from collections import deque
+from typing import Optional
 
+from ..obs.spans import clock
+from ..utils.stats import percentile_nearest_rank, percentile_or_none
 
-def percentile(values, q: float) -> float:
-    """Nearest-rank percentile (q in [0, 100]) of a non-empty
-    sequence."""
-    if not values:
-        raise ValueError("percentile of an empty population")
-    ordered = sorted(values)
-    rank = max(1, -(-len(ordered) * q // 100))  # ceil without floats
-    return ordered[int(min(rank, len(ordered))) - 1]
+__all__ = ["LatencyStats", "format_summary", "percentile",
+           "percentile_or_none"]
 
+#: re-export: the shared nearest-rank estimator (utils/stats.py)
+percentile = percentile_nearest_rank
 
-def percentile_or_none(values, q: float):
-    """:func:`percentile`, or None for an empty population — the
-    loadgen row contract: a cell where every arrival was rejected (or
-    none were made) keeps its full row schema with null latency
-    fields instead of crashing the summary."""
-    return percentile(values, q) if values else None
+#: the live window the /slo endpoint reports over (seconds)
+DEFAULT_WINDOW_S = 60.0
+
+#: reservoir bound per label: a hot shape cannot grow the live table
+#: without limit — the oldest samples age out in O(1)
+WINDOW_MAX_SAMPLES = 4096
 
 
 class LatencyStats:
@@ -43,11 +54,17 @@ class LatencyStats:
     dispatcher records from executor threads while summaries read from
     the event loop."""
 
-    def __init__(self):
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 window_max: int = WINDOW_MAX_SAMPLES):
         self._lock = threading.Lock()
         self._samples: dict = {}    # label -> list of sample dicts
         self._counts: dict = {}     # label -> {"requests", "batches",
         #                                       "degraded", "rejected"}
+        #: the streaming reservoir behind the live /slo endpoint:
+        #: window key -> deque[(t, queue_s, compute_s, degraded)]
+        self.window_s = float(window_s)
+        self._window_max = int(window_max)
+        self._window: dict = {}
 
     def _bucket(self, label: str) -> dict:
         c = self._counts.get(label)
@@ -58,7 +75,14 @@ class LatencyStats:
         return c
 
     def record(self, label: str, queue_wait_s: float, compute_s: float,
-               degraded: bool = False) -> None:
+               degraded: bool = False,
+               device: Optional[str] = None) -> None:
+        """One completed request.  `device` extends the live-window key
+        (``label@device``) so the /slo table separates the mesh
+        devices serving one shape — the per-(op, shape, domain,
+        precision, device) contract (docs/OBSERVABILITY.md)."""
+        now = clock()
+        wkey = label if device is None else f"{label}@{device}"
         with self._lock:
             c = self._bucket(label)
             c["requests"] += 1
@@ -67,6 +91,11 @@ class LatencyStats:
             self._samples[label].append(
                 {"queue": queue_wait_s, "compute": compute_s,
                  "total": queue_wait_s + compute_s})
+            dq = self._window.get(wkey)
+            if dq is None:
+                dq = self._window[wkey] = deque(
+                    maxlen=self._window_max)
+            dq.append((now, queue_wait_s, compute_s, degraded))
 
     def record_batch(self, label: str) -> None:
         with self._lock:
@@ -93,6 +122,39 @@ class LatencyStats:
                         row[f"{part}_p99_ms"] = round(
                             percentile(vals, 99) * 1e3, 4)
                 out[label] = row
+        return out
+
+    def window_summary(self,
+                       window_s: Optional[float] = None) -> dict:
+        """The LIVE table: per window key (``label`` or
+        ``label@device``), counts and p50/p99 of queue/compute/total
+        (ms) over the trailing `window_s` (default: the stats'
+        configured window).  Keys whose window emptied report a
+        zero-count row (the shape was served, just not recently) —
+        the /slo endpoint's contract is the same stable schema the
+        loadgen rows keep."""
+        horizon = clock() - (window_s or self.window_s)
+        out = {}
+        with self._lock:
+            for key, dq in self._window.items():
+                # prune in place: aged samples never return
+                while dq and dq[0][0] < horizon:
+                    dq.popleft()
+                live = list(dq)
+                row = {"requests": len(live),
+                       "degraded": sum(1 for s in live if s[3])}
+                for part, idx in (("queue", 1), ("compute", 2)):
+                    vals = [s[idx] for s in live]
+                    for q in (50, 99):
+                        v = percentile_or_none(vals, q)
+                        row[f"{part}_p{q}_ms"] = round(v * 1e3, 4) \
+                            if v is not None else None
+                totals = [s[1] + s[2] for s in live]
+                for q in (50, 99):
+                    v = percentile_or_none(totals, q)
+                    row[f"total_p{q}_ms"] = round(v * 1e3, 4) \
+                        if v is not None else None
+                out[key] = row
         return out
 
 

@@ -1,0 +1,44 @@
+"""Shared order statistics: THE nearest-rank percentile.
+
+Three layers grew their own copies of the same estimator — the serve
+SLO tables (`serve/slo.py`), the load generator's row schema
+(`serve/loadgen.py::percentile_or_none`) and the analyze layer's tail
+tables — and three copies of one formula is how a p99 silently means
+three different things.  This module is the single implementation;
+the consumers re-export it (so existing import paths keep working)
+and the property tests pin it against ``numpy.percentile``'s
+``method="inverted_cdf"`` — the textbook nearest-rank definition:
+
+    value at rank ceil(q/100 * N) of the sorted population (1-based)
+
+No interpolation: a reported p99 is always a latency that actually
+happened, which is the property the SLO rows promise
+(docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+__all__ = ["percentile_nearest_rank", "percentile_or_none"]
+
+
+def percentile_nearest_rank(values, q: float) -> float:
+    """Nearest-rank percentile (``q`` in [0, 100]) of a non-empty
+    sequence.  Raises ``ValueError`` on an empty population or an
+    out-of-range ``q`` — an SLO over nothing is a bug at the caller,
+    never a silent 0."""
+    if not values:
+        raise ValueError("percentile of an empty population")
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile q={q} outside [0, 100]")
+    ordered = sorted(values)
+    rank = max(1, -(-len(ordered) * q // 100))  # ceil without floats
+    return ordered[int(min(rank, len(ordered))) - 1]
+
+
+def percentile_or_none(values, q: float):
+    """:func:`percentile_nearest_rank`, or None for an empty
+    population — the loadgen/live-table row contract: a cell where
+    every arrival was rejected (or none were made) keeps its full row
+    schema with null latency fields instead of crashing the
+    summary."""
+    return percentile_nearest_rank(values, q) if values else None

@@ -244,8 +244,15 @@ def test_buffer_overflow_drops_oldest_and_counts(tmp_path):
         obs.emit("tick", i=i)
     snap = events.snapshot()
     assert len(snap) == 4
-    assert [r["payload"]["i"] for r in snap] == [2, 3, 4, 5]
-    assert events.dropped() == 2
+    # the first overflow emits ONE warn event (mirrored diagnostics),
+    # which itself rides the bounded buffer — the newest ticks survive
+    ticks = [r["payload"]["i"] for r in snap if r["kind"] == "tick"]
+    assert ticks == [3, 4, 5]
+    assert sum(1 for r in snap if r["kind"] == "warn") == 1
+    # 2 tick drops + the warn's own displacement, all counted — and
+    # exported live (pifft_obs_dropped_total, docs/OBSERVABILITY.md)
+    assert events.dropped() == 3
+    assert metrics.counter_value("pifft_obs_dropped_total") == 3
     obs.disable()
     metrics.reset()
 

@@ -1,0 +1,802 @@
+"""The live telemetry plane (docs/OBSERVABILITY.md, "The live
+plane"): trace-context propagation (minted/adopted/NOOP, span trees,
+fan-in links, flow-event export), the streaming /metrics + /healthz +
+/slo endpoints, burn-rate SLO alerting wired into the degrade chain,
+the shared nearest-rank percentile (property-tested against numpy),
+Prometheus label escaping, and the dropped-event surfacing."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs
+from cs87project_msolano2_tpu.obs import events, export, metrics
+from cs87project_msolano2_tpu.obs import trace as trace_mod
+from cs87project_msolano2_tpu.obs.slomon import (
+    Objective,
+    SloMonitor,
+    load_objectives,
+)
+from cs87project_msolano2_tpu.utils.stats import (
+    percentile_nearest_rank,
+    percentile_or_none,
+)
+
+
+@pytest.fixture
+def obs_run():
+    rid = obs.enable()
+    yield rid
+    obs.disable()
+    metrics.reset()
+
+
+@pytest.fixture(autouse=True)
+def _never_leak_enabled_state():
+    yield
+    if obs.enabled():
+        obs.disable()
+        metrics.reset()
+
+
+# -------------------------------------------------------- trace context
+
+
+def test_disabled_trace_mint_is_noop_singleton():
+    """The no-op-span pattern extended to trace mint: disabled
+    observability returns ONE shared instance, no allocation."""
+    assert not obs.enabled()
+    t1, t2 = trace_mod.mint(), trace_mod.ensure()
+    assert t1 is t2 is trace_mod.NOOP_TRACE
+    assert not t1.live
+    assert trace_mod.adopt({"trace_id": "abc"}) is trace_mod.NOOP_TRACE
+    assert t1.child() is trace_mod.NOOP_TRACE
+
+
+def test_mint_child_and_adopt(obs_run):
+    t = trace_mod.mint()
+    assert t.live and t.sampled and t.parent_id is None
+    c = t.child()
+    assert c.trace_id == t.trace_id
+    assert c.parent_id == t.span_id
+    assert c.span_id != t.span_id
+    # wire adoption: client trace id kept, client span becomes parent
+    w = trace_mod.adopt({"trace_id": "feedface", "span_id": "c11e"})
+    assert w.trace_id == "feedface" and w.parent_id == "c11e"
+    assert trace_mod.adopt("feedface-c11e").parent_id == "c11e"
+    # malformed wire fields mint instead of raising
+    assert trace_mod.adopt({"bogus": 1}).live
+    assert trace_mod.adopt("").live
+
+
+def test_sample_rate_env(obs_run, monkeypatch):
+    monkeypatch.setenv(trace_mod.SAMPLE_ENV, "0")
+    assert not trace_mod.mint().sampled
+    monkeypatch.setenv(trace_mod.SAMPLE_ENV, "1.0")
+    assert trace_mod.mint().sampled
+    monkeypatch.setenv(trace_mod.SAMPLE_ENV, "not-a-number")
+    assert trace_mod.sample_rate() == 1.0  # warned fallback, not a kill
+
+
+def test_contextvar_carry(obs_run):
+    t = trace_mod.mint()
+    assert trace_mod.current() is None
+    with trace_mod.use(t):
+        assert trace_mod.current() is t
+        child = trace_mod.ensure()
+        assert child.trace_id == t.trace_id
+        assert child.parent_id == t.span_id
+    assert trace_mod.current() is None
+
+
+def test_request_span_records_sum_exactly(obs_run):
+    t = trace_mod.mint()
+    recs = trace_mod.request_span_records(
+        t, label="1024:natural:split3", rid=7, t_submit=10.0,
+        t_dequeue=10.002, t_exec=10.005, compute_s=0.003,
+        t_done=10.0085, tags=["slo:jnp-fft"],
+        marks=[("failover:vdev2", 10.004)])
+    names = [r["name"] for r in recs]
+    assert names == ["serve_request", "queue", "window", "compute",
+                     "degrade:slo:jnp-fft", "failover:vdev2"]
+    by = {r["name"]: r for r in recs}
+    assert by["queue"]["dur_s"] == pytest.approx(0.002)
+    assert by["window"]["dur_s"] == pytest.approx(0.003)
+    assert by["compute"]["dur_s"] == pytest.approx(0.003)
+    # every child parented on the root span id
+    for r in recs[1:]:
+        assert r["parent_sid"] == t.span_id
+        assert r["trace"] == t.trace_id
+
+
+def test_emit_respects_sampling_and_tail_upgrade(obs_run):
+    unsampled = trace_mod.TraceContext("tid", "sid", sampled=False)
+    recs = trace_mod.request_span_records(
+        unsampled, label="l", rid=0, t_submit=0.0, t_dequeue=0.0,
+        t_exec=0.0, compute_s=0.0, t_done=0.0)
+    assert not trace_mod.emit_request_trace(unsampled, recs)
+    assert events.span_snapshot() == []
+    # the tail upgrade: degraded/failover/shed always emit
+    assert trace_mod.emit_request_trace(unsampled, recs, forced=True)
+    assert len(events.span_snapshot()) == len(recs)
+    tree = trace_mod.wire_tree(unsampled, recs, emitted=True)
+    assert tree["trace_id"] == "tid" and tree["spans"]
+    bare = trace_mod.wire_tree(unsampled, recs, emitted=False)
+    assert "spans" not in bare  # ids only on the unsampled path
+
+
+# ------------------------------------------------- traced serving path
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _dispatcher_burst(k=6, n=256, **cfg_kw):
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        Dispatcher,
+        ServeConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    xr = rng.standard_normal(n).astype(np.float32)
+    xi = rng.standard_normal(n).astype(np.float32)
+
+    async def run():
+        async with Dispatcher(ServeConfig(max_wait_ms=25.0,
+                                          **cfg_kw)) as d:
+            return d, await asyncio.gather(*[
+                d.submit(xr, xi) for _ in range(k)])
+
+    return _run(run())
+
+
+def test_served_request_carries_span_tree(obs_run):
+    _d, resps = _dispatcher_burst()
+    r0 = resps[0]
+    assert r0.trace and r0.trace["trace_id"]
+    spans = r0.trace["spans"]
+    names = [s["name"] for s in spans]
+    assert names[:4] == ["serve_request", "queue", "window", "compute"]
+    # the tree's phase children sum EXACTLY to the SLO row's total
+    total = r0.queue_wait_ms + r0.compute_ms
+    got = sum(s["dur_ms"] for s in spans
+              if s["name"] in ("queue", "window", "compute"))
+    assert got == pytest.approx(total, rel=0.05)
+    root = r0.trace["span_id"]
+    assert all(s.get("parent") == root for s in spans[1:])
+
+
+def test_batch_span_links_equal_coalesced_count(obs_run):
+    k = 6
+    _d, _resps = _dispatcher_burst(k=k)
+    batch_spans = [s for s in events.span_snapshot()
+                   if s.get("name") == "serve_batch"]
+    assert batch_spans
+    linked = sum(len(s.get("links") or ()) for s in batch_spans)
+    served = sum(s["cell"]["size"] for s in batch_spans)
+    assert linked == served == k
+
+
+def test_chrome_flow_events_from_links(obs_run):
+    _d, _resps = _dispatcher_burst(k=4)
+    doc = export.chrome_trace()
+    flows = [e for e in doc["traceEvents"]
+             if e.get("cat") == "pifft_flow"]
+    assert flows, "links produced no flow events"
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 4
+    assert all(e.get("bp") == "e" for e in finishes)
+    by_id = {e["id"]: e for e in starts}
+    for fin in finishes:  # arrows point forward in time
+        assert by_id[fin["id"]]["ts"] <= fin["ts"]
+    json.dumps(doc)  # the export stays loadable
+
+
+def test_spans_from_events_passes_links_through(obs_run):
+    with obs.span("fanin", links=["a1", "b2"], sid="s0"):
+        pass
+    recs = events.snapshot()
+    spans = export.spans_from_events(recs)
+    target = [s for s in spans if s.get("name") == "fanin"]
+    assert target and target[0]["links"] == ["a1", "b2"]
+    assert target[0]["sid"] == "s0"
+
+
+def test_sampled_out_requests_emit_no_span_events(obs_run,
+                                                 monkeypatch):
+    monkeypatch.setenv(trace_mod.SAMPLE_ENV, "0")
+    _d, resps = _dispatcher_burst(k=3)
+    # ids still ride the response; the tree and the events do not
+    assert all(r.trace and "spans" not in r.trace for r in resps)
+    assert not [s for s in events.span_snapshot()
+                if s.get("name") == "serve_request"]
+
+
+def test_wire_trace_round_trip(obs_run):
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        Dispatcher,
+        ServeConfig,
+    )
+    from cs87project_msolano2_tpu.serve.protocol import (
+        handle_connection,
+        request_over_socket,
+    )
+
+    rng = np.random.default_rng(1)
+    xr = rng.standard_normal(256).astype(np.float32)
+
+    async def run():
+        async with Dispatcher(ServeConfig()) as d:
+            server = await asyncio.start_server(
+                lambda r, w: handle_connection(d, r, w),
+                "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            try:
+                minted = await request_over_socket(
+                    "127.0.0.1", port, xr, np.zeros_like(xr),
+                    domain="r2c")
+                supplied = await request_over_socket(
+                    "127.0.0.1", port, xr, np.zeros_like(xr),
+                    domain="r2c",
+                    trace={"trace_id": "feedface", "span_id": "c11e"})
+            finally:
+                server.close()
+                await server.wait_closed()
+            return minted, supplied
+
+    minted, supplied = _run(run())
+    assert minted["ok"] and minted["trace"]["trace_id"]
+    assert supplied["trace"]["trace_id"] == "feedface"
+    # the server-side root is parented on the client's span
+    roots = [s for s in events.span_snapshot()
+             if s.get("trace") == "feedface"
+             and s.get("name") == "serve_request"]
+    assert roots and roots[0]["parent_sid"] == "c11e"
+
+
+def test_mesh_failover_span_under_same_trace(obs_run):
+    from cs87project_msolano2_tpu.resilience.inject import inject
+    from cs87project_msolano2_tpu.serve.loadgen import _group_for
+    from cs87project_msolano2_tpu.serve.mesh import (
+        MeshConfig,
+        MeshDispatcher,
+    )
+    from cs87project_msolano2_tpu.serve.shapes import ShapeSpec
+
+    rng = np.random.default_rng(2)
+    specs = [ShapeSpec(n=256)]
+    xr = rng.standard_normal(256).astype(np.float32)
+    xi = rng.standard_normal(256).astype(np.float32)
+
+    async def run():
+        async with MeshDispatcher(MeshConfig(devices=3),
+                                  specs) as mesh:
+            await mesh.submit(xr, xi)  # prime
+            victim = mesh.router.route(_group_for(specs[0]),
+                                       record=False)
+            with inject(victim.site, "permanent", count=1):
+                resp = await mesh.submit(xr, xi)
+            return victim.id, resp
+
+    victim_id, resp = _run(run())
+    hop = f"failover:{victim_id}"
+    assert hop in resp.degrade
+    assert resp.trace and resp.trace["spans"], "tail upgrade must emit"
+    assert any(s["name"] == hop for s in resp.trace["spans"])
+    # the hop span rides the request's OWN trace in the emitted stream
+    recs = [s for s in events.span_snapshot()
+            if s.get("trace") == resp.trace["trace_id"]]
+    assert any(s.get("name") == hop for s in recs)
+
+
+def test_shed_request_leaves_trace(obs_run):
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        Dispatcher,
+        QueueFull,
+        ServeConfig,
+    )
+
+    rng = np.random.default_rng(3)
+    xr = rng.standard_normal(256).astype(np.float32)
+    xi = rng.standard_normal(256).astype(np.float32)
+
+    async def run():
+        # depth 1: the submits all admit before the worker first runs
+        # (task scheduling order), so everything past the first sheds
+        async with Dispatcher(ServeConfig(queue_depth=1,
+                                          max_wait_ms=1.0)) as d:
+            tasks = [asyncio.ensure_future(d.submit(xr, xi))
+                     for _ in range(6)]
+            results = await asyncio.gather(*tasks,
+                                           return_exceptions=True)
+            return sum(1 for r in results
+                       if isinstance(r, QueueFull))
+
+    shed = _run(run())
+    assert shed > 0
+    sheds = [s for s in events.span_snapshot()
+             if s.get("name") == "serve_request"
+             and (s.get("args") or {}).get("shed")]
+    assert sheds and sheds[0].get("error") == "queue_full"
+
+
+# ------------------------------------------------------- live endpoints
+
+
+def test_telemetry_endpoints_live(obs_run):
+    from cs87project_msolano2_tpu.obs.http import (
+        TelemetryServer,
+        fetch_json,
+        fetch_text,
+    )
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        Dispatcher,
+        ServeConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    xr = rng.standard_normal(256).astype(np.float32)
+    xi = rng.standard_normal(256).astype(np.float32)
+
+    async def run():
+        async with Dispatcher(ServeConfig(max_wait_ms=25.0)) as d:
+            await asyncio.gather(*[d.submit(xr, xi)
+                                   for _ in range(4)])
+            server = TelemetryServer(d).start()
+            loop = asyncio.get_running_loop()
+            try:
+                # fetched WHILE the dispatcher is open and serving —
+                # the live-plane contract, not a post-mortem
+                prom = await loop.run_in_executor(
+                    None, fetch_text, server.url("/metrics"))
+                health = await loop.run_in_executor(
+                    None, fetch_json, server.url("/healthz"))
+                slo = await loop.run_in_executor(
+                    None, fetch_json, server.url("/slo"))
+                import urllib.error
+
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    await loop.run_in_executor(
+                        None, fetch_json, server.url("/nope"))
+                assert exc.value.code == 404
+            finally:
+                server.stop()
+            return d, prom, health, slo
+
+    d, prom, health, slo = _run(run())
+    assert "# TYPE pifft_serve_requests_total counter" in prom
+    assert health["ok"] and "queues" in health and "run" in health
+    assert slo["window_s"] == d.stats.window_s
+    row = slo["rows"]["256:natural:split3"]
+    assert row["requests"] == 4
+    assert row["total_p99_ms"] is not None
+
+
+def test_healthz_503_when_all_devices_dead(obs_run):
+    from cs87project_msolano2_tpu.obs.http import TelemetryServer
+    from cs87project_msolano2_tpu.serve.mesh import (
+        MeshConfig,
+        MeshDispatcher,
+    )
+
+    mesh = MeshDispatcher(MeshConfig(devices=2))
+    for dev in mesh.devices:
+        dev.state = "dead"
+    server = TelemetryServer(mesh).start()
+    try:
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(server.url("/healthz"), timeout=5)
+        assert exc.value.code == 503
+        doc = json.loads(exc.value.read().decode())
+        assert doc["ok"] is False and doc["devices_alive"] == 0
+    finally:
+        server.stop()
+
+
+def test_format_top_renders(obs_run):
+    from cs87project_msolano2_tpu.obs.http import format_top
+
+    frame = format_top(
+        {"window_s": 60.0,
+         "rows": {"1024:natural:split3": {
+             "requests": 3, "degraded": 1, "queue_p99_ms": 1.0,
+             "compute_p99_ms": 2.0, "total_p50_ms": 2.5,
+             "total_p99_ms": 3.0}}},
+        {"ok": True, "uptime_s": 12.0, "queued": 0,
+         "devices": [{"state": "healthy"}],
+         "devices_alive": 1})
+    assert "SERVING" in frame and "1024:natural:split3" in frame
+    empty = format_top({"rows": {}}, {"ok": False})
+    assert "NOT SERVING" in empty
+
+
+def test_sliding_window_ages_out(obs_run, monkeypatch):
+    from cs87project_msolano2_tpu.serve import slo as slo_mod
+
+    stats = slo_mod.LatencyStats(window_s=100.0)
+    now = {"t": 1000.0}
+    monkeypatch.setattr(slo_mod, "clock", lambda: now["t"])
+    stats.record("a", 0.001, 0.002)
+    stats.record("a", 0.003, 0.004, device="vdev1")
+    rows = stats.window_summary()
+    assert rows["a"]["requests"] == 1
+    assert rows["a@vdev1"]["requests"] == 1  # device-keyed row
+    now["t"] += 200.0  # the window slides past both samples
+    rows = stats.window_summary()
+    assert rows["a"]["requests"] == 0
+    assert rows["a"]["total_p99_ms"] is None  # stable schema, nulled
+    # the cumulative end-of-run summary is untouched by aging
+    assert stats.summary()["a"]["requests"] == 2
+
+
+# ---------------------------------------------------- burn-rate alerts
+
+
+def test_objective_validation_and_load(tmp_path):
+    with pytest.raises(ValueError):
+        Objective("x", -1.0)
+    with pytest.raises(ValueError):
+        Objective("x", 10.0, error_budget=0.0)
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({
+        "windows": [2, 10],
+        "objectives": [{"name": "conv", "match": "conv",
+                        "p99_target_ms": 40, "error_budget": 0.02}]}))
+    objectives, windows = load_objectives(str(path))
+    assert windows == (2.0, 10.0)
+    assert objectives[0].applies("conv", "whatever")
+    assert not objectives[0].applies("fft", "other")
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"objectives\": []}")
+    with pytest.raises(ValueError):
+        load_objectives(str(bad))
+    # duplicate names would silently merge their sample deques
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor([Objective("dup", 10.0), Objective("dup", 20.0)])
+
+
+def test_forced_level_refreshes_across_idle_gap(obs_run):
+    """A firing level must not outlive the burn just because no batch
+    delivered during the idle gap: the admission-path read refreshes
+    a stale evaluation."""
+    mon = SloMonitor([Objective("o", 20.0, 0.05)], windows=(10, 30))
+    t0 = 1000.0
+    for i in range(6):
+        mon.observe("fft", "l", 100.0, t=t0 + i)
+    mon.evaluate(t=t0 + 6)
+    assert mon.forced_level(t=t0 + 6) == "jnp-fft"
+    # ... minutes of silence: the stale level must clear on read
+    assert mon.forced_level(t=t0 + 600) is None
+    assert not mon.alerting()["o"]
+
+
+def test_sample_rate_parses_once_per_value(obs_run, monkeypatch,
+                                           capsys):
+    monkeypatch.setenv(trace_mod.SAMPLE_ENV, "bogus")
+    assert trace_mod.sample_rate() == 1.0
+    for _ in range(5):
+        trace_mod.mint()
+    # ONE warn per distinct malformed value, not one per mint
+    assert capsys.readouterr().err.count("is not a number") == 1
+
+
+def test_burn_rate_fires_and_resolves(obs_run):
+    mon = SloMonitor([Objective("o", 20.0, 0.05)],
+                     windows=(10.0, 30.0))
+    t0 = 1000.0
+    for i in range(6):
+        mon.observe("fft", "l", 100.0, t=t0 + i)
+    mon.evaluate(t=t0 + 6)
+    assert mon.alerting()["o"]
+    # burn 20 > rung threshold (t pins the synthetic clock domain)
+    assert mon.forced_level(t=t0 + 6) == "jnp-fft"
+    assert metrics.counter_value("pifft_slo_alerts_total",
+                                 objective="o", state="firing") == 1
+    # gauges live on every evaluation
+    snap = metrics.snapshot()["gauges"]
+    assert any(k.startswith("pifft_slo_burn_rate") for k in snap)
+    # the burn drains as the windows slide
+    for i in range(8):
+        mon.observe("fft", "l", 1.0, t=t0 + 40 + i)
+    mon.evaluate(t=t0 + 48)
+    assert not mon.alerting()["o"]
+    assert mon.forced_level(t=t0 + 48) is None
+    # a drained window publishes burn 0, never its crisis reading
+    gauges = metrics.snapshot()["gauges"]
+    burn_vals = [v for k, v in gauges.items()
+                 if k.startswith("pifft_slo_burn_rate")]
+    assert burn_vals and all(v == 0.0 for v in burn_vals), gauges
+    alerts = [e for e in obs.snapshot() if e.get("kind") == "slo_alert"]
+    assert [e["payload"]["state"] for e in alerts] == ["firing",
+                                                      "resolved"]
+    assert not [p for e in alerts for p in events.validate_event(e)]
+
+
+def test_too_few_samples_never_alert(obs_run):
+    mon = SloMonitor([Objective("o", 20.0, 0.05)], windows=(10, 30))
+    mon.observe("fft", "l", 999.0, t=1.0)
+    mon.evaluate(t=1.5)
+    assert not mon.alerting()["o"]  # 1 sample < min_samples
+
+
+def test_slo_demotion_tags_responses(obs_run):
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        Dispatcher,
+        ServeConfig,
+    )
+
+    mon = SloMonitor([Objective("o", 0.0001, 0.01)],
+                     windows=(30.0, 60.0))
+    rng = np.random.default_rng(0)
+    xr = rng.standard_normal(256).astype(np.float32)
+    xi = rng.standard_normal(256).astype(np.float32)
+
+    async def run():
+        # SEQUENTIAL submits: the first batches prime the monitor
+        # (every request blows a 0.1us target), later admissions see
+        # the forced level
+        async with Dispatcher(ServeConfig(max_wait_ms=0.5,
+                                          slo_objectives=mon)) as d:
+            out = []
+            for _ in range(8):
+                out.append(await d.submit(xr, xi))
+            return out
+
+    resps = _run(run())
+    tagged = [r for r in resps
+              if any(str(t).startswith("slo:") for t in r.degrade)]
+    assert tagged, [r.degrade for r in resps]
+    assert all(r.degraded for r in tagged)
+    # alert event emitted and schema-valid
+    alerts = [e for e in obs.snapshot() if e.get("kind") == "slo_alert"]
+    assert alerts
+    levels = [e for e in obs.snapshot()
+              if e.get("kind") == "serve_degrade"
+              and str((e.get("payload") or {}).get("level", ""))
+              .startswith("slo:")]
+    assert levels, "admission never recorded the slo level"
+
+
+def test_dispatcher_builds_monitor_from_config_path(tmp_path):
+    from cs87project_msolano2_tpu.serve.dispatcher import (
+        Dispatcher,
+        ServeConfig,
+    )
+
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps([{"name": "all", "p99_target_ms": 50}]))
+    d = Dispatcher(ServeConfig(slo_objectives=str(path)))
+    assert d.slomon is not None
+    assert d.slomon.objectives[0].name == "all"
+    assert Dispatcher(ServeConfig()).slomon is None
+
+
+# ------------------------------------------- shared percentile helper
+
+
+@pytest.mark.parametrize("q", [0, 1, 25, 50, 75, 90, 99, 99.9, 100])
+def test_percentile_matches_numpy_inverted_cdf(q):
+    """Property: the shared helper == numpy's nearest-rank mode over
+    random populations (the satellite's unification contract)."""
+    rng = np.random.default_rng(42)
+    for size in (1, 2, 3, 7, 100, 1001):
+        values = rng.standard_normal(size).tolist()
+        got = percentile_nearest_rank(values, q)
+        want = float(np.percentile(values, q, method="inverted_cdf"))
+        assert got == pytest.approx(want), (q, size)
+
+
+def test_percentile_edges():
+    assert percentile_nearest_rank([5.0], 99) == 5.0
+    assert percentile_nearest_rank([1, 2, 3], 0) == 1
+    assert percentile_nearest_rank([1, 2, 3], 100) == 3
+    with pytest.raises(ValueError):
+        percentile_nearest_rank([], 50)
+    with pytest.raises(ValueError):
+        percentile_nearest_rank([1], 101)
+    assert percentile_or_none([], 99) is None
+    assert percentile_or_none([2.0], 50) == 2.0
+
+
+def test_slo_and_loadgen_share_the_one_implementation():
+    from cs87project_msolano2_tpu.serve import loadgen, slo
+    from cs87project_msolano2_tpu.utils import stats
+
+    assert slo.percentile is stats.percentile_nearest_rank
+    assert slo.percentile_or_none is stats.percentile_or_none
+    assert loadgen.percentile_or_none is stats.percentile_or_none
+
+
+# --------------------------------------------- Prometheus text edges
+
+
+def test_prometheus_label_values_escaped(obs_run):
+    metrics.inc("pifft_test_total",
+                shape='with"quote', note="back\\slash\nnewline")
+    text = export.prometheus_text()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("pifft_test_total")][0]
+    assert 'shape="with\\"quote"' in line
+    assert "back\\\\slash\\nnewline" in line
+    assert "\n" not in line  # the raw newline never splits the series
+
+
+def test_histogram_buckets_cumulative_and_inf_terminated(obs_run):
+    for v in (0.003, 0.03, 0.3, 3.0, 30.0):
+        metrics.observe("pifft_test_seconds", v, shape="s")
+    text = export.prometheus_text()
+    buckets = [ln for ln in text.splitlines()
+               if ln.startswith("pifft_test_seconds_bucket")]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert 'le="+Inf"' in buckets[-1]
+    assert counts[-1] == 5.0  # +Inf == count
+    assert "pifft_test_seconds_sum" in text
+    assert "pifft_test_seconds_count" in text
+    # every bucket line keeps its base labels beside le
+    assert all('shape="s"' in ln for ln in buckets)
+
+
+# ----------------------------------------------- dropped-event surfacing
+
+
+def test_dropped_events_counted_warned_and_summarized(capsys):
+    obs.enable(buffer_max=8)
+    try:
+        for i in range(20):
+            obs.emit("spam", i=i)
+        assert events.dropped() > 0
+        assert metrics.counter_value("pifft_obs_dropped_total") \
+            == events.dropped()
+        err = capsys.readouterr().err
+        assert err.count("obs buffer overflowed") == 1  # warn ONCE
+        obs.emit("metrics", snapshot=metrics.snapshot())
+        summary = export.summarize(events.snapshot())
+        assert summary["dropped_events"] == events.dropped()
+        assert "DROPPED" in export.format_summary(summary)
+    finally:
+        obs.disable()
+        metrics.reset()
+
+
+def test_no_drop_no_warning(obs_run, capsys):
+    obs.emit("fine")
+    summary = export.summarize(events.snapshot())
+    assert summary["dropped_events"] == 0
+    assert "DROPPED" not in export.format_summary(summary)
+    assert "overflowed" not in capsys.readouterr().err
+
+
+# --------------------------------------------------- tail attribution
+
+
+def test_tail_attribution_names_the_owner(obs_run):
+    from cs87project_msolano2_tpu.analyze.loader import (
+        tail_attribution,
+    )
+
+    # hand-built trees: 9 fast compute-bound requests, one queue-bound
+    # outlier — the p99 owner must be the outlier's queue phase
+    def tree(rid, queue_s, compute_s):
+        t = trace_mod.mint()
+        recs = trace_mod.request_span_records(
+            t, label="512:natural:split3", rid=rid, t_submit=0.0,
+            t_dequeue=queue_s, t_exec=queue_s,
+            compute_s=compute_s, t_done=queue_s + compute_s)
+        trace_mod.emit_request_trace(t, recs)
+
+    for rid in range(9):
+        tree(rid, queue_s=0.001, compute_s=0.004)
+    tree(9, queue_s=0.050, compute_s=0.004)
+    table = tail_attribution(obs.snapshot())
+    row = table["512:natural:split3"]
+    assert row["requests"] == 10
+    assert row["p99_owner"] == "queue"
+    assert row["p99_queue_share"] > 0.8
+    assert row["p50_ms"] < row["p99_ms"]
+    shares = (row["p99_queue_share"] + row["p99_window_share"]
+              + row["p99_compute_share"])
+    assert shares == pytest.approx(1.0, abs=0.01)
+
+
+def test_tail_attribution_skips_incomplete_trees(obs_run):
+    from cs87project_msolano2_tpu.analyze.loader import (
+        tail_attribution,
+    )
+
+    t = trace_mod.mint()
+    events.record_span({"name": "serve_request", "ts_s": 0.0,
+                        "dur_s": 1.0, "tid": 1, "sid": t.span_id,
+                        "trace": t.trace_id,
+                        "args": {"shape": "x"}})  # no children
+    assert tail_attribution(obs.snapshot()) == {}
+
+
+# ----------------------------------------------------- check-rule scope
+
+
+def test_obs_http_in_pif107_and_pif112_scope():
+    """The live plane sits inside the serve concurrency rules' scope
+    (the satellite's wiring): both configs name obs/http.py."""
+    import fnmatch
+
+    from cs87project_msolano2_tpu.check.rules import (
+        BlockingCallInAsyncServePath,
+    )
+    from cs87project_msolano2_tpu.check.rules_flow import (
+        UnguardedSharedStateWrite,
+    )
+
+    path = "/repo/cs87project_msolano2_tpu/obs/http.py"
+    for rule in (BlockingCallInAsyncServePath,
+                 UnguardedSharedStateWrite):
+        pats = rule.default_config["paths"]
+        assert any(fnmatch.fnmatch(path, p) for p in pats), \
+            (rule.id, pats)
+
+
+def test_pif107_flags_async_blocking_in_obs_http(tmp_path):
+    """A constructed async time.sleep in an obs/http.py path is a
+    finding — the scope has teeth, not just a glob entry."""
+    from cs87project_msolano2_tpu.check.engine import check_paths
+
+    target = tmp_path / "obs" / "http.py"
+    target.parent.mkdir()
+    target.write_text(
+        "import time\n\n\n"
+        "async def handler():\n"
+        "    time.sleep(1)\n")
+    findings = check_paths([str(target)], rules=["PIF107"])
+    assert any(f.rule == "PIF107" for f in findings), findings
+    # the shipped module itself stays CLEAN under the widened scope
+    import cs87project_msolano2_tpu.obs.http as http_mod
+
+    assert not check_paths([http_mod.__file__], rules=["PIF107"])
+
+
+def test_slomon_describe_round_trips_json():
+    mon = SloMonitor([Objective("o", 20.0)], windows=(5, 60))
+    json.dumps(mon.describe())  # the /healthz surface stays JSON-safe
+
+
+def test_obs_top_once_renders_live_server(obs_run, capsys):
+    """`pifft obs top --once` against a live telemetry plane prints
+    one frame and exits 0; with no server it fails structurally."""
+    from cs87project_msolano2_tpu.cli import main as cli_main
+    from cs87project_msolano2_tpu.obs.http import TelemetryServer
+
+    server = TelemetryServer(None).start()
+    try:
+        rc = cli_main(["obs", "top", "--once", "--url", server.url()])
+    finally:
+        server.stop()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "pifft live telemetry" in out
+    rc = cli_main(["obs", "top", "--once",
+                   "--url", "http://127.0.0.1:9"])  # nothing there
+    assert rc == 1
+    assert "no telemetry plane" in capsys.readouterr().err
+
+
+def test_telemetry_server_stops_cleanly(obs_run):
+    from cs87project_msolano2_tpu.obs.http import TelemetryServer
+
+    server = TelemetryServer(None).start()
+    port = server.port
+    server.stop()
+    # the port is released: a second server can bind it immediately
+    import socket
+
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", port))
+    finally:
+        s.close()
