@@ -7,10 +7,11 @@
 SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
-        bench-chaos serve-smoke serve-slo serve-mesh-smoke rfft-smoke \
-        precision-smoke apps-smoke multichip-smoke obs-live-smoke \
-        replicate run-experiments run-experiments-and-analyze-results \
-        analyze analyze-datasets analyze-smoke check lint
+        bench-chaos serve-smoke serve-slo serve-mesh-smoke wire-smoke \
+        rfft-smoke precision-smoke apps-smoke multichip-smoke \
+        obs-live-smoke replicate run-experiments \
+        run-experiments-and-analyze-results analyze analyze-datasets \
+        analyze-smoke check lint
 
 all:
 	$(MAKE) -C cs87project_msolano2_tpu/native all
@@ -175,6 +176,51 @@ serve-mesh-smoke:
 	  print('# serve mesh rows ok: kill on %s, p99 %s -> %s ms, %d devices served' \
 	        % (kill['killed_device'], kill['p99_pre_kill_ms'], \
 	           kill['p99_post_kill_ms'], sum(1 for d in devs if d['served'] > 0)))"
+
+# the CI wire check (docs/SERVING.md, "The wire"): (1) the in-process
+# wire smoke — both dialects served over a real socket with the planes
+# BYTE-IDENTICAL to the direct dispatcher result, the host-copy meter
+# charging ZERO on the binary float32 path (and nonzero on JSON — the
+# meter discriminates), the shm lane and streaming reassembly
+# round-tripping bit-identically, an unsupported HELLO version falling
+# back to the JSON dialect with the serve_wire_fallback event, and a
+# malformed header closing with serve_conn_lost, never a hang; (2) the
+# trace-driven replay SLO run — at EQUAL offered load per (process,
+# rps) cell, the binary dialect's p99 must beat JSON's, and the
+# per-protocol tail attribution must show the parse-driven tail GONE:
+# every binary label's p99 sits strictly below every JSON label's
+# (an order of magnitude in practice — what remains of the binary
+# tail is millisecond-scale batching wait, not seconds of queue/parse)
+wire-smoke:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
+	  serve --wire-smoke --json | tee /tmp/pifft-wire-smoke.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-wire-smoke.json')); \
+	  assert r['ok'] and not r['problems'], r; \
+	  assert r['binary_host_copy_delta'] == 0, r; \
+	  assert r['json_host_copy_delta'] > 0, r; \
+	  print('# wire smoke ok: binary copies 0 B, json copies %d B' \
+	        % r['json_host_copy_delta'])" && \
+	PIFFT_PLAN_CACHE=off python3 bench.py --serve-load --smoke \
+	  --events /tmp/pifft-wire-events.jsonl \
+	  | tee /tmp/pifft-wire-slo.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-wire-slo.json')); \
+	  rows = r['serve_load']; \
+	  cell = lambda p: {(x['process'], x['offered_rps']): x['p99_ms'] \
+	                    for x in rows if x.get('protocol') == p \
+	                    and x.get('p99_ms') is not None}; \
+	  jsn, bin_ = cell('json'), cell('binary'); \
+	  matched = sorted(set(jsn) & set(bin_)); \
+	  assert matched, (sorted(jsn), sorted(bin_)); \
+	  slow = {k: (bin_[k], jsn[k]) for k in matched if bin_[k] >= jsn[k]}; \
+	  assert not slow, slow; \
+	  tails = r['serve_tail_attribution_by_protocol']; \
+	  bt = max(v['p99_ms'] for v in tails['binary'].values()); \
+	  jt = min(v['p99_ms'] for v in tails['json'].values()); \
+	  assert bt < jt, (bt, jt); \
+	  print('# wire replay ok: binary p99 beats json in %d/%d cells (best %0.1fx), worst binary tail %.1f ms vs best json %.1f ms' \
+	        % (len(matched), len(matched), \
+	           max(jsn[k] / bin_[k] for k in matched), bt, jt))"
 
 # the CI half-spectrum check (docs/REAL.md): rfft parity vs numpy
 # across sizes, then the bench smoke with the obs meter armed — the

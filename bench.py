@@ -107,6 +107,19 @@ SMOKE_SERVE_LOAD_NS = (1 << 10,)
 SMOKE_SERVE_LOAD_RPS = (80.0, 320.0)
 SMOKE_SERVE_LOAD_DURATION_S = 0.25
 
+# the wire replay tier (serve/loadgen.py run_wire_load): per-dialect
+# rows over a REAL socket, same offered load for both protocols so the
+# JSON-vs-binary p99 delta is apples to apples — the wire-smoke gate
+# asserts binary < json on these rows
+WIRE_LOAD_N = 1 << 16
+WIRE_LOAD_RPS = (200.0,)
+WIRE_LOAD_DURATION_S = 2.0
+WIRE_LOAD_PROCESSES = ("uniform", "diurnal", "bursty", "heavytail")
+SMOKE_WIRE_LOAD_N = 1 << 12
+SMOKE_WIRE_LOAD_RPS = (120.0,)
+SMOKE_WIRE_LOAD_DURATION_S = 0.4
+SMOKE_WIRE_LOAD_PROCESSES = ("uniform", "bursty")
+
 
 def _retry(fn, *args, smoke: bool = False, label: str = ""):
     """Shared TRANSIENT-retry wrapper (resilience.with_retry policy):
@@ -717,6 +730,9 @@ def serve_load_main(args) -> int:
     )
     from cs87project_msolano2_tpu.serve.loadgen import run_offered_load
 
+    from cs87project_msolano2_tpu.serve import protocol as serve_protocol
+    from cs87project_msolano2_tpu.serve.loadgen import run_wire_load
+
     smoke = args.smoke
     ns = tuple(SMOKE_SERVE_LOAD_NS if smoke else SERVE_LOAD_NS)
     rps_list = tuple(args.load_rps
@@ -724,16 +740,91 @@ def serve_load_main(args) -> int:
                          else SERVE_LOAD_RPS))
     duration = args.load_duration or (
         SMOKE_SERVE_LOAD_DURATION_S if smoke else SERVE_LOAD_DURATION_S)
+    wire_n = SMOKE_WIRE_LOAD_N if smoke else WIRE_LOAD_N
+    wire_rps = SMOKE_WIRE_LOAD_RPS if smoke else WIRE_LOAD_RPS
+    wire_duration = SMOKE_WIRE_LOAD_DURATION_S if smoke \
+        else WIRE_LOAD_DURATION_S
+    wire_processes = SMOKE_WIRE_LOAD_PROCESSES if smoke \
+        else WIRE_LOAD_PROCESSES
+    # the replay population: mixed op/priority/tenant over the wire
+    # shape — the front door must multiplex classes, not just shapes
+    population = [
+        (3.0, {"n": wire_n}),
+        (1.0, {"n": wire_n, "op": "conv", "priority": "high",
+               "tenant": "batch"}),
+    ]
     cfg = ServeConfig(max_batch=8, max_wait_ms=1.0, queue_depth=32)
     specs = [ShapeSpec(n=n) for n in ns]
+    if wire_n not in ns:
+        specs.append(ShapeSpec(n=wire_n))
+    specs.append(ShapeSpec(n=wire_n, op="conv"))
     rows = []
+    tails_by_protocol = {}
 
     async def run_all():
         async with Dispatcher(cfg, specs) as d:
             for n in ns:
                 for rps in rps_list:
-                    rows.append(await run_offered_load(
-                        d, n, rps, duration))
+                    row = await run_offered_load(d, n, rps, duration)
+                    # the classic cells drive the dispatcher directly
+                    # — no wire at all; say so instead of letting the
+                    # loader's "json" backfill claim otherwise
+                    row["protocol"] = "inproc"
+                    rows.append(row)
+            # ---- the wire replay tier: same dispatcher, REAL socket,
+            # one row set per dialect at the same offered load
+            import numpy as _np
+
+            for _w, _spec in population:
+                # pay each replay group's trace/compile cost BEFORE
+                # the measured schedule opens (the warmup pass every
+                # SLO run owes itself — the cells measure the wire,
+                # not XLA)
+                _rng = _np.random.default_rng(0)
+                _xr = _rng.standard_normal(
+                    _spec["n"]).astype(_np.float32)
+                await d.submit(_xr, _np.zeros_like(_xr)
+                               if _spec.get("op", "fft") != "fft"
+                               else _xr.copy(),
+                               op=_spec.get("op", "fft"))
+            server = await asyncio.start_server(
+                lambda r, w: serve_protocol.handle_connection(d, r, w),
+                "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            try:
+                for proto in ("json", "binary"):
+                    mark = len(obs.events.snapshot()) \
+                        if obs.enabled() else 0
+                    for process in wire_processes:
+                        for rps in wire_rps:
+                            rows.append(await run_wire_load(
+                                "127.0.0.1", port, proto, population,
+                                rps, wire_duration, process=process,
+                                seed=17))
+                    if obs.enabled():
+                        from cs87project_msolano2_tpu.analyze.loader \
+                            import tail_attribution
+                        # attribution over THIS dialect's event slice:
+                        # the per-protocol p99 owner the wire-smoke
+                        # gate reads (binary must not blame the queue/
+                        # parse phase)
+                        sliced = tail_attribution(
+                            obs.events.snapshot()[mark:])
+                        if sliced:
+                            tails_by_protocol[proto] = {
+                                label: {
+                                    "p99_owner": r["p99_owner"],
+                                    "p99_ms": r["p99_ms"],
+                                    "p99_queue_share":
+                                        r["p99_queue_share"],
+                                    "p99_window_share":
+                                        r["p99_window_share"],
+                                    "p99_compute_share":
+                                        r["p99_compute_share"]}
+                                for label, r in sliced.items()}
+            finally:
+                server.close()
+                await server.wait_closed()
 
     asyncio.run(run_all())
 
@@ -773,6 +864,9 @@ def serve_load_main(args) -> int:
                         "p99_window_share": row["p99_window_share"],
                         "p99_compute_share": row["p99_compute_share"]}
                 for label, row in tails.items()}
+        if tails_by_protocol:
+            record["serve_tail_attribution_by_protocol"] = \
+                tails_by_protocol
         if obs.events.dropped():
             # an overflowed buffer means the attribution above is
             # partial: say so in the record, not just the summary

@@ -106,7 +106,12 @@ class Sample:
     "corr2^K_*", "solve2^K_*", and the overlap-save "os2^K_*" set)
     carry their op, and every record that predates the op axis —
     the whole committed BENCH_r01-r06 trajectory — backfills "fft",
-    the only op those rounds served."""
+    the only op those rounds served.  ``protocol`` tags the wire
+    dialect a serve-load sample was measured over (docs/SERVING.md
+    "The wire"): per-protocol ``serve_load`` rows carry "json" /
+    "binary" (or "inproc" for the direct-dispatcher cells), and every
+    record that predates the protocol axis backfills "json", the only
+    dialect the front door spoke before the framed wire landed."""
 
     source: str               # "tsv" | "bench" | "obs"
     metric: str               # "total_ms", "funnel_ms", "n2^24_gflops", ...
@@ -124,6 +129,7 @@ class Sample:
     #: samples carry the device id they were measured on; every other
     #: sample (and every pre-mesh committed round) stays None
     device: Optional[str] = None
+    protocol: str = "json"
 
 
 @dataclasses.dataclass
@@ -140,6 +146,10 @@ class BenchRound:
     #: (``bench.py --serve-mesh`` — docs/SERVING.md): per-device
     #: utilization rows plus the kill row; empty for every other round
     serve_mesh_rows: list = dataclasses.field(default_factory=list)
+    #: the raw ``serve_load`` row set when the round carries one
+    #: (``bench.py --serve-load`` — docs/SERVING.md): one SLO cell per
+    #: (protocol, arrival process, offered rps); empty otherwise
+    serve_load_rows: list = dataclasses.field(default_factory=list)
 
     def metric_names(self) -> list:
         return sorted(self.metrics)
@@ -312,6 +322,21 @@ def load_bench_round(path: str) -> BenchRound:
         for key in ("p99_pre_kill_ms", "p99_post_kill_ms"):
             if _numeric(r.get(key)):
                 metrics[f"serve_mesh_{key}"] = float(r[key])
+    # the serve_load row set (docs/SERVING.md "The wire"): the worst
+    # p99 per wire dialect becomes a scalar metric, so the trajectory
+    # (and a future `analyze gate` floor) can hold the binary dialect
+    # to its parse-tax-free tail directly; rows predating the protocol
+    # axis backfill "json", the only dialect the front door spoke then
+    load_rows = parsed.get("serve_load")
+    load_rows = [r for r in load_rows if isinstance(r, dict)] \
+        if isinstance(load_rows, list) else []
+    by_proto: dict = {}
+    for r in load_rows:
+        if _numeric(r.get("p99_ms")):
+            proto = r.get("protocol") or "json"
+            by_proto.setdefault(proto, []).append(float(r["p99_ms"]))
+    for proto, p99s in by_proto.items():
+        metrics[f"serve_load_{proto}_p99_ms"] = max(p99s)
     # fingerprint: the stamped env when present, else backfill from the
     # record's smoke flag and the platform banner in the captured tail
     env = parsed.get("env") if isinstance(parsed.get("env"), dict) \
@@ -328,7 +353,8 @@ def load_bench_round(path: str) -> BenchRound:
                       else None,
                       note=doc.get("note") if isinstance(doc.get("note"),
                                                          str) else None,
-                      serve_mesh_rows=mesh_rows)
+                      serve_mesh_rows=mesh_rows,
+                      serve_load_rows=load_rows)
 
 
 def load_bench_rounds(paths) -> list:
@@ -352,6 +378,9 @@ _PRECISION_METRIC = re.compile(
 _OP_METRIC = re.compile(r"^(conv|corr|solve|os)2\^(\d+)_")
 _OP_PREFIX = {"conv": "conv", "corr": "corr", "solve": "solve",
               "os": "conv"}
+#: per-protocol serve-load scalars (docs/SERVING.md "The wire"): the
+#: dialect rides the metric name exactly as the op does for op rows
+_SERVE_LOAD_METRIC = re.compile(r"^serve_load_([a-z0-9]+)_p99_ms$")
 
 
 def bench_samples(rnd: BenchRound) -> list:
@@ -377,6 +406,16 @@ def bench_samples(rnd: BenchRound) -> list:
                     source="bench", metric=name, value=v, rep=rep,
                     round_index=rnd.index,
                     fingerprint=rnd.fingerprint, device=device))
+            continue
+        sl = _SERVE_LOAD_METRIC.match(name)
+        if sl is not None:
+            # the per-dialect SLO scalar keeps its dialect on the
+            # sample, so `analyze` can filter binary vs json tails
+            # without re-parsing metric names
+            out.append(Sample(
+                source="bench", metric=name, value=val,
+                round_index=rnd.index, fingerprint=rnd.fingerprint,
+                protocol=sl.group(1)))
             continue
         domain = "c2c"
         precision = "split3"
@@ -405,6 +444,19 @@ def bench_samples(rnd: BenchRound) -> list:
                 rep=rep if isinstance(val, list) else None,
                 round_index=rnd.index, fingerprint=rnd.fingerprint,
                 domain=domain, precision=precision, op=op))
+    # per-cell serve_load rows (docs/SERVING.md "The wire"): one
+    # sample per (protocol, process, rps) SLO cell, dialect-tagged —
+    # rows predating the protocol axis backfill "json"
+    for rep, r in enumerate(rnd.serve_load_rows):
+        if not _numeric(r.get("p99_ms")):
+            continue
+        out.append(Sample(
+            source="bench", metric="serve_load_p99_ms",
+            value=float(r["p99_ms"]),
+            n=r["n"] if isinstance(r.get("n"), int) else None,
+            rep=rep, round_index=rnd.index,
+            fingerprint=rnd.fingerprint,
+            protocol=r.get("protocol") or "json"))
     return out
 
 

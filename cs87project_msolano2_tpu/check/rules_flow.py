@@ -1052,3 +1052,146 @@ class UntaggedDemotion(Rule):
                     f"keyword) — the never-silent rule "
                     f"(docs/RESILIENCE.md) requires every demotion "
                     f"to be tagged before the value escapes")
+
+
+# ============================== PIF117 copying decode on the serve hot path
+
+
+@register
+class CopyingDecodeOnServeHotPath(Rule):
+    id = "PIF117"
+    name = "copying-decode-on-serve-hot-path"
+    summary = ("flow: a copying decode (json parse, per-element struct "
+               "unpack, array-from-list) on the serve wire hot path "
+               "with no reachable host-copy meter charge")
+    invariant = ("the binary front door's whole claim (docs/SERVING.md "
+                 "\"The wire\") is that client planes land in pooled "
+                 "staging buffers with ZERO intermediate copies: "
+                 "``frombuffer`` views over the frame payload, no "
+                 "``json.loads``, no per-element Python floats.  The "
+                 "`make wire-smoke` gate checks the meter dynamically "
+                 "(binary-path delta == 0); this rule checks the code "
+                 "shape statically.  A copying decode — a json parse, "
+                 "a struct unpack inside a per-element loop, or "
+                 "np.array/np.asarray/np.fromiter over a list "
+                 "materialization — is allowed on the hot path ONLY "
+                 "when it is metered: a ``charge_host_copy(...)`` call "
+                 "in the same function, on the same statement or a "
+                 "path-connected one (either direction), books the "
+                 "bytes to ``pifft_host_copy_bytes_total`` so the "
+                 "smoke gate sees them.  An unmetered copy is "
+                 "invisible to the meter and silently re-grows the "
+                 "parse tax the binary dialect exists to delete.  A "
+                 "single header-prefix ``unpack`` outside any loop is "
+                 "fine (fixed bytes, not per-element)")
+    # an unmetered copy is exactly what the meter exists to surface, so
+    # a suppression must say why: blanket noqa never silences this rule
+    # and an explicit noqa[PIF117] needs a reason
+    blanket_suppressible = False
+    default_config = {
+        "paths": ("*/serve/protocol.py", "*/serve/buffers.py"),
+        # resolved call targets that parse into Python objects
+        "decode_calls": ("json.loads", "json.load"),
+        # method names that unpack per-element when called in a loop
+        # (a single header-prefix unpack outside a loop is exempt)
+        "unpack_methods": ("unpack", "unpack_from", "iter_unpack"),
+        # resolved array constructors that copy when fed a list
+        # materialization (list(...), .tolist(), a list comprehension)
+        "array_calls": ("numpy.array", "numpy.asarray", "numpy.fromiter"),
+        # the sanctioning meter vocabulary (matched on the last
+        # segment of the resolved target, so wire.charge_host_copy
+        # and a bare import both count)
+        "meter_calls": ("charge_host_copy",),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        if not _in_scope(ctx, config):
+            return
+        for fn in flow.function_defs(ctx.tree):
+            yield from self._check_fn(ctx, fn, config)
+
+    # -- vocabulary matching
+
+    @staticmethod
+    def _materializes_list(arg: ast.AST) -> bool:
+        """Is `arg` a list materialization (the copying feed)?"""
+        if isinstance(arg, (ast.ListComp, ast.List)):
+            return True
+        if isinstance(arg, ast.Call):
+            if isinstance(arg.func, ast.Name) and arg.func.id == "list":
+                return True
+            if isinstance(arg.func, ast.Attribute) \
+                    and arg.func.attr == "tolist":
+                return True
+        return False
+
+    def _decode_kind(self, ctx, call: ast.Call, config: dict,
+                     loop_calls: set) -> Optional[str]:
+        """A human-readable label when `call` is a copying decode,
+        else None."""
+        target = ctx.resolve_call(call)
+        if target in config["decode_calls"]:
+            return f"`{_last_segment(target)}(...)` json parse"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in config["unpack_methods"] \
+                and id(call) in loop_calls:
+            return f"per-element `.{call.func.attr}(...)` in a loop"
+        if target in config["array_calls"] and call.args \
+                and self._materializes_list(call.args[0]):
+            return (f"`{_last_segment(target)}(...)` over a list "
+                    f"materialization")
+        return None
+
+    def _check_fn(self, ctx, fn, config) -> Iterator:
+        # calls lexically inside a loop within this function (the
+        # per-element-unpack qualifier); nested defs are analyzed as
+        # their own functions, and their calls never appear in this
+        # function's CFG scan, so over-collecting here is harmless
+        loop_calls: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                loop_calls.update(id(sub) for sub in ast.walk(node)
+                                  if isinstance(sub, ast.Call))
+        # cheap pre-scan: no decode vocabulary, nothing to meter
+        if not any(isinstance(node, ast.Call)
+                   and self._decode_kind(ctx, node, config, loop_calls)
+                   for node in ast.walk(fn)):
+            return
+        cfg = flow.build_cfg(fn)
+        decodes: list = []      # (node_idx, call, label)
+        meter_nodes: set = set()
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub in flow.shallow_walk(root):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    target = ctx.resolve_call(sub)
+                    if _last_segment(target) in config["meter_calls"]:
+                        meter_nodes.add(node.idx)
+                        continue
+                    label = self._decode_kind(ctx, sub, config,
+                                              loop_calls)
+                    if label:
+                        decodes.append((node.idx, sub, label))
+        if not decodes:
+            return
+        # a charge sanctions a decode it can reach or be reached from
+        # (charging before or after the copy are both honest books)
+        metered: set = set(meter_nodes)
+        for m in meter_nodes:
+            metered |= cfg.reachable(m)
+        for idx, call, label in decodes:
+            if idx in metered or (cfg.reachable(idx) & meter_nodes):
+                continue
+            yield self.finding(
+                ctx, call,
+                f"copying decode {label} on the serve wire hot path "
+                f"with no reachable `charge_host_copy(...)` in this "
+                f"function — the zero-copy landing contract "
+                f"(docs/SERVING.md) requires plane bytes to land via "
+                f"frombuffer views, and any deliberate copy to be "
+                f"booked to the host-copy meter so `make wire-smoke` "
+                f"sees it; meter the bytes, restructure to a view, or "
+                f"noqa with a reason saying why this copy is exempt")

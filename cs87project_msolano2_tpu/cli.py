@@ -88,6 +88,8 @@ of the `make multichip-smoke` CI gate.
 from __future__ import annotations
 
 import argparse
+import asyncio
+import json
 import sys
 
 import numpy as np
@@ -614,11 +616,102 @@ def multichip_main(argv) -> int:
     return 0
 
 
+def wire_main(argv) -> int:
+    """``pifft wire`` — inspect the binary wire protocol.
+
+    ``layout`` prints the authoritative frame header table straight
+    from the struct (docs/SERVING.md "The wire" quotes it; this is
+    the source).  ``probe`` dials a running server, negotiates, and
+    reports what the connection actually granted — dialect, credit
+    window, shm lane — then round-trips one PING.
+    """
+    ap = argparse.ArgumentParser(
+        prog="cs87project_msolano2_tpu wire",
+        description="binary wire protocol tools (docs/SERVING.md)")
+    ap.add_argument("cmd", choices=("layout", "probe"))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8571)
+    ap.add_argument("--shm", action="store_true",
+                    help="probe: also ask for the shm lane")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from .serve import wire
+
+    if args.cmd == "layout":
+        fields = (
+            ("magic", "4s", 'b"PIFB"'),
+            ("version", "u16", f"wire version (current: "
+                               f"{wire.WIRE_VERSION})"),
+            ("flags", "u16", "F_NO_XI|F_PI|F_SHM|F_STREAM|"
+                             "F_DEGRADED|F_WANT_SHM"),
+            ("msg_type", "u8", "HELLO/ACK/REQUEST/RESPONSE/ERROR/"
+                               "STREAM_*/PING/PONG"),
+            ("op", "u8", f"index into {wire.WIRE_OPS}"),
+            ("domain", "u8", f"index into {wire.WIRE_DOMAINS}"),
+            ("precision", "u8", "0 = unset, else index into the "
+                                "precision modes"),
+            ("priority", "u8", f"index into {wire.WIRE_PRIORITIES}"),
+            ("inverse", "u8", "0/1"),
+            ("dtype", "u8", "0 = float32, 1 = bfloat16"),
+            ("pad", "u8", "reserved (zero)"),
+            ("rid", "u64", "request id (echoed on the reply)"),
+            ("n", "u32", "logical transform length"),
+            ("width", "u32", "plane elements in the payload"),
+            ("extras_len", "u32", "metadata blob bytes (JSON: "
+                                  "tenant/trace/response meta)"),
+            ("slot", "u32", "shm slot / stream seq / HELLO_ACK "
+                            "credit window"),
+            ("payload_len", "u64", "raw plane bytes (xr then xi, "
+                                   "dlpack-style contiguous)"),
+        )
+        if args.json:
+            print(json.dumps({
+                "magic": "PIFB", "version": wire.WIRE_VERSION,
+                "header_bytes": wire.HEADER.size,
+                "struct": wire.HEADER.format,
+                "fields": [{"name": n, "type": t, "meaning": m}
+                           for n, t, m in fields]}, indent=1))
+        else:
+            print(f"# wire header: {wire.HEADER.size} bytes, "
+                  f"little-endian ({wire.HEADER.format})")
+            for name, typ, meaning in fields:
+                print(f"{name:<12} {typ:<4} {meaning}")
+        return 0
+
+    async def probe():
+        c = await wire.WireClient.connect(args.host, args.port,
+                                          want_shm=args.shm)
+        out = {"dialect": c.dialect}
+        if c.dialect == "binary":
+            out["credits"] = c.window
+            out["shm"] = c.shm.name if c.shm is not None else None
+            out["pong"] = await c.ping()
+        await c.close()
+        return out
+
+    try:
+        out = asyncio.run(probe())
+    except (OSError, wire.WireError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        print(f"# {args.host}:{args.port} -> dialect={out['dialect']}"
+              + (f" credits={out['credits']} shm={out['shm']} "
+                 f"pong={out['pong']}"
+                 if out["dialect"] == "binary" else ""))
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "plan":
         return plan_main(argv[1:])
+    if argv and argv[0] == "wire":
+        return wire_main(argv[1:])
     if argv and argv[0] == "faults":
         return faults_main(argv[1:])
     if argv and argv[0] == "multichip":

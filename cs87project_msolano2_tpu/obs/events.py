@@ -307,6 +307,12 @@ _KIND_PAYLOAD = {
     # is firing or resolved, and the burn pair that decided — the
     # obs-live-smoke gate asserts the shape, not just the presence
     "slo_alert": ("objective", "state", "burn"),
+    # the wire front door (docs/SERVING.md "The wire"): a negotiation
+    # must say which dialect/version/credit window it settled on, and
+    # a fallback which version the client offered vs what the server
+    # supports — the wire-smoke gate asserts both shapes
+    "serve_wire_negotiated": ("protocol", "version", "credits"),
+    "serve_wire_fallback": ("offered", "supported"),
 }
 
 

@@ -88,3 +88,29 @@ class BufferPool:
         with self._lock:
             return {shape for (shape, _dt), free in self._free.items()
                     if free}
+
+
+def landing_views(payload, width: int, *, no_xi: bool = False,
+                  dtype: int = 0):
+    """Zero-copy ``(xr, xi)`` float32 views over a binary frame's
+    payload bytes — the landing half of the zero-copy contract: the
+    wire bytes ARE the request planes, and the batcher's staging copy
+    into this pool's arrays is the one host memcpy the request ever
+    pays.  `dtype` is the wire dtype code (``wire.DTYPE_F32`` /
+    ``wire.DTYPE_BF16``); the bf16 path must widen and is charged to
+    the host-copy meter (site ``bf16_wire``)."""
+    from . import wire
+
+    if dtype == wire.DTYPE_BF16:
+        bits = np.frombuffer(payload, np.uint16)
+        # widening bf16 -> f32 materializes new planes: a sanctioned,
+        # METERED copy (the f32 path stays at exactly zero)
+        wire.charge_host_copy(bits.nbytes * 2, site="bf16_wire")
+        full = (bits.astype(np.uint32) << 16).view(np.float32)
+        xr = full[:width]
+        xi = None if no_xi else full[width:2 * width]
+        return xr, xi
+    xr = np.frombuffer(payload, np.float32, count=width)
+    xi = None if no_xi else np.frombuffer(payload, np.float32,
+                                          count=width, offset=width * 4)
+    return xr, xi

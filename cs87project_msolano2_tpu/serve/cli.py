@@ -106,6 +106,18 @@ def serve_main(argv) -> int:
                          "mesh under open-loop load with a mid-run "
                          "device kill and a journaled drain "
                          "(make serve-mesh-smoke)")
+    ap.add_argument("--wire-smoke", action="store_true",
+                    help="wire CI gate (make wire-smoke): both "
+                         "dialects over a real socket must return "
+                         "byte-identical planes, the binary path must "
+                         "charge ZERO metered host-copy bytes, and "
+                         "negotiation/fallback, streaming and the shm "
+                         "lane must round-trip (docs/SERVING.md)")
+    ap.add_argument("--shm", action="store_true",
+                    help="server mode: arm the same-host shared-"
+                         "memory lane — HELLO frames asking for it "
+                         "get a per-connection slot ring "
+                         "(serve/shm.py)")
     ap.add_argument("--devices", type=int, default=None,
                     help="serve on a device mesh of this size "
                          "(MeshDispatcher; mesh-smoke default 8)")
@@ -155,10 +167,20 @@ def serve_main(argv) -> int:
 
     if args.mesh_smoke:
         return _mesh_smoke(cfg, specs or list(MESH_SMOKE_SPECS), args)
+    if args.wire_smoke:
+        return _wire_smoke(cfg, args)
     if args.smoke:
         return _smoke(cfg, specs, args)
 
     from .protocol import serve_socket
+
+    shm_config = None
+    if args.shm:
+        # slot must hold two float32 planes of the largest served
+        # shape (8 MiB floor when serving cold — no warmed set to
+        # size from)
+        slot_bytes = max([s.n * 8 for s in specs] or [1 << 23])
+        shm_config = {"slots": 8, "slot_bytes": slot_bytes}
 
     if args.devices and args.devices > 1:
         from .mesh import MeshConfig, MeshDispatcher
@@ -183,7 +205,8 @@ def serve_main(argv) -> int:
 
     async def main():
         async with dispatcher:
-            await serve_socket(dispatcher, args.host, args.port)
+            await serve_socket(dispatcher, args.host, args.port,
+                               shm_config=shm_config)
 
     try:
         asyncio.run(main())
@@ -364,6 +387,214 @@ def _smoke(cfg: ServeConfig, specs, args) -> int:
     if problems:
         return 1
     print("# serve smoke ok", file=sys.stderr)
+    return 0
+
+
+def _wire_smoke(cfg: ServeConfig, args) -> int:
+    """The ``make wire-smoke`` gate: every claim the wire makes,
+    asserted over a REAL socket in one process —
+
+    * both dialects return BYTE-IDENTICAL float32 planes for the same
+      request (the JSON dialect's float32-faithful serialization);
+    * the binary float32 path's metered ``pifft_host_copy_bytes_total``
+      delta is exactly ZERO (the JSON path's is not — the meter works);
+    * the shm lane round-trips byte-identically, and streaming
+      reassembly returns the same bytes as the inline response;
+    * an unknown-version HELLO falls back to the JSON dialect with a
+      ``serve_wire_fallback`` event; a malformed header closes the
+      connection (``serve_conn_lost``), never hangs;
+    * every emitted event validates against the obs schema.
+    """
+    from .. import obs
+    from ..obs import events as obs_events
+    from ..obs import metrics
+    from . import protocol as proto_mod
+    from . import wire
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    if args.max_wait_ms is None:
+        cfg.max_wait_ms = 2.0
+
+    n_small, n_big = 4096, 1 << 16
+    specs = [ShapeSpec(n=n_small), ShapeSpec(n=1024, domain="r2c"),
+             ShapeSpec(n=n_big)]
+    rng = np.random.default_rng(7)
+    problems: list = []
+    report: dict = {}
+
+    def hc_total() -> float:
+        return sum(v for k, v in
+                   metrics.snapshot()["counters"].items()
+                   if k.startswith("pifft_host_copy_bytes_total"))
+
+    async def main():
+        async with Dispatcher(cfg, specs) as d:
+            server = await asyncio.start_server(
+                lambda r, w: proto_mod.handle_connection(
+                    d, r, w,
+                    shm_config={"slots": 8, "slot_bytes": n_big * 8}),
+                "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            try:
+                xr = rng.standard_normal(n_small).astype(np.float32)
+                xi = rng.standard_normal(n_small).astype(np.float32)
+                # pay the compile cost outside any metered window
+                await d.submit(xr, xi)
+
+                j0 = hc_total()
+                rj = await proto_mod.request_over_socket(
+                    "127.0.0.1", port, xr, xi)
+                report["json_host_copy_delta"] = hc_total() - j0
+                if not rj.get("ok"):
+                    problems.append(f"JSON dialect refused the "
+                                    f"request: {rj}")
+                if report["json_host_copy_delta"] <= 0:
+                    problems.append(
+                        "the JSON dialect charged no host-copy bytes "
+                        "— the meter is dead, so the binary zero "
+                        "below would be vacuous")
+
+                c = await wire.WireClient.connect(
+                    "127.0.0.1", port, want_shm=True)
+                report["dialect"] = c.dialect
+                report["credits"] = c.window
+                report["shm_granted"] = c.shm is not None
+                if c.dialect != "binary":
+                    problems.append(f"HELLO v{wire.WIRE_VERSION} was "
+                                    f"answered in {c.dialect}")
+                b0 = hc_total()
+                rb = await c.request(xr, xi)
+                report["binary_host_copy_delta"] = hc_total() - b0
+                if report["binary_host_copy_delta"] != 0:
+                    problems.append(
+                        f"binary f32 path charged "
+                        f"{report['binary_host_copy_delta']} metered "
+                        f"host-copy bytes (want exactly 0)")
+                if not rb.get("ok"):
+                    problems.append(f"binary dialect refused the "
+                                    f"request: {rb}")
+                elif rj.get("ok"):
+                    jr = np.asarray(rj["yr"], np.float64) \
+                        .astype(np.float32)
+                    ji = np.asarray(rj["yi"], np.float64) \
+                        .astype(np.float32)
+                    if jr.tobytes() != rb["yr"].tobytes() \
+                            or ji.tobytes() != rb["yi"].tobytes():
+                        problems.append(
+                            "JSON and binary dialects returned "
+                            "DIFFERENT plane bytes for the same "
+                            "request")
+
+                # the r2c no-xi path: header flag instead of a plane
+                xr2 = rng.standard_normal(1024).astype(np.float32)
+                rr_b = await c.request(xr2, None, domain="r2c")
+                rr_j = await proto_mod.request_over_socket(
+                    "127.0.0.1", port, xr2, np.zeros_like(xr2),
+                    domain="r2c")
+                if rr_b.get("ok") and rr_j.get("ok"):
+                    if np.asarray(rr_j["yr"], np.float64) \
+                            .astype(np.float32).tobytes() \
+                            != rr_b["yr"].tobytes():
+                        problems.append("r2c planes differ between "
+                                        "dialects")
+                else:
+                    problems.append(f"r2c request failed: "
+                                    f"binary={rr_b.get('ok')} "
+                                    f"json={rr_j.get('ok')}")
+
+                # shm round-trip must equal the inline binary answer
+                rs = await c.request(xr, xi, use_shm=True)
+                if not rs.get("ok"):
+                    problems.append(f"shm request failed: {rs}")
+                elif rb.get("ok") and rs["yr"].tobytes() \
+                        != rb["yr"].tobytes():
+                    problems.append("shm lane returned different "
+                                    "plane bytes than the inline "
+                                    "binary path")
+
+                # streaming reassembly == inline, byte for byte
+                big_r = rng.standard_normal(n_big).astype(np.float32)
+                big_i = rng.standard_normal(n_big).astype(np.float32)
+                await d.submit(big_r, big_i)   # compile outside timing
+                r_inline = await c.request(big_r, big_i)
+                r_stream = await c.request(big_r, big_i, stream=True)
+                if r_inline.get("ok") and r_stream.get("ok"):
+                    if r_inline["yr"].tobytes() \
+                            != r_stream["yr"].tobytes():
+                        problems.append("streamed response reassembled"
+                                        " to different bytes")
+                else:
+                    problems.append(
+                        f"streaming cell failed: inline="
+                        f"{r_inline.get('ok')} "
+                        f"stream={r_stream.get('ok')}")
+                await c.close()
+
+                # negotiation: a future version must land on JSON
+                cf = await wire.WireClient.connect(
+                    "127.0.0.1", port, version=wire.WIRE_VERSION + 7)
+                report["fallback_dialect"] = cf.dialect
+                if cf.dialect != "json":
+                    problems.append(
+                        f"unknown-version HELLO negotiated "
+                        f"{cf.dialect!r}, want the JSON fallback")
+                await cf.close()
+
+                # malformed header: closed with an event, not a hang
+                r0, w0 = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                w0.write(wire.MAGIC + b"\xff" * 60)
+                await w0.drain()
+                data = await asyncio.wait_for(r0.read(64), timeout=5.0)
+                if data:
+                    problems.append("malformed header got a reply "
+                                    "instead of a close")
+                w0.close()
+            finally:
+                server.close()
+                await server.wait_closed()
+
+    asyncio.run(main())
+
+    snapshot = obs_events.snapshot()
+    kinds = [e.get("kind") for e in snapshot]
+    if "serve_wire_fallback" not in kinds:
+        problems.append("no serve_wire_fallback event for the "
+                        "unknown-version HELLO")
+    if "serve_conn_lost" not in kinds:
+        problems.append("no serve_conn_lost event for the malformed "
+                        "header")
+    bad_events = 0
+    for rec in snapshot:
+        for p in obs_events.validate_event(rec):
+            bad_events += 1
+            problems.append(f"event seq={rec.get('seq')}: {p}")
+    report["events"] = len(snapshot)
+    report["schema_invalid_events"] = bad_events
+
+    if owned:
+        obs.disable()
+
+    if args.json:
+        print(json.dumps({"ok": not problems, **report,
+                          "problems": problems},
+                         indent=1, sort_keys=True))
+    else:
+        print(f"# wire smoke: dialect={report.get('dialect')} "
+              f"credits={report.get('credits')} "
+              f"shm={report.get('shm_granted')} "
+              f"binary host-copy delta="
+              f"{report.get('binary_host_copy_delta')} "
+              f"json delta={report.get('json_host_copy_delta')}; "
+              f"{report['events']} event(s), "
+              f"{bad_events} schema-invalid")
+        for p in problems:
+            print(f"# FAIL: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("# wire smoke ok", file=sys.stderr)
     return 0
 
 

@@ -219,8 +219,16 @@ class Response:
         if self.trace is not None:
             rec["trace"] = self.trace
         if arrays:
-            rec["yr"] = np.asarray(self.yr, np.float64).tolist()
-            rec["yi"] = np.asarray(self.yi, np.float64).tolist()
+            # float32-faithful serialization: squeeze through float32
+            # FIRST, then widen to float64 for repr — json emits the
+            # shortest decimal that round-trips the f64, and an f64
+            # holding an exact f32 value recovers that f32 BIT-
+            # IDENTICALLY on decode.  Both wire dialects therefore
+            # deliver the same plane bytes (tests/test_wire.py).
+            rec["yr"] = np.asarray(self.yr, np.float32) \
+                .astype(np.float64).tolist()
+            rec["yi"] = np.asarray(self.yi, np.float32) \
+                .astype(np.float64).tolist()
         return rec
 
 
@@ -453,7 +461,8 @@ class Dispatcher:
                      priority: str = "normal",
                      tenant: str = "default",
                      op: str = "fft",
-                     trace=None) -> Response:
+                     trace=None,
+                     t_recv: Optional[float] = None) -> Response:
         """Serve one n-point transform of float planes ``(n,)``.
         Raises a :class:`ServeError` subclass — never hangs — when the
         request cannot be admitted or no rung could serve it.
@@ -483,14 +492,20 @@ class Dispatcher:
         `trace` continues a caller's trace (a wire ``trace`` field or
         an in-process :class:`~..obs.trace.TraceContext`); omitted, a
         fresh trace is MINTED here — obs/trace.py, the no-op
-        singleton when observability is off."""
+        singleton when observability is off.
+
+        `t_recv` is the wire front's arrival stamp (the clock when the
+        request's bytes finished arriving, BEFORE any decode): when
+        given, it becomes the submit time, so frame decode cost lands
+        in the request's queue phase and tail attribution sees the
+        front door (docs/ANALYSIS.md)."""
         if self._closing:
             raise DispatcherClosed("dispatcher is shut down")
         xr, xi, group = self._validated(xr, xi, layout, precision,
                                         inverse, domain, priority, op)
         self._check_served(group)
         ctx = trace_mod.ensure(trace)
-        t_submit = clock()
+        t_submit = t_recv if t_recv is not None else clock()
         q = self._ensure_worker(group)
         try:
             self._admit(group, q, priority)
@@ -610,6 +625,12 @@ class Dispatcher:
                             shape=group.label(), level=level,
                             depth=q.qsize())
             await self._run_batch(group, batch, rung, level, device)
+            # drop the served batch's refs BEFORE parking on the queue
+            # again: request planes may be zero-copy views over a
+            # client's shm slot ring (serve/shm.py), and a suspended
+            # frame still binding them would pin a closed connection's
+            # segment mapping open
+            req = nxt = batch = None
 
     def _is_device_failure(self, exc: Exception) -> bool:
         """Hook: exceptions the batch path must NOT absorb into

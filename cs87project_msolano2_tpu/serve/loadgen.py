@@ -13,8 +13,15 @@ Row schema is STABLE: every latency field is present in every row,
 every arrival was rejected still rolls up — the summary must never
 crash on the saturation it exists to measure).
 
-``bench.py --serve-load`` drives this over the served shape set;
-:func:`run_mesh_chaos_load` is the mesh tier
+``bench.py --serve-load`` drives this over the served shape set, and
+— since the binary front door landed — also replays TRACE-DRIVEN wire
+load (:func:`run_wire_load`): synthetic diurnal / bursty / heavy-tail
+arrival processes (:func:`arrival_offsets`) over mixed
+op/shape/priority/tenant populations, fired through REAL socket
+connections per wire dialect so the JSON-vs-binary p99 delta is a
+measured fact the per-protocol ``serve_load`` rows carry
+(docs/SERVING.md "The wire").  :func:`run_mesh_chaos_load` is the mesh
+tier
 (``bench.py --serve-mesh`` / ``pifft serve --mesh-smoke``,
 docs/SERVING.md): round-robin open-loop load over a shape set spread
 across a :class:`~.mesh.MeshDispatcher`, with a MID-RUN DEVICE KILL
@@ -345,6 +352,288 @@ async def run_mesh_chaos_load(mesh, specs, rps: float,
         "p99_post_kill_ms": p99_ms(post),
         "utilization": mesh.utilization(),
         "problems": problems,
+    }
+
+
+# ------------------------------------------- trace-driven wire replay
+
+
+#: the synthetic arrival processes replay traces are drawn from
+#: (docs/SERVING.md): real front doors never see the uniform schedule
+#: the classic cells use — diurnal swing, bursts and heavy-tailed
+#: think time are what the credit window and the coalescer must absorb
+ARRIVAL_PROCESSES = ("uniform", "diurnal", "bursty", "heavytail")
+
+
+def arrival_offsets(process: str, rps: float, duration_s: float,
+                    rng) -> list:
+    """Sorted arrival times in ``[0, duration_s)`` for one replay
+    trace, averaging `rps`.  Deterministic given `rng` — a replay is
+    only a replay if two runs see the same schedule.
+
+    - ``uniform``: the classic open-loop grid (``i/rps``).
+    - ``diurnal``: an inhomogeneous Poisson day compressed into the
+      run — rate swings ±80% around `rps` on one sinusoidal period.
+    - ``bursty``: on/off source — quiet floor punctuated by bursts at
+      4x the mean rate (the coalescer's best case, admission's worst).
+    - ``heavytail``: Pareto (alpha=1.5) interarrivals with mean
+      ``1/rps`` — long gaps, hot clumps, no second moment to speak of.
+    """
+    total = max(1, int(rps * duration_s))
+    if process == "uniform":
+        return [i / rps for i in range(total)]
+    if process == "diurnal":
+        # invert the cumulative rate Lambda(t) on a grid: arrival i
+        # lands where Lambda(t)/Lambda(D) crosses (i+u_i)/total
+        grid = np.linspace(0.0, duration_s, 1024)
+        lam = 1.0 + 0.8 * np.sin(2 * np.pi * grid / duration_s)
+        cum = np.concatenate([[0.0], np.cumsum(
+            (lam[1:] + lam[:-1]) * 0.5 * np.diff(grid))])
+        cum /= cum[-1]
+        u = (np.arange(total) + rng.random(total)) / total
+        return sorted(np.interp(u, cum, grid).tolist())
+    if process == "bursty":
+        out: list = []
+        t = 0.0
+        burst_rate = 4.0 * rps
+        # duty cycle ~25%: mean on-time D/12 at 4x, off-time D/4
+        while t < duration_s and len(out) < 4 * total:
+            on = rng.exponential(duration_s / 12.0)
+            end = min(t + on, duration_s)
+            while t < end:
+                out.append(t)
+                t += rng.exponential(1.0 / burst_rate)
+            t += rng.exponential(duration_s / 4.0)
+        return out or [0.0]
+    if process == "heavytail":
+        alpha = 1.5
+        scale = (alpha - 1.0) / alpha / rps  # Pareto mean == 1/rps
+        gaps = scale * (1.0 + rng.pareto(alpha, size=2 * total))
+        times = np.cumsum(gaps)
+        out = times[times < duration_s].tolist()
+        return out[:2 * total] or [0.0]
+    raise ValueError(f"unknown arrival process {process!r} "
+                     f"(one of {ARRIVAL_PROCESSES})")
+
+
+#: population spec defaults: a replay population is a list of
+#: ``(weight, spec)`` pairs, each spec a dict with any of these keys
+_SPEC_DEFAULTS = {"op": "fft", "domain": "c2c", "layout": "natural",
+                  "precision": None, "inverse": False,
+                  "priority": "normal", "tenant": "default"}
+
+
+def _replay_input(spec: dict, rng):
+    n = spec["n"]
+    op = spec.get("op", "fft")
+    domain = spec.get("domain", "c2c")
+    if op in ("conv", "corr"):
+        return (rng.standard_normal(n).astype(np.float32),
+                rng.standard_normal(n).astype(np.float32))
+    if op == "solve":
+        return rng.standard_normal(n).astype(np.float32), None
+    if domain == "c2r":
+        sp = np.fft.rfft(rng.standard_normal(n))
+        return (sp.real.astype(np.float32),
+                sp.imag.astype(np.float32))
+    if domain == "r2c":
+        return rng.standard_normal(n).astype(np.float32), None
+    return (rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal(n).astype(np.float32))
+
+
+class _JsonLoadClient:
+    """Minimal multiplexing JSON-dialect client for the replay driver:
+    pipelines requests over ONE connection and matches replies by
+    ``id`` — so the JSON cells pay the dialect's true parse cost on a
+    persistent connection, not per-request connect overhead."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self._pending: dict = {}
+        self._rid = 0
+        self._lock = asyncio.Lock()
+        self._task = asyncio.ensure_future(self._read_loop())
+
+    @classmethod
+    async def connect(cls, host: str, port: int) -> "_JsonLoadClient":
+        reader, writer = await asyncio.open_connection(host, port)
+        return cls(reader, writer)
+
+    async def _read_loop(self):
+        from . import protocol
+
+        try:
+            while True:
+                rec = await protocol.read_frame(self.reader)
+                if rec is None:
+                    break
+                rec.pop("_t_recv", None)
+                fut = self._pending.pop(rec.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(rec)
+        except (asyncio.IncompleteReadError, ValueError,
+                ConnectionResetError, BrokenPipeError) as e:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(str(e)))
+            self._pending.clear()
+
+    async def request(self, payload: dict) -> dict:
+        from . import protocol
+
+        self._rid += 1
+        rid = self._rid
+        payload = dict(payload, id=rid)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            async with self._lock:
+                self.writer.write(protocol.encode_frame(payload))
+                await self.writer.drain()
+            return await fut
+        finally:
+            self._pending.pop(rid, None)
+
+    async def close(self):
+        self._task.cancel()
+        try:
+            await self._task
+        except asyncio.CancelledError:
+            pass
+        self.writer.close()
+
+
+async def run_wire_load(host: str, port: int, protocol_name: str,
+                        population, rps: float, duration_s: float,
+                        process: str = "uniform", seed: int = 0,
+                        connections: int = 2,
+                        use_shm: bool = False) -> dict:
+    """One replay cell driven over REAL socket connections — the wire
+    dialect's full cost (framing, parse, credits) is inside the
+    client-observed latency, which is the entire point of the
+    per-protocol ``serve_load`` rows (bench.py --serve-load).
+
+    `protocol_name` picks the dialect ("json" or "binary");
+    `population` is a list of ``(weight, spec)`` pairs (specs per
+    ``_SPEC_DEFAULTS`` + ``n``); arrivals follow `process`
+    (:func:`arrival_offsets`).  The row keeps
+    :func:`run_offered_load`'s stable schema and adds ``protocol``,
+    ``process`` and ``connections``."""
+    from . import wire
+
+    rng = np.random.default_rng(seed)
+    weights = np.asarray([float(w) for w, _s in population])
+    weights = weights / weights.sum()
+    specs = [dict(_SPEC_DEFAULTS, **s) for _w, s in population]
+    inputs = [_replay_input(s, rng) for s in specs]
+
+    if protocol_name == "binary":
+        clients = [await wire.WireClient.connect(
+            host, port, want_shm=use_shm)
+            for _ in range(max(1, connections))]
+    else:
+        clients = [await _JsonLoadClient.connect(host, port)
+                   for _ in range(max(1, connections))]
+
+    ok: list = []          # (client_total_s, record)
+    rejected: list = []    # structured backpressure records
+    failed: list = []
+
+    async def one(i: int, si: int):
+        spec = specs[si]
+        xr, xi = inputs[si]
+        client = clients[i % len(clients)]
+        t0 = clock()
+        try:
+            if protocol_name == "binary":
+                rec = await client.request(
+                    xr, xi, op=spec["op"], layout=spec["layout"],
+                    precision=spec["precision"],
+                    inverse=spec["inverse"], domain=spec["domain"],
+                    priority=spec["priority"], tenant=spec["tenant"],
+                    use_shm=use_shm and client.shm is not None)
+            else:
+                payload = {"op": spec["op"],
+                           "xr": np.asarray(xr, np.float64).tolist(),
+                           "layout": spec["layout"],
+                           "precision": spec["precision"],
+                           "inverse": spec["inverse"],
+                           "domain": spec["domain"],
+                           "priority": spec["priority"],
+                           "tenant": spec["tenant"]}
+                if xi is not None:
+                    payload["xi"] = np.asarray(xi, np.float64).tolist()
+                rec = await client.request(payload)
+        except (ConnectionError, wire.WireError, OSError) as e:
+            failed.append({"type": "transport",
+                           "message": str(e)[:200]})
+            return
+        if rec.get("ok"):
+            ok.append((clock() - t0, rec))
+        elif (rec.get("error") or {}).get("type") == "queue_full":
+            rejected.append(rec["error"])
+        else:
+            failed.append(rec.get("error") or {"type": "unknown"})
+
+    offsets = arrival_offsets(process, rps, duration_s, rng)
+    draws = rng.choice(len(specs), size=len(offsets), p=weights)
+    t_start = clock()
+    tasks = []
+    try:
+        for i, off in enumerate(offsets):
+            delay = (t_start + off) - clock()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.ensure_future(one(i, int(draws[i]))))
+        await asyncio.gather(*tasks)
+    finally:
+        for c in clients:
+            await c.close()
+    elapsed = max(clock() - t_start, 1e-9)
+
+    totals = [t for t, _ in ok]
+    queues = [r.get("queue_wait_ms") for _, r in ok
+              if r.get("queue_wait_ms") is not None]
+    computes = [r.get("compute_ms") for _, r in ok
+                if r.get("compute_ms") is not None]
+
+    def ms(values, q, scale=1.0):
+        v = percentile_or_none(values, q)
+        return round(v * scale, 4) if v is not None else None
+
+    ns = sorted({s["n"] for s in specs})
+    shape = ("mixed" if len(specs) > 1 else
+             f"n2^{specs[0]['n'].bit_length() - 1}"
+             f":{specs[0]['layout']}"
+             + (f":{specs[0]['op']}" if specs[0]["op"] != "fft"
+                else ""))
+    return {
+        "shape": shape,
+        "n": ns[-1],
+        "op": specs[0]["op"] if len(specs) == 1 else "mixed",
+        "protocol": protocol_name,
+        "process": process,
+        "connections": len(clients),
+        "offered_rps": round(rps, 1),
+        "duration_s": round(elapsed, 4),
+        "requests": len(offsets),
+        "completed": len(ok),
+        "rejected": len(rejected),
+        "failed": len(failed),
+        "achieved_rps": round(len(ok) / elapsed, 1),
+        "degraded": sum(1 for _, r in ok if r.get("degraded")),
+        "p50_ms": ms(totals, 50, 1e3),
+        "p99_ms": ms(totals, 99, 1e3),
+        "queue_p50_ms": ms(queues, 50),
+        "queue_p99_ms": ms(queues, 99),
+        "compute_p50_ms": ms(computes, 50),
+        "compute_p99_ms": ms(computes, 99),
+        "retry_after_p50_ms": ms(
+            [e.get("retry_after_ms") for e in rejected
+             if isinstance(e, dict)
+             and e.get("retry_after_ms") is not None], 50),
     }
 
 

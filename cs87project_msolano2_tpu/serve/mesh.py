@@ -244,7 +244,8 @@ class MeshDispatcher(Dispatcher):
                      priority: str = "normal",
                      tenant: str = "default",
                      op: str = "fft",
-                     trace=None):
+                     trace=None,
+                     t_recv: Optional[float] = None):
         """:meth:`Dispatcher.submit`, mesh-routed: validation and the
         class-aware bounded admission are the shared base logic; the
         queue is the ROUTED device's, and the tenant-quota layer runs
@@ -261,7 +262,7 @@ class MeshDispatcher(Dispatcher):
                                         inverse, domain, priority, op)
         self._check_served(group)
         ctx = trace_mod.ensure(trace)
-        t_submit = clock()
+        t_submit = t_recv if t_recv is not None else clock()
         # choose first, RECORD only after admission passes: a shed
         # request must not inflate the placement counter the
         # affinity assertions read

@@ -1,0 +1,561 @@
+"""Tests for the binary front door (docs/SERVING.md "The wire"):
+frame codec round-trips, dialect negotiation (version fallback,
+malformed-header close, truncation tolerance), bit-identity of served
+planes across the JSON and binary dialects, per-connection flow
+control, streaming reassembly, the same-host shm lane, the host-copy
+meter's zero-delta contract on the binary float32 path, the replay
+arrival processes, check rule PIF117, and the analyze loader's
+per-protocol serve_load parsing."""
+
+import asyncio
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs
+from cs87project_msolano2_tpu.serve import (
+    Dispatcher,
+    ServeConfig,
+    ShapeSpec,
+)
+from cs87project_msolano2_tpu.serve import protocol, wire
+from cs87project_msolano2_tpu.serve.loadgen import (
+    ARRIVAL_PROCESSES,
+    arrival_offsets,
+)
+
+N = 256
+
+
+def planes(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal(n).astype(np.float32))
+
+
+def run_async(coro, timeout_s=120.0):
+    async def bounded():
+        return await asyncio.wait_for(coro, timeout=timeout_s)
+
+    return asyncio.run(bounded())
+
+
+@pytest.fixture
+def obs_run():
+    obs.enable()
+    yield obs
+    obs.disable()
+
+
+def host_copy_total() -> float:
+    from cs87project_msolano2_tpu.obs import metrics
+
+    return sum(v for k, v in metrics.snapshot()["counters"].items()
+               if k.startswith("pifft_host_copy_bytes_total"))
+
+
+class _BufReader:
+    """A minimal asyncio.StreamReader stand-in over in-memory bytes."""
+
+    def __init__(self, data: bytes):
+        self._data = memoryview(data)
+        self._pos = 0
+
+    async def readexactly(self, n: int) -> bytes:
+        chunk = bytes(self._data[self._pos:self._pos + n])
+        if len(chunk) < n:
+            raise asyncio.IncompleteReadError(chunk, n)
+        self._pos += n
+        return chunk
+
+
+# ------------------------------------------------------- frame codec
+
+
+def test_frame_codec_round_trip_preserves_planes_and_fields():
+    xr, xi = planes()
+    bufs = wire.encode_frame(
+        wire.MSG_REQUEST, flags=wire.F_STREAM, op="conv", domain="r2c",
+        precision="bf16", priority="high", inverse=True, rid=77,
+        n=N, width=N, slot=3, extras={"tenant": "batch"},
+        payload=[wire.as_bytes_view(xr), wire.as_bytes_view(xi)])
+    frame = run_async(wire.read_wire_frame(
+        _BufReader(b"".join(bytes(b) for b in bufs))))
+    assert frame.msg_type == wire.MSG_REQUEST
+    assert frame.flags & wire.F_STREAM
+    assert (frame.op, frame.domain, frame.precision,
+            frame.priority) == ("conv", "r2c", "bf16", "high")
+    assert frame.inverse and frame.rid == 77 and frame.slot == 3
+    assert frame.extras == {"tenant": "batch"}
+    got = np.frombuffer(frame.payload, np.float32)
+    assert got[:N].tobytes() == xr.tobytes()
+    assert got[N:].tobytes() == xi.tobytes()
+
+
+def test_parse_header_rejects_out_of_contract_frames():
+    good = bytes(wire.encode_frame(wire.MSG_PING)[0])
+    assert wire.parse_header(good).msg_type == wire.MSG_PING
+    with pytest.raises(wire.WireError):
+        wire.parse_header(b"JUNK" + good[4:])
+    bad_type = bytearray(good)
+    bad_type[8] = 200
+    with pytest.raises(wire.WireError):
+        wire.parse_header(bytes(bad_type))
+    with pytest.raises(wire.WireError):
+        wire.encode_frame(wire.MSG_REQUEST, op="not-an-op")
+    with pytest.raises(wire.WireError):
+        wire.encode_frame(
+            wire.MSG_REQUEST,
+            extras={"pad": "x" * (wire.MAX_EXTRAS_BYTES + 1)})
+
+
+def test_json_length_prefix_and_magic_never_collide():
+    # dialect detection hinges on this: the JSON frame cap keeps every
+    # legal big-endian length prefix below b"PIFB" read as a u32
+    (magic_as_len,) = struct.unpack(">I", wire.MAGIC)
+    assert magic_as_len > protocol.MAX_FRAME_BYTES
+
+
+# ------------------------------------- both dialects over one socket
+
+
+async def _start_server(cfg=None, specs=None, shm_config=None):
+    d = Dispatcher(cfg or ServeConfig(max_batch=4, max_wait_ms=1.0),
+                   specs or [ShapeSpec(n=N)])
+    await d.__aenter__()
+    server = await asyncio.start_server(
+        lambda r, w: protocol.handle_connection(d, r, w,
+                                                shm_config=shm_config),
+        "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    return d, server, port
+
+
+async def _stop(d, server):
+    server.close()
+    await server.wait_closed()
+    await d.close()
+
+
+def test_binary_and_json_dialects_serve_bit_identical_planes(obs_run):
+    xr, xi = planes()
+
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            direct = await d.submit(xr.copy(), xi.copy())
+            jrec = await protocol.request_over_socket(
+                "127.0.0.1", port, xr, xi)
+            before = host_copy_total()
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            try:
+                assert client.dialect == "binary"
+                assert await client.ping()
+                brec = await client.request(xr, xi)
+            finally:
+                await client.close()
+            binary_delta = host_copy_total() - before
+            return direct, jrec, brec, binary_delta
+        finally:
+            await _stop(d, server)
+
+    direct, jrec, brec, binary_delta = run_async(main())
+    want_r = np.asarray(direct.yr, np.float32).tobytes()
+    want_i = np.asarray(direct.yi, np.float32).tobytes()
+    # the JSON dialect is float32-faithful: f64 JSON text round-trips
+    # the exact f32 planes, so both dialects serve THE SAME BYTES
+    assert np.asarray(jrec["yr"], np.float32).tobytes() == want_r
+    assert np.asarray(jrec["yi"], np.float32).tobytes() == want_i
+    assert brec["ok"] and not brec["degraded"]
+    assert np.asarray(brec["yr"], np.float32).tobytes() == want_r
+    assert np.asarray(brec["yi"], np.float32).tobytes() == want_i
+    # the tentpole contract: the binary float32 path copies NOTHING on
+    # the host that the meter would have to own up to
+    assert binary_delta == 0.0
+
+
+def test_json_dialect_charges_the_host_copy_meter(obs_run):
+    xr, xi = planes()
+
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            before = host_copy_total()
+            await protocol.request_over_socket("127.0.0.1", port, xr, xi)
+            return host_copy_total() - before
+        finally:
+            await _stop(d, server)
+
+    assert run_async(main()) > 0
+
+
+# -------------------------------------------------------- negotiation
+
+
+def test_unknown_wire_version_falls_back_to_json_dialect(obs_run):
+    xr, xi = planes()
+
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            client = await wire.WireClient.connect(
+                "127.0.0.1", port, version=wire.WIRE_VERSION + 7)
+            assert client.dialect == "json"
+            assert client.fallback.get("dialect") == "json"
+            # the connection SURVIVES in the JSON dialect: speak it
+            frame = {"op": "fft", "id": 1, "xr": xr.tolist(),
+                     "xi": xi.tolist(), "layout": "natural",
+                     "domain": "c2c", "inverse": False,
+                     "precision": None}
+            client.writer.write(protocol.encode_frame(frame))
+            await client.writer.drain()
+            reply = await protocol.read_frame(client.reader)
+            client.writer.close()
+            return reply
+        finally:
+            await _stop(d, server)
+
+    reply = run_async(main())
+    reply.pop("_t_recv", None)
+    assert reply["ok"]
+    kinds = [e["kind"] for e in obs_run.events.snapshot()]
+    assert "serve_wire_fallback" in kinds
+    fallback = next(e for e in obs_run.events.snapshot()
+                    if e["kind"] == "serve_wire_fallback")
+    assert fallback["payload"]["offered"] == wire.WIRE_VERSION + 7
+    assert fallback["payload"]["supported"] == wire.WIRE_VERSION
+
+
+def test_malformed_header_closes_with_conn_lost_never_hangs(obs_run):
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(wire.MAGIC + b"\xff" * 60)
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(), timeout=10.0)
+            writer.close()
+            # the server is still alive for the NEXT connection
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            assert await client.ping()
+            await client.close()
+            return got
+        finally:
+            await _stop(d, server)
+
+    got = run_async(main())
+    assert got == b""
+    kinds = [e["kind"] for e in obs_run.events.snapshot()]
+    assert "serve_conn_lost" in kinds
+
+
+def test_truncated_frame_is_a_tolerated_disconnect(obs_run):
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            _reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            # a valid HELLO, then half a header: the client went away
+            for buf in wire.encode_frame(wire.MSG_HELLO):
+                writer.write(buf)
+            writer.write(wire.MAGIC + b"\x01")
+            await writer.drain()
+            writer.close()
+            await asyncio.sleep(0.1)
+            # the server neither hung nor died
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            assert await client.ping()
+            await client.close()
+        finally:
+            await _stop(d, server)
+
+    run_async(main())
+
+
+def test_negotiated_event_names_protocol_and_credits(obs_run):
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            window = client.window
+            await client.close()
+            return window
+        finally:
+            await _stop(d, server)
+
+    window = run_async(main())
+    assert window == wire.DEFAULT_CREDITS
+    neg = [e for e in obs_run.events.snapshot()
+           if e["kind"] == "serve_wire_negotiated"]
+    assert neg and neg[0]["payload"]["protocol"] == "binary"
+    assert neg[0]["payload"]["credits"] == window
+    from cs87project_msolano2_tpu.obs import events as obs_events
+
+    for e in obs_run.events.snapshot():
+        assert obs_events.validate_event(e) == []
+
+
+# ------------------------------------------------------- flow control
+
+
+def test_flow_control_violation_is_structured_not_fatal():
+    xr, xi = planes()
+
+    async def main():
+        # a long batching window holds requests in flight while the
+        # burst lands, so exceeding the credit window is deterministic
+        cfg = ServeConfig(max_batch=64, max_wait_ms=200.0,
+                          queue_depth=128)
+        d, server, port = await _start_server(cfg=cfg)
+        try:
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            try:
+                burst = client.window + 4
+                # bypass the client's own credit gate: write raw
+                # REQUEST frames back to back
+                futs = {}
+                for _ in range(burst):
+                    rid = client._next_rid()
+                    futs[rid] = asyncio.get_running_loop() \
+                        .create_future()
+                    client._pending[rid] = futs[rid]
+                    for buf in wire.encode_frame(
+                            wire.MSG_REQUEST, rid=rid, n=N, width=N,
+                            payload=[wire.as_bytes_view(xr),
+                                     wire.as_bytes_view(xi)]):
+                        client.writer.write(buf)
+                await client.writer.drain()
+                frames = await asyncio.gather(*futs.values())
+                errors = [f for f in frames
+                          if f.msg_type == wire.MSG_ERROR]
+                ok = [f for f in frames
+                      if f.msg_type == wire.MSG_RESPONSE]
+                # the violating requests got a structured error naming
+                # the discipline; everything in-window was SERVED —
+                # the connection survived its misbehaving client
+                assert errors, "burst never exceeded the window"
+                for f in errors:
+                    assert f.extras["error"]["type"] == "flow_control"
+                assert len(ok) >= client.window
+                assert await client.ping()
+            finally:
+                await client.close()
+        finally:
+            await _stop(d, server)
+
+    run_async(main())
+
+
+# ------------------------------------------- streaming and the shm lane
+
+
+def test_streaming_response_reassembles_bit_identically(obs_run):
+    n = 1 << 16  # 2 planes * 256 KiB > STREAM_CHUNK_BYTES: must chunk
+    xr, xi = planes(n=n)
+
+    async def main():
+        d, server, port = await _start_server(specs=[ShapeSpec(n=n)])
+        try:
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            try:
+                inline = await client.request(xr, xi)
+                streamed = await client.request(xr, xi, stream=True)
+            finally:
+                await client.close()
+            return inline, streamed
+        finally:
+            await _stop(d, server)
+
+    inline, streamed = run_async(main())
+    assert streamed["yr"].tobytes() == inline["yr"].tobytes()
+    assert streamed["yi"].tobytes() == inline["yi"].tobytes()
+
+
+def test_shm_lane_round_trip_matches_inline(obs_run):
+    xr, xi = planes()
+
+    async def main():
+        d, server, port = await _start_server(
+            shm_config={"slots": 4, "slot_bytes": N * 8})
+        try:
+            client = await wire.WireClient.connect(
+                "127.0.0.1", port, want_shm=True)
+            try:
+                assert client.shm is not None
+                inline = await client.request(xr, xi)
+                over_shm = await client.request(xr, xi, use_shm=True)
+                # slots recycle: more requests than slots must not jam
+                for _ in range(6):
+                    again = await client.request(xr, xi, use_shm=True)
+                    assert again["yr"].tobytes() \
+                        == inline["yr"].tobytes()
+            finally:
+                await client.close()
+            return inline, over_shm
+        finally:
+            await _stop(d, server)
+
+    inline, over_shm = run_async(main())
+    assert over_shm["yr"].tobytes() == inline["yr"].tobytes()
+    assert over_shm["yi"].tobytes() == inline["yi"].tobytes()
+
+
+# -------------------------------------------------- arrival processes
+
+
+def test_arrival_processes_are_deterministic_and_bounded():
+    rps, duration = 200.0, 1.0
+    for process in ARRIVAL_PROCESSES:
+        a = arrival_offsets(process, rps, duration,
+                            np.random.default_rng(7))
+        b = arrival_offsets(process, rps, duration,
+                            np.random.default_rng(7))
+        assert a == b, f"{process} replay is not deterministic"
+        assert a == sorted(a), f"{process} offsets are unsorted"
+        assert all(0.0 <= t < duration for t in a)
+        # averaging `rps` means the count is in the right decade
+        assert len(a) >= int(rps * duration) * 0.2, process
+    uniform = arrival_offsets("uniform", rps, duration,
+                              np.random.default_rng(0))
+    assert uniform == [i / rps for i in range(int(rps * duration))]
+    with pytest.raises(ValueError):
+        arrival_offsets("lunar", rps, duration,
+                        np.random.default_rng(0))
+
+
+# ------------------------------------------------------------- PIF117
+
+
+CHARGED = '''
+import json
+from . import wire
+
+def read_body(body):
+    wire.charge_host_copy(len(body), site="json_decode")
+    return json.loads(body.decode("utf-8"))
+'''
+
+UNCHARGED = '''
+import json
+
+def read_body(body):
+    return json.loads(body.decode("utf-8"))
+'''
+
+HEADER_UNPACK = '''
+import struct
+_LEN = struct.Struct(">I")
+
+def read_len(head):
+    (length,) = _LEN.unpack(head)
+    return length
+'''
+
+LOOP_UNPACK = '''
+import struct
+
+def decode_all(buf, n):
+    out = []
+    for i in range(n):
+        out.append(struct.unpack("<d", buf[i * 8:(i + 1) * 8]))
+    return out
+'''
+
+LIST_LANDING = '''
+import numpy as np
+
+def land(values):
+    return np.asarray(list(values), np.float32)
+'''
+
+
+def _pif117(source, path="x/serve/protocol.py"):
+    from cs87project_msolano2_tpu.check.engine import check_source
+
+    return check_source(path, source, rules=["PIF117"])
+
+
+def test_pif117_flags_uncharged_decodes_only():
+    assert _pif117(CHARGED) == []
+    assert _pif117(HEADER_UNPACK) == []
+    for bad in (UNCHARGED, LOOP_UNPACK, LIST_LANDING):
+        findings = _pif117(bad)
+        assert [f.rule for f in findings] == ["PIF117"]
+        assert "charge_host_copy" in findings[0].message
+
+
+def test_pif117_is_scoped_to_the_landing_modules():
+    assert _pif117(UNCHARGED, path="x/serve/wire.py") == []
+    assert _pif117(UNCHARGED, path="x/analyze/loader.py") == []
+    assert _pif117(LIST_LANDING, path="x/serve/buffers.py")
+
+
+def test_pif117_suppression_demands_a_reason():
+    blanket = UNCHARGED.replace(
+        "return json.loads(body.decode(\"utf-8\"))",
+        "return json.loads(body.decode(\"utf-8\"))  # pifft: noqa")
+    assert _pif117(blanket), "blanket noqa must not silence PIF117"
+    bare = UNCHARGED.replace(
+        "return json.loads(body.decode(\"utf-8\"))",
+        "return json.loads(body.decode(\"utf-8\"))"
+        "  # pifft: noqa[PIF117]")
+    assert _pif117(bare), "a reasonless noqa[PIF117] must not count"
+    reasoned = UNCHARGED.replace(
+        "return json.loads(body.decode(\"utf-8\"))",
+        "return json.loads(body.decode(\"utf-8\"))"
+        "  # pifft: noqa[PIF117]: cold path, measured elsewhere")
+    assert _pif117(reasoned) == []
+
+
+# ------------------------------------------------- loader integration
+
+
+def test_loader_parses_per_protocol_serve_load_rows(tmp_path):
+    from cs87project_msolano2_tpu.analyze.loader import (
+        bench_samples,
+        load_bench_round,
+    )
+
+    rec = {
+        "metric": "serve_slo_p99_ms", "value": 42.0, "unit": "ms",
+        "smoke": True,
+        "serve_load": [
+            {"n": 4096, "protocol": "inproc", "offered_rps": 120.0,
+             "p99_ms": 9.0, "degraded": 0, "failed": 0},
+            {"n": 4096, "protocol": "json", "process": "uniform",
+             "offered_rps": 120.0, "p99_ms": 42.0, "degraded": 0,
+             "failed": 0},
+            {"n": 4096, "protocol": "binary", "process": "bursty",
+             "offered_rps": 120.0, "p99_ms": 8.5, "degraded": 0,
+             "failed": 0},
+            # a pre-wire row with no protocol key: backfills "json"
+            {"n": 4096, "offered_rps": 60.0, "p99_ms": 55.0,
+             "degraded": 0, "failed": 0},
+        ],
+    }
+    p = tmp_path / "BENCH_r09.json"
+    p.write_text(json.dumps(rec))
+    rnd = load_bench_round(str(p))
+    assert rnd.metrics["serve_load_inproc_p99_ms"] == 9.0
+    assert rnd.metrics["serve_load_binary_p99_ms"] == 8.5
+    # the json scalar folds the backfilled pre-wire row in: max(42, 55)
+    assert rnd.metrics["serve_load_json_p99_ms"] == 55.0
+    assert len(rnd.serve_load_rows) == 4
+    rows = [s for s in bench_samples(rnd)
+            if s.metric == "serve_load_p99_ms"]
+    assert [s.protocol for s in rows] == ["inproc", "json", "binary",
+                                          "json"]
+    assert all(s.n == 4096 for s in rows)
+    scalars = {s.metric: s.protocol for s in bench_samples(rnd)
+               if s.metric.startswith("serve_load_")
+               and s.metric.endswith("_p99_ms")
+               and s.metric != "serve_load_p99_ms"}
+    assert scalars == {"serve_load_inproc_p99_ms": "inproc",
+                       "serve_load_json_p99_ms": "json",
+                       "serve_load_binary_p99_ms": "binary"}
+    # every other sample keeps the "json" protocol backfill
+    assert all(s.protocol == "json" for s in bench_samples(rnd)
+               if not s.metric.startswith("serve_load"))
