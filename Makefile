@@ -11,7 +11,7 @@ SHELL := /bin/bash
         rfft-smoke precision-smoke apps-smoke multichip-smoke \
         obs-live-smoke replicate run-experiments \
         run-experiments-and-analyze-results analyze analyze-datasets \
-        analyze-smoke check lint
+        analyze-smoke check check-stats lint
 
 all:
 	$(MAKE) -C cs87project_msolano2_tpu/native all
@@ -377,6 +377,13 @@ obs-live-smoke:
 check:
 	python3 -m cs87project_msolano2_tpu.cli check \
 	  --baseline check-baseline.json
+
+# the same run with the per-phase/per-rule wall-time table and the
+# summary-cache hit counts — what to reach for when the CI 60s guard
+# trips (docs/CHECKS.md, "--stats")
+check-stats:
+	python3 -m cs87project_msolano2_tpu.cli check \
+	  --baseline check-baseline.json --stats
 
 # lint = ruff (general Python hygiene; skipped with a notice where the
 # environment lacks it) + pifft check (project invariants).  Both always

@@ -21,6 +21,19 @@ enforces those invariants mechanically:
                 PIF112 unguarded shared-state write, PIF113
                 await-holding-lock, PIF114 unpaired resource, PIF115
                 untagged demotion.
+* ``callgraph`` — the whole-program layer: import-map-aware call graph
+                over every FileContext in the run (receiver-type
+                heuristics, ``functools.partial``, classmethod
+                constructors).
+* ``summaries`` — per-function dataflow summaries (source/param→sink,
+                sanitizer facts, locks, blocking and demote effects)
+                with a content-hash disk cache (``PIFFT_CHECK_CACHE``)
+                that also drives ``--changed`` invalidation.
+* ``taint``   — interprocedural rules on top: PIF118 untrusted size to
+                allocation/index, PIF119 unvalidated shape to plan
+                construction, PIF120 lock held across a blocking
+                callee, PIF121 degrade tag dropped across a call; all
+                carry source→sink paths (SARIF ``codeFlows``).
 * ``runtime`` — what static analysis cannot see, as pytest fixtures:
                 ``tracer_leak_guard`` (jax.checking_leaks) and
                 ``RecompileGuard`` (per-function retrace budgets).
@@ -28,13 +41,17 @@ enforces those invariants mechanically:
                 against the committed ``check-baseline.json``.
 """
 
+from .callgraph import Program  # noqa: F401
 from .engine import (  # noqa: F401
     Finding,
+    ProgramRule,
     Rule,
+    RunStats,
     all_rules,
     changed_files,
     check_paths,
     check_source,
+    check_sources,
     collect_noqa,
     compare_baseline,
     load_baseline,
@@ -49,6 +66,7 @@ from .flow import (  # noqa: F401
     flow_locksets,
     pair_events,
 )
+from .summaries import SummaryCache  # noqa: F401
 from .runtime import (  # noqa: F401
     RecompileBudgetExceeded,
     RecompileGuard,

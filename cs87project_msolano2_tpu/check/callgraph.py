@@ -1,0 +1,272 @@
+"""Whole-program context for the check subsystem: the call graph.
+
+The per-function layer (:mod:`.flow`, :mod:`.rules_flow`) stops at the
+enclosing ``def``; the serving front door does not.  A 48-byte wire
+header decoded in ``parse_header`` flows through ``_handle_binary``
+into ``ShmRing.slot_planes`` before it reaches a ``frombuffer`` count —
+three frames deep.  This module builds the :class:`Program` the
+interprocedural rules (:mod:`.taint`) walk: every
+:class:`~.engine.FileContext` in the run, a table of function
+definitions keyed by ``module:qualname``, and a call-site resolver that
+chases imports (absolute AND relative), receiver types, ``self``/
+``cls`` methods, classmethod constructors and ``functools.partial``.
+
+Resolution is deliberately heuristic — this is a linter, not a type
+checker — and errs toward *resolving*: an unresolved edge silently
+truncates a taint path, so a unique-by-name fallback catches the
+helper-moved-to-another-module case.  Everything here is pure ``ast``
+over already-parsed trees; nothing imports the analyzed code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Optional
+
+from .flow import FN_DEFS
+
+#: methods that conventionally construct an instance of their class —
+#: ``ring = ShmRing.attach(...)`` types ``ring`` as a ShmRing
+_CTOR_METHOD_PREFIXES = ("create", "attach", "connect", "open", "from_")
+
+
+def module_name(path: str) -> str:
+    """Dotted module name for a display path: ``pkg/serve/wire.py`` ->
+    ``pkg.serve.wire``; a package ``__init__.py`` names the package."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = [p for p in norm.split("/") if p and p != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def absolute_imports(tree: ast.AST, module: str) -> dict:
+    """name-in-scope -> absolute dotted origin, with *relative* imports
+    resolved against `module` (which :class:`~.engine.ImportMap` leaves
+    alone: it canonicalizes spellings, not packages)."""
+    pkg_parts = module.split(".")[:-1] if module else []
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    out[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # `from .shm import X` / `from ..obs import Y`
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                if node.module:
+                    base = base + node.module.split(".")
+                origin = ".".join(base)
+            else:
+                origin = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                dotted = f"{origin}.{a.name}" if origin else a.name
+                out[a.asname or a.name] = dotted
+    return out
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function definition in the program."""
+
+    fid: str                    # "module:qualname"
+    module: str
+    qualname: str               # "Class.method", "outer.inner", "fn"
+    name: str                   # last qualname segment
+    cls: Optional[str]          # enclosing class name, if a method
+    node: ast.AST               # the FunctionDef / AsyncFunctionDef
+    ctx: object                 # the owning FileContext
+    path: str
+
+    @property
+    def lineno(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+def _collect(ctx, module: str):
+    """Yield FunctionInfo for every def in a file, plus the class table
+    {class name -> set of method names}."""
+    classes: dict = {}
+    infos: list = []
+
+    def walk(node, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                classes.setdefault(child.name, set())
+                walk(child, f"{prefix}{child.name}.", child.name)
+            elif isinstance(child, FN_DEFS):
+                qual = f"{prefix}{child.name}"
+                if cls is not None:
+                    classes.setdefault(cls, set()).add(child.name)
+                infos.append(FunctionInfo(
+                    fid=f"{module}:{qual}", module=module, qualname=qual,
+                    name=child.name, cls=cls, node=child, ctx=ctx,
+                    path=ctx.path))
+                # nested defs keep the qual prefix but leave the class:
+                # a def inside a method is a plain closure
+                walk(child, f"{qual}.", None)
+
+    walk(ctx.tree, "", None)
+    return infos, classes
+
+
+class Program:
+    """All FileContexts of one run, indexed for call resolution.
+
+    ``contexts`` maps display path -> FileContext; ``cache`` is the
+    program-wide scratch space interprocedural rules share (mirroring
+    ``FileContext.flow_cache`` one level up)."""
+
+    def __init__(self, contexts: Iterable):
+        self.contexts: dict = {}
+        self.functions: dict = {}        # fid -> FunctionInfo
+        self.by_module_qual: dict = {}   # (module, qualname) -> fid
+        self.by_name: dict = {}          # bare name -> [fid]
+        self.by_class_method: dict = {}  # (class, method) -> [fid]
+        self.classes: dict = {}          # (module, class) -> {methods}
+        self.class_modules: dict = {}    # class name -> [module]
+        self.module_of: dict = {}        # path -> module
+        self.path_of: dict = {}          # module -> path
+        self.imports: dict = {}          # module -> {alias: absolute}
+        for ctx in contexts:
+            mod = module_name(ctx.path)
+            self.contexts[ctx.path] = ctx
+            self.module_of[ctx.path] = mod
+            self.path_of[mod] = ctx.path
+            self.imports[mod] = absolute_imports(ctx.tree, mod)
+            infos, classes = _collect(ctx, mod)
+            for info in infos:
+                self.functions[info.fid] = info
+                self.by_module_qual[(mod, info.qualname)] = info.fid
+                self.by_name.setdefault(info.name, []).append(info.fid)
+                if info.cls:
+                    self.by_class_method.setdefault(
+                        (info.cls, info.name), []).append(info.fid)
+            for cname, methods in classes.items():
+                self.classes[(mod, cname)] = methods
+                self.class_modules.setdefault(cname, []).append(mod)
+        self.cache: dict = {}
+
+    # ------------------------------------------------------- resolution
+
+    def _import_origin(self, module: str, head: str) -> Optional[str]:
+        return self.imports.get(module, {}).get(head)
+
+    def _lookup(self, module: str, qualname: str) -> Optional[str]:
+        return self.by_module_qual.get((module, qualname))
+
+    def _class_method(self, cls: str, meth: str,
+                      module: Optional[str] = None) -> Optional[str]:
+        """fid of Class.meth — in `module` if given, else unique across
+        the program."""
+        if module is not None:
+            return self._lookup(module, f"{cls}.{meth}")
+        fids = self.by_class_method.get((cls, meth), [])
+        return fids[0] if len(fids) == 1 else None
+
+    def _resolve_dotted(self, module: str, dotted: str) -> Optional[str]:
+        """Resolve an absolute dotted target (`pkg.serve.wire.parse`,
+        `pkg.serve.shm.ShmRing.attach`) against the def tables by
+        peeling the longest module prefix."""
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod in self.path_of:
+                qual = ".".join(parts[cut:])
+                fid = self._lookup(mod, qual)
+                if fid:
+                    return fid
+                # module.Class.method where the class table knows the
+                # class but the qual spelling differs: nothing to do —
+                # quals already use Class.method form
+                return None
+        return None
+
+    def resolve(self, module: str, raw: dict) -> Optional[str]:
+        """fid for one recorded call site, or None.
+
+        `raw` is the summary-layer record: ``dotted`` (the spelled
+        target), optional ``recv_type`` (inferred receiver class) and
+        ``encl_class`` (the class whose method contains the call)."""
+        dotted = raw.get("dotted")
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+
+        if not rest:
+            # bare name: local def, imported function, unique fallback
+            fid = self._lookup(module, dotted)
+            if fid:
+                return fid
+            origin = self._import_origin(module, head)
+            if origin:
+                fid = self._resolve_dotted(module, origin)
+                if fid:
+                    return fid
+            fids = self.by_name.get(dotted, [])
+            return fids[0] if len(fids) == 1 else None
+
+        meth = parts[-1]
+        if head in ("self", "cls") and len(parts) == 2:
+            encl = raw.get("encl_class")
+            if encl:
+                fid = self._lookup(module, f"{encl}.{meth}")
+                if fid:
+                    return fid
+            # fall through to the unique-method fallback below
+        elif head not in ("self", "cls"):
+            # receiver spelled as a name chain: module attr, class
+            # attr, or typed local
+            origin = self._import_origin(module, head)
+            target = ".".join([origin] + rest) if origin else dotted
+            fid = self._resolve_dotted(module, target)
+            if fid:
+                return fid
+            # ClassName.method on a locally-defined class
+            if len(parts) == 2 and (module, head) in self.classes:
+                fid = self._lookup(module, f"{head}.{meth}")
+                if fid:
+                    return fid
+            # imported ClassName.method: origin ends in the class name
+            if origin and len(parts) == 2:
+                op = origin.split(".")
+                mod, cname = ".".join(op[:-1]), op[-1]
+                fid = self._class_method(cname, meth, module=mod)
+                if fid:
+                    return fid
+
+        recv_type = raw.get("recv_type")
+        if recv_type and len(parts) == 2:
+            # typed receiver: resolve the class through imports first
+            origin = self._import_origin(module, recv_type)
+            if origin:
+                op = origin.split(".")
+                fid = self._class_method(op[-1], meth,
+                                         module=".".join(op[:-1]))
+                if fid:
+                    return fid
+            fid = self._lookup(module, f"{recv_type}.{meth}")
+            if fid:
+                return fid
+            fid = self._class_method(recv_type, meth)
+            if fid:
+                return fid
+
+        # unique-by-name fallback for methods: only when exactly one
+        # def in the whole program has this name (any class or none)
+        fids = self.by_name.get(meth, [])
+        return fids[0] if len(fids) == 1 else None
+
+    def info(self, fid: str) -> Optional[FunctionInfo]:
+        return self.functions.get(fid)

@@ -10,10 +10,18 @@ bench_configs.py, exp_perf.py, harness/).
 
 ``--changed`` scopes the run to files touched vs a git ref (default
 ``HEAD``: committed-but-different plus staged, unstaged and untracked)
-— the pre-commit fast path; CI keeps the full run.  ``--format sarif``
-emits SARIF 2.1.0 for GitHub code-scanning annotations.
-``--list-noqa`` inventories every suppression with its reason (rule
-PIF503 makes the reason mandatory).
+— the pre-commit fast path; CI keeps the full run.  The scope is
+expanded through the summary cache's call-graph edges: editing a
+callee re-checks its (transitive) callers, so an interprocedural
+finding that depends on the edited file re-fires; unchanged files
+still join the run as *context* (parsed, summarized from cache) so
+call resolution stays whole-program.  ``--format sarif`` emits SARIF
+2.1.0 for GitHub code-scanning annotations, including ``codeFlows``
+for the interprocedural rules' source→sink paths.  ``--list-noqa``
+inventories every suppression with its reason (rule PIF503 makes the
+reason mandatory).  ``--stats`` prints per-phase and per-rule wall
+times plus summary-cache hits/misses (embedded under ``"stats"`` with
+``--format json``).
 
 Exit codes: 0 clean (or matches baseline), 1 findings (or new findings
 vs baseline), 2 usage errors.
@@ -25,7 +33,7 @@ import argparse
 import os
 import sys
 
-from . import engine
+from . import engine, summaries
 
 DEFAULT_PATHS = ("cs87project_msolano2_tpu", "bench.py",
                  "bench_configs.py", "exp_perf.py", "harness")
@@ -43,9 +51,9 @@ def _default_paths() -> list:
             if os.path.exists(p)]
 
 
-def _emit(findings: list, paths: list, fmt: str) -> None:
+def _emit(findings: list, paths: list, fmt: str, stats=None) -> None:
     if fmt == "json":
-        print(engine.to_json(findings, paths))
+        print(engine.to_json(findings, paths, stats=stats))
     elif fmt == "sarif":
         print(engine.to_sarif(findings))
     else:
@@ -87,6 +95,10 @@ def main(argv=None) -> int:
     ap.add_argument("--list-noqa", action="store_true",
                     help="inventory every `# pifft: noqa` suppression "
                          "with its reason, then exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-phase and per-rule wall times plus "
+                         "summary-cache hits/misses (with --format "
+                         "json: embedded under \"stats\")")
     args = ap.parse_args(argv)
     fmt = "json" if args.json and args.fmt == "human" else args.fmt
 
@@ -106,6 +118,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    cache = None
+    context_paths: list = []
     if args.changed is not None:
         anchor = raw_paths[0] if raw_paths else os.getcwd()
         if not os.path.isdir(anchor):
@@ -116,11 +130,27 @@ def main(argv=None) -> int:
             print(f"error: --changed {args.changed}: {e}",
                   file=sys.stderr)
             return 2
-        raw_paths = [p for p in engine.iter_python_files(raw_paths)
-                     if os.path.abspath(p) in touched]
-        if not raw_paths:
+        all_files = list(engine.iter_python_files(raw_paths))
+        display = {p: engine._display_path(p) for p in all_files}
+        changed_set = {display[p] for p in all_files
+                       if os.path.abspath(p) in touched}
+        if not changed_set:
             print(f"pifft check: no files changed vs {args.changed}")
             return 0
+        # expand through the summary cache's call edges: a finding in a
+        # caller depends on its callee's summary, so editing only the
+        # callee must re-fire the caller's findings
+        cache = summaries.SummaryCache.default()
+        expanded = cache.invalidation_closure(changed_set)
+        raw_paths = [p for p in all_files if display[p] in expanded]
+        context_paths = [p for p in all_files
+                         if display[p] not in expanded]
+        extra = len(expanded & {display[p] for p in all_files}) \
+            - len(changed_set)
+        if extra > 0 and not args.list_noqa:
+            print(f"pifft check: {len(changed_set)} changed file(s) "
+                  f"+ {extra} dependent caller file(s)",
+                  file=sys.stderr)
 
     if args.list_noqa:
         # after the --changed filter, so the inventory scopes the same
@@ -141,11 +171,20 @@ def main(argv=None) -> int:
         return 0
 
     paths = [engine._display_path(p) for p in raw_paths]
+    stats = engine.RunStats() if args.stats else None
     try:
-        findings = engine.check_paths(raw_paths, rules=args.rule)
+        findings = engine.check_paths(raw_paths, rules=args.rule,
+                                      stats=stats,
+                                      context_paths=context_paths,
+                                      cache=cache)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
+    if stats is not None and fmt != "json":
+        # human: the table rides stdout with the findings; sarif keeps
+        # stdout machine-clean and the table goes to stderr
+        print(stats.format_table(),
+              file=sys.stderr if fmt == "sarif" else sys.stdout)
 
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as fh:
@@ -166,7 +205,7 @@ def main(argv=None) -> int:
             return 2
         new, fixed = engine.compare_baseline(findings, baseline)
         if fmt != "human":
-            _emit(new, paths, fmt)
+            _emit(new, paths, fmt, stats=stats)
         else:
             if new:
                 print(engine.format_human(new))
@@ -181,5 +220,5 @@ def main(argv=None) -> int:
                       f"--write-baseline")
         return 1 if new else 0
 
-    _emit(findings, paths, fmt)
+    _emit(findings, paths, fmt, stats=stats)
     return 1 if findings else 0

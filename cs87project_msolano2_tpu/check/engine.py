@@ -48,21 +48,32 @@ _LINE_REF_RE = re.compile(r"\bline \d+\b")
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation at one source location."""
+    """One rule violation at one source location.
+
+    Interprocedural rules attach ``flow``: the source→sink call path
+    as ``(path, line, note)`` steps, rendered by ``--format sarif`` as
+    ``codeFlows`` and by the human format as indented ``via`` lines."""
 
     rule: str
     path: str
     line: int
     col: int
     message: str
+    flow: tuple = ()
 
     def to_record(self) -> dict:
-        return dataclasses.asdict(self)
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message}
+        if self.flow:
+            d["flow"] = [list(s) for s in self.flow]
+        return d
 
     @classmethod
     def from_record(cls, d: dict) -> "Finding":
         return cls(rule=d["rule"], path=d["path"], line=int(d["line"]),
-                   col=int(d.get("col", 0)), message=d["message"])
+                   col=int(d.get("col", 0)), message=d["message"],
+                   flow=tuple((s[0], int(s[1]), s[2])
+                              for s in d.get("flow", ())))
 
     def key(self) -> tuple:
         """Identity for baseline matching.  Line/column drift is
@@ -229,6 +240,105 @@ class Rule:
                        col=getattr(node, "col_offset", 0), message=message)
 
 
+class ProgramRule(Rule):
+    """Base class for whole-program (interprocedural) rules.
+
+    A ProgramRule sees the :class:`~.callgraph.Program` built over
+    every file in the run — call graph, per-function summaries —
+    instead of one FileContext at a time.  The engine applies noqa
+    suppression and ``exempt`` per finding (a program finding lands in
+    whichever file its anchor step is in)."""
+
+    program_level = True
+
+    def check_program(self, program,
+                      config: dict) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator[Finding]:
+        # the per-file entry point never runs for program rules; the
+        # engine routes them through check_program
+        return iter(())
+
+
+class RunStats:
+    """Wall-time accounting for one check run: per-phase (parse /
+    file_rules / callgraph / summaries / taint) and per-rule seconds,
+    plus the summary-cache hit/miss counts — the ``--stats`` surface
+    that makes the CI 60s guard diagnosable."""
+
+    def __init__(self):
+        self.phases: dict = {}
+        self.rules: dict = {}
+        self.cache: dict = {"hits": 0, "misses": 0, "path": None}
+        self.files = 0
+        self.findings = 0
+
+    @staticmethod
+    def _clock() -> float:
+        # the sanctioned non-measurement clock (PIF102/PIF106)
+        from ..obs.spans import clock
+
+        return clock()
+
+    class _Phase:
+        def __init__(self, stats, name):
+            self.stats, self.name = stats, name
+
+        def __enter__(self):
+            self.t0 = RunStats._clock()
+            return self
+
+        def __exit__(self, *exc):
+            dt = RunStats._clock() - self.t0
+            self.stats.phases[self.name] = \
+                self.stats.phases.get(self.name, 0.0) + dt
+            return False
+
+    def phase(self, name: str) -> "RunStats._Phase":
+        return RunStats._Phase(self, name)
+
+    def add_rule(self, rid: str, dt: float, found: int) -> None:
+        t, n = self.rules.get(rid, (0.0, 0))
+        self.rules[rid] = (t + dt, n + found)
+
+    def note_cache(self, cache) -> None:
+        if cache is not None:
+            self.cache = {"hits": cache.hits, "misses": cache.misses,
+                          "path": cache.path}
+
+    def to_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "phases": {k: round(v, 6) for k, v in self.phases.items()},
+            "rules": {k: {"seconds": round(t, 6), "findings": n}
+                      for k, (t, n) in self.rules.items()},
+            "cache": self.cache,
+        }
+
+    def format_table(self) -> str:
+        lines = [f"-- pifft check --stats ({self.files} file(s)) --",
+                 "phase                seconds"]
+        for name in ("parse", "file_rules", "callgraph", "summaries",
+                     "taint"):
+            if name in self.phases:
+                lines.append(f"  {name:<18} {self.phases[name]:8.3f}")
+        for name, v in sorted(self.phases.items()):
+            if name not in ("parse", "file_rules", "callgraph",
+                            "summaries", "taint"):
+                lines.append(f"  {name:<18} {v:8.3f}")
+        lines.append("rule       seconds  findings")
+        for rid in sorted(self.rules):
+            t, n = self.rules[rid]
+            lines.append(f"  {rid:<8} {t:8.3f}  {n:8d}")
+        lines.append(
+            f"summary cache: {self.cache['hits']} hit(s), "
+            f"{self.cache['misses']} miss(es)"
+            + (f" ({self.cache['path']})" if self.cache["path"]
+               else " (disabled)"))
+        return "\n".join(lines)
+
+
 _REGISTRY: dict[str, Rule] = {}
 
 
@@ -244,10 +354,11 @@ def register(cls: type) -> type:
 
 
 def all_rules() -> dict[str, Rule]:
-    """id -> rule instance, importing the bundled rule sets (syntactic
-    AND flow-sensitive) on first use."""
+    """id -> rule instance, importing the bundled rule sets (syntactic,
+    flow-sensitive AND interprocedural) on first use."""
     from . import rules as _  # noqa: F401  (registration side effect)
     from . import rules_flow as _rf  # noqa: F401  (same)
+    from . import taint as _tt  # noqa: F401  (same)
 
     return dict(_REGISTRY)
 
@@ -278,18 +389,7 @@ def _exempt(path: str, patterns: Iterable[str]) -> bool:
     return any(fnmatch.fnmatch(norm, pat) for pat in patterns)
 
 
-def check_source(path: str, source: str, rules: Optional[Iterable[str]] = None,
-                 config: Optional[dict] = None) -> list:
-    """Run rules over one in-memory source (the unit-test entry point).
-    Returns findings sorted by location; a syntax error yields the
-    single pseudo-finding PIF000 rather than raising."""
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding(rule="PIF000", path=path, line=e.lineno or 1,
-                        col=e.offset or 0,
-                        message=f"file does not parse: {e.msg}")]
-    ctx = FileContext(path, source, tree)
+def _select_rules(rules: Optional[Iterable[str]]) -> dict:
     selected = all_rules()
     if rules is not None:
         want = {r.upper() for r in rules}
@@ -297,16 +397,120 @@ def check_source(path: str, source: str, rules: Optional[Iterable[str]] = None,
         if unknown:
             raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
         selected = {k: v for k, v in selected.items() if k in want}
+    return selected
+
+
+def check_contexts(ctxs: list, report_paths: set,
+                   rules: Optional[Iterable[str]] = None,
+                   config: Optional[dict] = None,
+                   stats: Optional[RunStats] = None,
+                   cache=None) -> list:
+    """Run rules over parsed FileContexts.  Every context joins the
+    whole-program phase (call resolution needs the callee's file even
+    when only the caller changed); only findings in `report_paths` are
+    returned.  `cache` is a :class:`~.summaries.SummaryCache` (created
+    on demand when program rules are selected)."""
+    selected = _select_rules(rules)
     overrides = config or {}
     out = []
-    for rid, rule in sorted(selected.items()):
+    file_rules = [(rid, r) for rid, r in sorted(selected.items())
+                  if not getattr(r, "program_level", False)]
+    prog_rules = [(rid, r) for rid, r in sorted(selected.items())
+                  if getattr(r, "program_level", False)]
+
+    def _rcfg(rule, rid):
         rcfg = dict(rule.default_config)
         rcfg.update(overrides.get(rid, {}))
-        if _exempt(path, rcfg.get("exempt", ())):
+        return rcfg
+
+    with (stats.phase("file_rules") if stats else _null()):
+        for ctx in ctxs:
+            if ctx.path not in report_paths:
+                continue
+            for rid, rule in file_rules:
+                rcfg = _rcfg(rule, rid)
+                if _exempt(ctx.path, rcfg.get("exempt", ())):
+                    continue
+                t0 = RunStats._clock() if stats else 0.0
+                found = 0
+                for f in rule.check(ctx, rcfg):
+                    if not ctx.suppressed(f, rule=rule):
+                        out.append(f)
+                        found += 1
+                if stats:
+                    stats.add_rule(rid, RunStats._clock() - t0, found)
+
+    if prog_rules:
+        from . import callgraph, summaries
+
+        with (stats.phase("callgraph") if stats else _null()):
+            program = callgraph.Program(ctxs)
+        if cache is None:
+            cache = summaries.SummaryCache.default()
+        program.cache["summary_cache"] = cache
+        with (stats.phase("summaries") if stats else _null()):
+            summaries.ensure_summaries(program, cache)
+        with (stats.phase("taint") if stats else _null()):
+            for rid, rule in prog_rules:
+                rcfg = _rcfg(rule, rid)
+                t0 = RunStats._clock() if stats else 0.0
+                found = 0
+                for f in rule.check_program(program, rcfg):
+                    if f.path not in report_paths:
+                        continue
+                    if _exempt(f.path, rcfg.get("exempt", ())):
+                        continue
+                    fctx = program.contexts.get(f.path)
+                    if fctx is not None and fctx.suppressed(f, rule=rule):
+                        continue
+                    out.append(f)
+                    found += 1
+                if stats:
+                    stats.add_rule(rid, RunStats._clock() - t0, found)
+        if stats:
+            stats.note_cache(cache)
+
+    if stats:
+        stats.findings = len(out)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+class _null:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def check_source(path: str, source: str, rules: Optional[Iterable[str]] = None,
+                 config: Optional[dict] = None) -> list:
+    """Run rules over one in-memory source (the unit-test entry point).
+    Program rules see a one-file program — same-file interprocedural
+    findings still fire.  Returns findings sorted by location; a syntax
+    error yields the single pseudo-finding PIF000 rather than raising."""
+    return check_sources({path: source}, rules=rules, config=config)
+
+
+def check_sources(sources: dict, rules: Optional[Iterable[str]] = None,
+                  config: Optional[dict] = None,
+                  report: Optional[Iterable[str]] = None) -> list:
+    """Run rules over several in-memory sources as ONE program — the
+    cross-file unit-test entry point.  `report` limits which paths'
+    findings are returned (default: all of them)."""
+    ctxs = []
+    out = []
+    for path, source in sources.items():
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            out.append(Finding(rule="PIF000", path=path,
+                               line=e.lineno or 1, col=e.offset or 0,
+                               message=f"file does not parse: {e.msg}"))
             continue
-        for f in rule.check(ctx, rcfg):
-            if not ctx.suppressed(f, rule=rule):
-                out.append(f)
+        ctxs.append(FileContext(path, source, tree))
+    report_paths = set(report) if report is not None else set(sources)
+    out.extend(check_contexts(ctxs, report_paths, rules, config))
     return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
@@ -329,43 +533,81 @@ def _display_path(path: str) -> str:
 
 
 def check_paths(paths: Iterable[str], rules: Optional[Iterable[str]] = None,
-                config: Optional[dict] = None) -> list:
-    """Run rules over files/directories; the CLI and CI entry point."""
+                config: Optional[dict] = None,
+                stats: Optional[RunStats] = None,
+                context_paths: Optional[Iterable[str]] = None,
+                cache=None) -> list:
+    """Run rules over files/directories; the CLI and CI entry point.
+
+    `context_paths` are parsed into the whole-program phase (so call
+    edges into them resolve) but produce no findings of their own —
+    how ``--changed`` keeps interprocedural results exact while only
+    re-reporting the touched-plus-dependent set."""
     findings = []
-    for path in iter_python_files(paths):
-        shown = _display_path(path)
-        try:
-            with open(path, encoding="utf-8") as fh:
-                source = fh.read()
-        except OSError as e:
-            findings.append(Finding(
-                rule="PIF000", path=shown, line=1, col=0,
-                message=f"unreadable: {e}"))
-            continue
-        findings.extend(check_source(shown, source, rules, config))
+    ctxs = []
+    report: set = set()
+    seen: set = set()
+    with (stats.phase("parse") if stats else _null()):
+        for group, reported in ((paths, True), (context_paths or (),
+                                                False)):
+            for path in iter_python_files(group):
+                shown = _display_path(path)
+                if shown in seen:
+                    continue
+                seen.add(shown)
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        source = fh.read()
+                except OSError as e:
+                    if reported:
+                        findings.append(Finding(
+                            rule="PIF000", path=shown, line=1, col=0,
+                            message=f"unreadable: {e}"))
+                    continue
+                try:
+                    tree = ast.parse(source, filename=shown)
+                except SyntaxError as e:
+                    if reported:
+                        findings.append(Finding(
+                            rule="PIF000", path=shown, line=e.lineno or 1,
+                            col=e.offset or 0,
+                            message=f"file does not parse: {e.msg}"))
+                    continue
+                ctxs.append(FileContext(shown, source, tree))
+                if reported:
+                    report.add(shown)
+    if stats:
+        stats.files = len(report)
+    findings.extend(check_contexts(ctxs, report, rules, config,
+                                   stats=stats, cache=cache))
     return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 # ---------------------------------------------------------------- output
 
 
-def to_json(findings: list, paths: Iterable[str] = ()) -> str:
-    return json.dumps(
-        {
-            "schema": 1,
-            "paths": list(paths),
-            "count": len(findings),
-            "findings": [f.to_record() for f in findings],
-        },
-        indent=1, sort_keys=True,
-    )
+def to_json(findings: list, paths: Iterable[str] = (),
+            stats: Optional[RunStats] = None) -> str:
+    doc = {
+        "schema": 1,
+        "paths": list(paths),
+        "count": len(findings),
+        "findings": [f.to_record() for f in findings],
+    }
+    if stats is not None:
+        doc["stats"] = stats.to_dict()
+    return json.dumps(doc, indent=1, sort_keys=True)
 
 
 def format_human(findings: list) -> str:
     if not findings:
         return "pifft check: clean"
-    lines = [f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}"
-             for f in findings]
+    lines = []
+    for f in findings:
+        lines.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} "
+                     f"{f.message}")
+        for sp, sl, note in f.flow:
+            lines.append(f"    via {sp}:{sl}: {note}")
     lines.append(f"pifft check: {len(findings)} finding(s)")
     return "\n".join(lines)
 
@@ -400,7 +642,7 @@ def to_sarif(findings: list) -> str:
         rules_meta.append(meta)
     results = []
     for f in findings:
-        results.append({
+        result = {
             "ruleId": f.rule,
             "ruleIndex": index[f.rule],
             "level": "error",
@@ -412,7 +654,24 @@ def to_sarif(findings: list) -> str:
                                "startColumn": f.col + 1},
                 },
             }],
-        })
+        }
+        if f.flow:
+            # the interprocedural source→sink path, in the shape GitHub
+            # code scanning renders as a step-through trace
+            result["codeFlows"] = [{
+                "threadFlows": [{
+                    "locations": [{
+                        "location": {
+                            "physicalLocation": {
+                                "artifactLocation": {"uri": sp},
+                                "region": {"startLine": max(sl, 1)},
+                            },
+                            "message": {"text": note},
+                        },
+                    } for sp, sl, note in f.flow],
+                }],
+            }]
+        results.append(result)
     doc = {
         "$schema": _SARIF_SCHEMA,
         "version": "2.1.0",

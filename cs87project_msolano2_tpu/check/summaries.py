@@ -1,0 +1,789 @@
+"""Per-function dataflow summaries for the interprocedural rules.
+
+One summary condenses everything :mod:`.taint` needs to know about a
+function WITHOUT re-walking its body: which parameters and untrusted
+sources reach which sinks (allocation sizes, ``frombuffer``
+count/offset, slot/ring indexing, plan-key shape params), which
+origins flow to ``return``, which plain locals the function
+bounds-checks (so a decoder's guards become program-wide facts), every
+call site with the taint origins of its arguments and the locks held
+across it, and the blocking / untagged-demotion effects the PIF120/121
+rules chase through the graph.
+
+The intra-function analysis is a forward may-taint dataflow over the
+existing :func:`~.flow.build_cfg` graph.  Origins are strings —
+``param:2``, ``wire:n@47``, ``json:width@12``, ``env:PIFFT_X@9``,
+``unpack@31``, ``ret:4`` (the value returned by this function's call
+site #4) — so a summary serializes to plain JSON.  The sanitizer model
+is deliberately *generous*: comparing a tainted value against anything
+untainted (a literal, a ``MAX_*`` cap, a ``len()``) kills its taint on
+both branches, as does wrapping it in a clamp/validator call or
+``min()`` with an untainted bound.  A may-analysis with generous
+sanitizing stays quiet on defensive code and still catches the
+straight-through hop the per-function layer is blind to.
+
+Summaries are cached on disk keyed by file content hash
+(``PIFFT_CHECK_CACHE`` names the store; ``off`` disables it; default
+``~/.cache/pifft/check_summaries.json``) so ``--changed`` and
+pre-commit runs skip the dataflow for untouched files, and the cached
+call-site names drive the ``--changed`` invalidation closure: editing
+a callee re-checks its callers.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import hashlib
+import json
+import os
+import tempfile
+from typing import Iterable, Optional
+
+from . import flow
+from .engine import dotted_name
+
+# bump when the summary schema or the vocabulary below changes: a
+# cache written by an older checker must miss, not mislead
+SCHEMA = 1
+
+# ------------------------------------------------------------ vocabulary
+#
+# The taint vocabulary is fixed at summary-computation time (rules
+# select scope and reporting, not sources) so one cached summary serves
+# every rule and every run.  docs/CHECKS.md "Writing a taint rule"
+# documents each knob.
+
+#: header/frame fields a hostile client controls (serve/wire.py HEADER)
+WIRE_FIELDS = ("n", "width", "slot", "payload_len", "extras_len", "rid")
+#: receiver names whose attribute reads of WIRE_FIELDS are wire sources
+FRAME_GLOBS = ("*frame*", "*hello*", "*ack*", "*msg*", "*req*",
+               "*header*", "*hdr*")
+#: JSON request keys that size things when read off a message mapping
+JSON_KEYS = ("n", "width", "count", "size", "length", "slot", "shape",
+             "batch", "depth", "slots", "slot_bytes")
+#: receiver names treated as decoded request mappings for JSON_KEYS
+MSG_GLOBS = ("*msg*", "*req*", "*body*", "*payload*", "*conf*", "*opts*")
+
+#: canonical call targets whose result is attacker-sized storage / work
+ALLOC_CALLS = ("numpy.empty", "numpy.zeros", "numpy.ones", "numpy.full",
+               "numpy.arange", "bytearray", "range")
+#: plan-construction entry points (PIF119's sink vocabulary)
+PLAN_CALLS = ("plan_for", "plankey", "plan_key", "make_key")
+#: receivers whose tainted subscripts count as slot/ring indexing
+INDEX_RECV_GLOBS = ("*slot*", "*ring*", "*buf*", "*plane*", "*shm*",
+                    "*pool*")
+
+#: calls that bound their argument (result is clean)
+SANITIZER_CALL_GLOBS = ("*clamp*", "*bounded*", "*checked*", "*validate*",
+                        "_lookup", "_index")
+#: calls that pass taint through unchanged (casts)
+PASSTHROUGH_CALLS = ("int", "float", "abs", "round", "bool")
+#: calls whose result is always clean (reading one is not a hop)
+SAFE_CALLS = ("len", "isinstance", "hash", "id", "ord", "chr", "str",
+              "repr", "format", "sorted", "sum", "tuple", "set",
+              "frozenset", "dict", "list", "enumerate", "zip", "print")
+
+#: blocking callees for PIF120 (sync calls that park the thread)
+BLOCKING_CALLS = ("time.sleep", "subprocess.run", "subprocess.call",
+                  "subprocess.check_output", "subprocess.check_call",
+                  "socket.create_connection")
+#: blocking methods, gated on a receiver glob so `", ".join(...)` and
+#: friends stay quiet
+BLOCKING_METHODS = {
+    "result": ("*fut*", "*future*", "*task*"),
+    "join": ("*thread*", "*proc*", "*worker*"),
+    "recv": ("*sock*", "*conn*"),
+    "accept": ("*sock*", "*srv*", "*server*", "*listener*"),
+    "wait": ("*event*", "*proc*", "*fut*", "*done*"),
+}
+
+#: PIF115's vocabulary, mirrored so PIF121 agrees with the
+#: per-function rule about what demotes and what tags
+TRAIL_GLOBS = ("*degrade*", "*demotion*")
+RUNG_CALLS = ("promote_precision",)
+TAG_GLOBS = ("*degraded*",)
+
+_EMPTY = frozenset()
+
+
+def _matches(name: str, globs: Iterable[str]) -> bool:
+    low = name.lower()
+    return any(fnmatch.fnmatch(low, g.lower()) for g in globs)
+
+
+def _last(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+# ----------------------------------------------------- receiver typing
+
+
+def _receiver_types(fn) -> dict:
+    """name -> class-name guesses from constructor calls, classmethod
+    constructors (``ShmRing.attach``) and annotations.  Flow-insensitive
+    — good enough to aim method resolution."""
+    out: dict = {}
+
+    def note_ann(name, ann):
+        d = dotted_name(ann) if ann is not None else None
+        if d and _last(d)[:1].isupper():
+            out[name] = _last(d)
+
+    for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+        note_ann(a.arg, a.annotation)
+    for node in flow.shallow_walk_body(fn):
+        if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            note_ann(node.target.id, node.annotation)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            d = dotted_name(node.value.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            if parts[-1][:1].isupper():
+                out[node.targets[0].id] = parts[-1]
+            elif len(parts) >= 2 and parts[-2][:1].isupper() and any(
+                    parts[-1].startswith(p) for p in
+                    ("create", "attach", "connect", "open", "from_")):
+                out[node.targets[0].id] = parts[-2]
+    return out
+
+
+# ------------------------------------------------------ the taint walk
+
+
+class _FnAnalysis:
+    """One function's summary computation."""
+
+    def __init__(self, ctx, fn, qualname: str, cls: Optional[str]):
+        self.ctx = ctx
+        self.fn = fn
+        self.qualname = qualname
+        self.cls = cls
+        self.cfg = flow.build_cfg(fn)
+        self.locksets = flow.flow_locksets(self.cfg)
+        self.recv_types = _receiver_types(fn)
+        all_args = (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+        self.params = [a.arg for a in all_args]
+        self.sanitized: set = set()
+        self.calls: list = []       # call records, in discovery order
+        self._call_ids: dict = {}   # id(ast.Call) -> idx
+        self.sinks: list = []
+        self.returns: set = set()
+        self.blocking: Optional[dict] = None
+        self.demote: Optional[dict] = None
+        self.tag_nodes: set = set()
+
+    # -- origin helpers
+
+    def _entry_state(self) -> dict:
+        state = {}
+        for i, name in enumerate(self.params):
+            if name in ("self", "cls"):
+                continue  # object state is not caller-controlled data
+            state[name] = frozenset([f"param:{i}"])
+        return state
+
+    def _call_idx(self, call: ast.Call) -> int:
+        idx = self._call_ids.get(id(call))
+        if idx is None:
+            idx = len(self.calls)
+            self._call_ids[id(call)] = idx
+            self.calls.append(None)  # reserved; filled in record pass
+        return idx
+
+    def taint_of(self, expr, state: dict) -> frozenset:
+        """May-taint origins of an expression under `state`."""
+        if expr is None or isinstance(expr, ast.Constant):
+            return _EMPTY
+        if isinstance(expr, ast.Name):
+            return state.get(expr.id, _EMPTY)
+        if isinstance(expr, ast.Attribute):
+            chain = dotted_name(expr)
+            if chain is not None and chain in state:
+                return state[chain]
+            base = self.taint_of(expr.value, state)
+            if chain is not None:
+                root = chain.split(".", 1)[0]
+                if expr.attr in WIRE_FIELDS and _matches(root, FRAME_GLOBS):
+                    return base | frozenset(
+                        [f"wire:{expr.attr}@{expr.lineno}"])
+            return base
+        if isinstance(expr, ast.Subscript):
+            # reading msg["n"] off a request mapping is a JSON source
+            key = expr.slice.value if isinstance(expr.slice, ast.Constant) \
+                else None
+            recv = dotted_name(expr.value)
+            base = self.taint_of(expr.value, state) \
+                | self.taint_of(expr.slice, state)
+            if isinstance(key, str) and key in JSON_KEYS and recv and (
+                    _matches(_last(recv), MSG_GLOBS)
+                    or self.taint_of(expr.value, state)):
+                return base | frozenset([f"json:{key}@{expr.lineno}"])
+            return base
+        if isinstance(expr, ast.Call):
+            return self._call_taint(expr, state)
+        if isinstance(expr, ast.BoolOp):
+            return frozenset().union(
+                *(self.taint_of(v, state) for v in expr.values))
+        if isinstance(expr, ast.BinOp):
+            return self.taint_of(expr.left, state) \
+                | self.taint_of(expr.right, state)
+        if isinstance(expr, ast.UnaryOp):
+            return self.taint_of(expr.operand, state)
+        if isinstance(expr, ast.IfExp):
+            return self.taint_of(expr.body, state) \
+                | self.taint_of(expr.orelse, state)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return frozenset().union(
+                *(self.taint_of(e, state) for e in expr.elts)) \
+                if expr.elts else _EMPTY
+        if isinstance(expr, ast.Starred):
+            return self.taint_of(expr.value, state)
+        if isinstance(expr, ast.Slice):
+            return frozenset().union(*(
+                self.taint_of(e, state)
+                for e in (expr.lower, expr.upper, expr.step) if e))
+        if isinstance(expr, ast.Await):
+            return self.taint_of(expr.value, state)
+        if isinstance(expr, ast.NamedExpr):
+            return self.taint_of(expr.value, state)
+        return _EMPTY
+
+    def _call_taint(self, call: ast.Call, state: dict) -> frozenset:
+        dotted = dotted_name(call.func)
+        canon = self.ctx.imports.resolve(dotted) if dotted else None
+        last = _last(dotted)
+        arg_taint = frozenset().union(
+            *(self.taint_of(a, state) for a in call.args),
+            *(self.taint_of(kw.value, state) for kw in call.keywords)) \
+            if (call.args or call.keywords) else _EMPTY
+
+        # sources first: the result IS untrusted
+        if canon == "os.getenv" or (canon or "").endswith("environ.get"):
+            key = call.args[0].value if call.args and isinstance(
+                call.args[0], ast.Constant) else "?"
+            return frozenset([f"env:{key}@{call.lineno}"])
+        if canon in ("struct.unpack", "struct.unpack_from") or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("unpack", "unpack_from")
+                and _matches(_last(dotted_name(call.func.value) or ""),
+                             ("*header*", "*struct*", "*fmt*"))):
+            return frozenset([f"unpack@{call.lineno}"])
+        if canon == "json.loads":
+            return frozenset([f"json:doc@{call.lineno}"])
+        # msg.get("n") on a request mapping
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "get" \
+                and call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str) \
+                and call.args[0].value in JSON_KEYS:
+            recv = dotted_name(call.func.value)
+            if (recv and _matches(_last(recv), MSG_GLOBS)) or \
+                    self.taint_of(call.func.value, state):
+                return frozenset(
+                    [f"json:{call.args[0].value}@{call.lineno}"])
+
+        if last == "min":
+            # a clamp iff some bound is untainted
+            taints = [self.taint_of(a, state) for a in call.args]
+            if any(not t for t in taints):
+                return _EMPTY
+            return frozenset().union(*taints) if taints else _EMPTY
+        if last in PASSTHROUGH_CALLS or last == "max":
+            return arg_taint
+        if last in SAFE_CALLS:
+            return _EMPTY
+        if last and _matches(last, SANITIZER_CALL_GLOBS):
+            return _EMPTY
+        if dotted:
+            # a call we may resolve in the program: its value carries
+            # whatever the callee returns
+            return frozenset([f"ret:{self._call_idx(call)}"])
+        return arg_taint
+
+    # -- transfer
+
+    def _kill(self, state: dict, expr) -> None:
+        """Remove taint from every name/chain read inside `expr`."""
+        for sub in flow.shallow_walk(expr):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                key = dotted_name(sub)
+                if not key:
+                    continue
+                if state.get(key):
+                    state[key] = _EMPTY
+                    if "." not in key:
+                        self.sanitized.add(key)
+                elif key not in state and isinstance(sub, ast.Attribute) \
+                        and sub.attr in WIRE_FIELDS and _matches(
+                            key.split(".", 1)[0], FRAME_GLOBS):
+                    # a guarded wire field stays clean on later reads
+                    state[key] = _EMPTY
+
+    def _apply_guards(self, node, state: dict) -> None:
+        for root in node.scan:
+            if root is None:
+                continue
+            for sub in flow.shallow_walk(root):
+                if isinstance(sub, ast.Compare):
+                    operands = [sub.left] + list(sub.comparators)
+                    taints = [self.taint_of(o, state) for o in operands]
+                    if any(t for t in taints) and \
+                            any(not t for t in taints):
+                        for o, t in zip(operands, taints):
+                            if t:
+                                self._kill(state, o)
+                elif isinstance(sub, ast.Call):
+                    last = _last(dotted_name(sub.func))
+                    if last and _matches(last, SANITIZER_CALL_GLOBS):
+                        for a in list(sub.args) + \
+                                [kw.value for kw in sub.keywords]:
+                            if self.taint_of(a, state):
+                                self._kill(state, a)
+
+    def _assign(self, state: dict, target, origins: frozenset) -> None:
+        if isinstance(target, ast.Name):
+            # rebinding a root forgets its field facts
+            prefix = target.id + "."
+            for key in [k for k in state if k.startswith(prefix)]:
+                del state[key]
+            state[target.id] = origins
+        elif isinstance(target, ast.Attribute):
+            chain = dotted_name(target)
+            if chain:
+                state[chain] = origins
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(state, elt, origins)
+        elif isinstance(target, ast.Starred):
+            self._assign(state, target.value, origins)
+
+    def _transfer(self, node, state: dict) -> dict:
+        out = dict(state)
+        if isinstance(node.stmt, (ast.For, ast.AsyncFor)):
+            # loop-header scan roots are (target, iter), not the For
+            self._assign(out, node.stmt.target,
+                         self.taint_of(node.stmt.iter, out))
+        for root in node.scan:
+            if root is None:
+                continue
+            for sub in flow.shallow_walk(root):
+                if isinstance(sub, ast.Assign):
+                    origins = self.taint_of(sub.value, out)
+                    for t in sub.targets:
+                        self._assign(out, t, origins)
+                elif isinstance(sub, ast.AnnAssign) and sub.value:
+                    self._assign(out, sub.target,
+                                 self.taint_of(sub.value, out))
+                elif isinstance(sub, ast.AugAssign):
+                    origins = self.taint_of(sub.value, out) \
+                        | self.taint_of(sub.target, out)
+                    self._assign(out, sub.target, origins)
+                elif isinstance(sub, ast.NamedExpr):
+                    self._assign(out, sub.target,
+                                 self.taint_of(sub.value, out))
+        self._apply_guards(node, out)
+        return out
+
+    @staticmethod
+    def _join(a: Optional[dict], b: dict) -> dict:
+        """May-union for plain names; a chain key survives the merge
+        only if every inbound path has it (absent = re-taints on read,
+        so dropping it is the conservative direction)."""
+        if a is None:
+            return dict(b)
+        out = {}
+        keys = set(a) | set(b)
+        for k in keys:
+            if "." in k:
+                if k in a and k in b:
+                    out[k] = a[k] | b[k]
+            else:
+                out[k] = a.get(k, _EMPTY) | b.get(k, _EMPTY)
+        return out
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        instate: dict = {cfg.entry: self._entry_state()}
+        worklist = [cfg.entry]
+        iters = 0
+        limit = 40 * (len(cfg.nodes) + 1)
+        while worklist and iters < limit:
+            iters += 1
+            n = worklist.pop()
+            out = self._transfer(cfg.nodes[n], instate[n])
+            for s in cfg.succ[n]:
+                merged = self._join(instate.get(s), out)
+                if merged != instate.get(s):
+                    instate[s] = merged
+                    worklist.append(s)
+        self._record(instate)
+        return self._to_record()
+
+    # -- the record pass (fixpoint states are final here)
+
+    def _record(self, instate: dict) -> None:
+        cfg = self.cfg
+        for node in cfg.statement_nodes():
+            state = instate.get(node.idx)
+            if state is None:
+                continue  # unreachable
+            # evaluate in pre-assignment order: sinks and call args see
+            # the state on entry to the statement
+            for root in node.scan:
+                if root is None:
+                    continue
+                self._record_exprs(node, root, state)
+            if node.kind == "return" and node.stmt is not None and \
+                    getattr(node.stmt, "value", None) is not None:
+                self.returns |= self.taint_of(node.stmt.value, state)
+            for root in node.scan:
+                if root is None:
+                    continue
+                if UntaggedFacts.tags_in(root):
+                    self.tag_nodes.add(node.idx)
+        self._record_untagged(instate)
+
+    def _record_exprs(self, node, root, state: dict) -> None:
+        awaited: set = set()
+        for n in flow.shallow_walk(root):
+            if isinstance(n, ast.Await):
+                for inner in ast.walk(n.value):
+                    awaited.add(id(inner))
+        for sub in flow.shallow_walk(root):
+            if isinstance(sub, ast.Call):
+                self._record_call(node, root, sub, state,
+                                  awaited=id(sub) in awaited)
+            elif isinstance(sub, ast.Subscript):
+                recv = dotted_name(sub.value)
+                if recv and _matches(_last(recv), INDEX_RECV_GLOBS):
+                    for o in sorted(self.taint_of(sub.slice, state)):
+                        self._sink(o, "index", sub,
+                                   f"index into `{recv}`")
+
+    def _sink(self, origin: str, kind: str, node, what: str) -> None:
+        self.sinks.append({"origin": origin, "kind": kind,
+                           "line": node.lineno, "col": node.col_offset,
+                           "what": what})
+
+    def _record_call(self, node, root, call: ast.Call, state: dict,
+                     awaited: bool) -> None:
+        dotted = dotted_name(call.func)
+        if not dotted:
+            return
+        canon = self.ctx.imports.resolve(dotted)
+        last = _last(dotted)
+
+        # sink classification
+        if canon in ALLOC_CALLS or _last(canon) == "bytearray" \
+                or last in ("bytearray", "range"):
+            for o in sorted(frozenset().union(
+                    *(self.taint_of(a, state) for a in call.args),
+                    _EMPTY)):
+                self._sink(o, "alloc", call, f"allocation size in "
+                                             f"`{dotted}(...)`")
+        if _last(canon) == "frombuffer":
+            cand = list(call.args[2:4]) + [
+                kw.value for kw in call.keywords
+                if kw.arg in ("count", "offset")]
+            for o in sorted(frozenset().union(
+                    *(self.taint_of(a, state) for a in cand), _EMPTY)):
+                self._sink(o, "frombuffer", call,
+                           f"`{dotted}` count/offset")
+        if _last(canon).lower() in PLAN_CALLS:
+            for o in sorted(frozenset().union(
+                    *(self.taint_of(a, state) for a in call.args),
+                    *(self.taint_of(kw.value, state)
+                      for kw in call.keywords), _EMPTY)):
+                self._sink(o, "plan", call,
+                           f"plan construction `{dotted}(...)`")
+
+        # blocking evidence (sync only)
+        if not awaited and self.blocking is None:
+            if canon in BLOCKING_CALLS:
+                self.blocking = {"what": canon, "line": call.lineno}
+            elif isinstance(call.func, ast.Attribute):
+                globs = BLOCKING_METHODS.get(call.func.attr)
+                recv = dotted_name(call.func.value)
+                if globs and recv and _matches(_last(recv), globs):
+                    self.blocking = {"what": f"{recv}.{call.func.attr}()",
+                                     "line": call.lineno}
+
+        # call-site record (resolution happens at program level)
+        if last in SAFE_CALLS or last in PASSTHROUGH_CALLS or \
+                last in ("min", "max"):
+            return
+        target_dotted, args, kwargs = dotted, list(call.args), \
+            call.keywords
+        partial = canon == "functools.partial"
+        if partial:
+            if not call.args:
+                return
+            target_dotted = dotted_name(call.args[0])
+            if not target_dotted:
+                return
+            args = list(call.args[1:])
+        idx = self._call_idx(call)
+        binds = None
+        if isinstance(root, ast.Assign) and len(root.targets) == 1 and \
+                isinstance(root.targets[0], ast.Name) and \
+                root.value is call:
+            binds = root.targets[0].id
+        recv_type = None
+        parts = target_dotted.split(".")
+        if len(parts) >= 2:
+            recv_type = self.recv_types.get(parts[0])
+        self.calls[idx] = {
+            "idx": idx, "line": call.lineno, "col": call.col_offset,
+            "dotted": target_dotted, "recv_type": recv_type,
+            "encl_class": self.cls, "partial": partial,
+            "args": [sorted(self.taint_of(a, state)) for a in args],
+            "kwargs": {kw.arg: sorted(self.taint_of(kw.value, state))
+                       for kw in kwargs if kw.arg},
+            "locks": sorted(self.locksets.get(node.idx, frozenset())),
+            "awaited": awaited,
+            "node": node.idx,
+        }
+
+    def _record_untagged(self, instate: dict) -> None:
+        """PIF115 semantics, summarized: does this function demote
+        untagged, and can each call site's demotion escape untagged?"""
+        cfg = self.cfg
+        demotes: list = []
+        for node in cfg.statement_nodes():
+            for root in node.scan:
+                if root is None:
+                    continue
+                for sub, what in UntaggedFacts.demotes_in(self.ctx, root):
+                    demotes.append((node.idx, sub, what))
+        avoid = frozenset(self.tag_nodes)
+        from_entry = cfg.reachable(cfg.entry, avoid=avoid)
+        for idx, sub, what in demotes:
+            if idx in self.tag_nodes:
+                continue
+            if idx not in from_entry and idx != cfg.entry:
+                continue
+            if cfg.exit in cfg.reachable(idx, avoid=avoid):
+                self.demote = {"line": sub.lineno, "what": what}
+                break
+        # per-call-site: can control flow from the call to the exit
+        # without passing a tag assignment?
+        for rec in self.calls:
+            if rec is None:
+                continue
+            nidx = rec.pop("node")
+            ok_entry = nidx in from_entry or nidx == cfg.entry
+            onward = cfg.reachable(nidx, avoid=avoid)
+            rec["esc_untagged"] = bool(
+                ok_entry and nidx not in self.tag_nodes
+                and cfg.exit in onward)
+
+    def _to_record(self) -> dict:
+        first = self.params[0] if self.params else None
+        return {
+            "qual": self.qualname,
+            "name": _last(self.qualname),
+            "cls": self.cls,
+            "line": getattr(self.fn, "lineno", 1),
+            "params": self.params,
+            "offset": 1 if (self.cls and first in ("self", "cls")
+                            and not flow.decorator_matches(
+                                self.fn, ("staticmethod",))) else 0,
+            "sinks": self.sinks,
+            "returns": sorted(self.returns),
+            "sanitized": sorted(self.sanitized),
+            "blocking": self.blocking,
+            "demote": self.demote,
+            "calls": [c for c in self.calls if c is not None],
+        }
+
+
+class UntaggedFacts:
+    """PIF115's demote/tag detectors, shared verbatim so the
+    interprocedural rule never disagrees with the per-function one."""
+
+    @staticmethod
+    def demotes_in(ctx, root) -> list:
+        out = []
+        for sub in flow.shallow_walk(root):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in ("append", "extend") and sub.args:
+                container = dotted_name(sub.func.value)
+                if container and _matches(_last(container), TRAIL_GLOBS):
+                    out.append((sub, f"append to `{container}`"))
+                    continue
+            target = ctx.resolve_call(sub)
+            if target and _last(target) in RUNG_CALLS:
+                out.append((sub, f"`{_last(target)}(...)`"))
+        return out
+
+    @staticmethod
+    def tags_in(root) -> bool:
+        for sub in flow.shallow_walk(root):
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    name = None
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                    elif isinstance(t, ast.Attribute):
+                        name = t.attr
+                    elif isinstance(t, ast.Subscript) and isinstance(
+                            t.slice, ast.Constant) and isinstance(
+                            t.slice.value, str):
+                        name = t.slice.value
+                    if name and _matches(name, TAG_GLOBS):
+                        return True
+            elif isinstance(sub, ast.Call):
+                for kw in sub.keywords:
+                    if kw.arg and _matches(kw.arg, TAG_GLOBS):
+                        return True
+        return False
+
+
+# ----------------------------------------------------- per-file summaries
+
+
+def summarize_file(ctx, module: str) -> dict:
+    """All function summaries for one FileContext, JSON-ready."""
+    from . import callgraph
+
+    functions: dict = {}
+    infos, classes = callgraph._collect(ctx, module)
+    for info in infos:
+        try:
+            rec = _FnAnalysis(ctx, info.node, info.qualname,
+                              info.cls).run()
+        except RecursionError:  # pragma: no cover - pathological input
+            continue
+        functions[info.qualname] = rec
+    defs = sorted({i.name for i in infos} | set(classes))
+    callnames = sorted({_last(c["dotted"])
+                        for rec in functions.values()
+                        for c in rec["calls"]})
+    return {"functions": functions, "defs": defs, "callnames": callnames}
+
+
+def source_hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+
+# ------------------------------------------------------------ disk cache
+
+
+def cache_path() -> Optional[str]:
+    """The summary store named by ``PIFFT_CHECK_CACHE`` (``off``
+    disables caching entirely)."""
+    env = os.environ.get("PIFFT_CHECK_CACHE")
+    if env == "off":
+        return None
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "pifft",
+                        "check_summaries.json")
+
+
+class SummaryCache:
+    """Content-hash-keyed store of per-file summaries.
+
+    One JSON document holds every file's summary keyed by display path;
+    an entry is valid only while the file's sha256 matches.  ``hits``
+    and ``misses`` feed ``--stats`` (and the CI assertion that a warm
+    second run recomputes nothing)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.files: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                if isinstance(doc, dict) and doc.get("schema") == SCHEMA:
+                    self.files = doc.get("files", {})
+            except (OSError, ValueError):
+                self.files = {}
+
+    @classmethod
+    def default(cls) -> "SummaryCache":
+        return cls(cache_path())
+
+    def get(self, path: str, sha: str) -> Optional[dict]:
+        ent = self.files.get(path)
+        if ent and ent.get("hash") == sha:
+            self.hits += 1
+            return ent["summary"]
+        self.misses += 1
+        return None
+
+    def put(self, path: str, sha: str, summary: dict) -> None:
+        self.files[path] = {"hash": sha, "summary": summary}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self.path or not self._dirty:
+            return
+        doc = {"schema": SCHEMA, "files": self.files}
+        d = os.path.dirname(os.path.abspath(self.path))
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.path)
+        except OSError:  # pragma: no cover - read-only cache dir
+            pass
+
+    # ------------------------------------------- --changed invalidation
+
+    def invalidation_closure(self, changed: set) -> set:
+        """Expand a set of changed display paths with every cached file
+        that (transitively) CALLS a name one of them defines — the
+        edited-callee staleness fix: a caller's interprocedural finding
+        depends on its callee's summary, so the caller re-checks when
+        only the callee's file changed."""
+        out = set(changed)
+        defs_of = {p: set(e["summary"].get("defs", ()))
+                   for p, e in self.files.items()}
+        calls_of = {p: set(e["summary"].get("callnames", ()))
+                    for p, e in self.files.items()}
+        while True:
+            changed_names: set = set()
+            for p in out:
+                changed_names |= defs_of.get(p, set())
+            grew = False
+            for p, names in calls_of.items():
+                if p not in out and names & changed_names:
+                    out.add(p)
+                    grew = True
+            if not grew:
+                return out
+
+
+def ensure_summaries(program, cache: Optional[SummaryCache] = None) -> dict:
+    """path -> file summary for every context in `program`, via the
+    cache when warm.  Stored on ``program.cache['summaries']``."""
+    got = program.cache.get("summaries")
+    if got is not None:
+        return got
+    out: dict = {}
+    for path, ctx in program.contexts.items():
+        sha = source_hash(ctx.source)
+        rec = cache.get(path, sha) if cache is not None else None
+        if rec is None:
+            rec = summarize_file(ctx, program.module_of[path])
+            if cache is not None:
+                cache.put(path, sha, rec)
+        out[path] = rec
+    if cache is not None:
+        cache.save()
+    program.cache["summaries"] = out
+    return out

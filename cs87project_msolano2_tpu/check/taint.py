@@ -1,0 +1,503 @@
+"""Interprocedural taint rules: untrusted input chased to hot-path sinks.
+
+The per-function flow rules (:mod:`.rules_flow`) stop at the enclosing
+``def``.  These rules run over the whole :class:`~.callgraph.Program`:
+per-function summaries (:mod:`.summaries`) are stitched together along
+resolved call edges, so a wire header field decoded in one file and
+spent as a ``frombuffer`` count two frames later in another is one
+finding — carrying the full source→sink path, rendered as SARIF
+``codeFlows`` by ``--format sarif``.
+
+* **PIF118** — a wire/JSON/env source reaches an allocation size,
+  ``frombuffer`` count/offset, or slot/ring index with no bounds check
+  on the way.
+* **PIF119** — an unvalidated shape parameter reaches plan construction
+  (``plan_for``/``PlanKey``): a hostile size would compile a plan, and
+  compilation is the one cost the serving tier must never let a client
+  pick (docs/SERVING.md admission rules).
+* **PIF120** — a call made while holding a sync lock resolves to a
+  callee that (transitively) blocks: the interprocedural face of
+  PIF113's await-under-lock.
+* **PIF121** — a call site whose callee (transitively) demotes
+  untagged, on a caller path that also escapes untagged: the
+  interprocedural face of PIF115's never-silent rule.
+
+Sanitizer semantics live in the summary layer (generous: any
+comparison against an untainted bound kills the taint on both
+branches, as do clamp/validator calls); additionally, wire fields a
+*decoder* function (``decode_funcs`` config) bounds-checks before
+returning are trusted program-wide — fixing ``parse_header`` cleans
+every downstream read of that field.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import Iterator, Optional
+
+from . import summaries
+from .engine import Finding, ProgramRule, register
+
+#: functions whose local bounds checks promote wire fields to trusted —
+#: the decode boundary (matched on the bare function name)
+DECODE_FUNCS = ("parse_header", "*_decode", "decode_*")
+
+#: recursion bound for fact expansion across call edges
+MAX_DEPTH = 12
+
+_SRC_DESC = {
+    "wire": "wire field",
+    "json": "request field",
+    "env": "environment knob",
+    "unpack": "struct-unpacked value",
+}
+
+_SINK_DESC = {
+    "alloc": "an allocation size",
+    "frombuffer": "a frombuffer count/offset",
+    "index": "a slot/ring index",
+    "plan": "plan construction",
+}
+
+
+def _path_match(path: str, globs) -> bool:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    return any(fnmatch.fnmatch(norm, g) for g in globs)
+
+
+def _origin_kind(origin: str) -> str:
+    return origin.split(":", 1)[0].split("@", 1)[0]
+
+
+def _origin_line(origin: str) -> int:
+    if "@" in origin:
+        try:
+            return int(origin.rsplit("@", 1)[1])
+        except ValueError:
+            return 0
+    return 0
+
+
+def _origin_what(origin: str) -> str:
+    body = origin.split(":", 1)[1] if ":" in origin else origin
+    return body.rsplit("@", 1)[0]
+
+
+class _Analysis:
+    """The shared program-level fact engine (one per check run)."""
+
+    def __init__(self, program):
+        self.program = program
+        cache = program.cache.get("summary_cache")
+        self.file_summaries = summaries.ensure_summaries(program, cache)
+        self.fns: dict = {}
+        for path, filerec in self.file_summaries.items():
+            module = program.module_of[path]
+            for qual, rec in filerec["functions"].items():
+                self.fns[f"{module}:{qual}"] = {
+                    "path": path, "module": module, "rec": rec}
+        self._resolved: dict = {}
+        self._sink_memo: dict = {}
+        self._ret_memo: dict = {}
+        self._block_memo: dict = {}
+        self.validated_fields = self._decoder_validated()
+
+    @classmethod
+    def get(cls, program) -> "_Analysis":
+        got = program.cache.get("taint_analysis")
+        if got is None:
+            got = cls(program)
+            program.cache["taint_analysis"] = got
+        return got
+
+    def _decoder_validated(self) -> set:
+        """Wire fields some decode-boundary function bounds-checks on a
+        local of the same name before returning."""
+        out: set = set()
+        for ent in self.fns.values():
+            rec = ent["rec"]
+            if any(fnmatch.fnmatch(rec["name"], g) for g in DECODE_FUNCS):
+                out |= set(rec["sanitized"]) & set(summaries.WIRE_FIELDS)
+        return out
+
+    # ------------------------------------------------------- resolution
+
+    def resolve_cs(self, fid: str, cs: dict) -> Optional[str]:
+        key = (fid, cs["idx"])
+        if key in self._resolved:
+            return self._resolved[key]
+        module = self.fns[fid]["module"]
+        callee = self.program.resolve(module, cs)
+        if callee == fid:
+            callee = None  # self-recursion adds no new facts
+        self._resolved[key] = callee
+        return callee
+
+    def _cs_origins(self, callee_rec: dict, cs: dict, k: int) -> list:
+        """Caller-side origins feeding the callee's parameter #k."""
+        out = []
+        j = k - callee_rec["offset"]
+        if 0 <= j < len(cs["args"]):
+            out.extend(cs["args"][j])
+        params = callee_rec["params"]
+        if 0 <= k < len(params):
+            out.extend(cs["kwargs"].get(params[k], ()))
+        return out
+
+    # ---------------------------------------------------- sink facts
+
+    def expand_origin(self, fid: str, origin: str, depth: int,
+                      seen: frozenset) -> list:
+        """[(root, steps)] for one origin in `fid`'s frame: ``param:i``
+        stays relative; source origins carry their read location;
+        ``ret:j`` chases the callee's returns."""
+        path = self.fns[fid]["path"]
+        kind = _origin_kind(origin)
+        if kind == "param":
+            return [(origin, [])]
+        if kind in ("wire", "json", "env", "unpack"):
+            what = _origin_what(origin)
+            if kind == "wire" and what in self.validated_fields:
+                return []  # bounds-checked at the decode boundary
+            desc = _SRC_DESC[kind]
+            label = f"{desc} `{what}` read" if what else f"{desc} read"
+            return [(origin, [(path, _origin_line(origin), label)])]
+        if kind == "ret":
+            if depth >= MAX_DEPTH:
+                return []
+            idx = int(origin.split(":", 1)[1])
+            cs = self._call_by_idx(fid, idx)
+            if cs is None:
+                return []
+            callee = self.resolve_cs(fid, cs)
+            if callee is None or callee in seen:
+                return []
+            out = []
+            hop = (path, cs["line"], f"returned by `{cs['dotted']}`")
+            for root, steps in self.ret_facts(callee, depth + 1,
+                                              seen | {fid}):
+                if _origin_kind(root) == "param":
+                    k = int(root.split(":", 1)[1])
+                    for o in self._cs_origins(self.fns[callee]["rec"],
+                                              cs, k):
+                        for r2, s2 in self.expand_origin(
+                                fid, o, depth + 1, seen):
+                            out.append((r2, s2 + [hop] + steps))
+                else:
+                    out.append((root, steps + [hop]))
+            return out
+        return []
+
+    def _call_by_idx(self, fid: str, idx: int) -> Optional[dict]:
+        for cs in self.fns[fid]["rec"]["calls"]:
+            if cs["idx"] == idx:
+                return cs
+        return None
+
+    def ret_facts(self, fid: str, depth: int = 0,
+                  seen: frozenset = frozenset()) -> list:
+        if fid in self._ret_memo:
+            return self._ret_memo[fid]
+        out = []
+        for origin in self.fns[fid]["rec"]["returns"]:
+            out.extend(self.expand_origin(fid, origin, depth,
+                                          seen | {fid}))
+        if not seen:  # only memoize top-level (cycle-free) answers
+            self._ret_memo[fid] = out
+        return out
+
+    def sink_facts(self, fid: str, depth: int = 0,
+                   seen: frozenset = frozenset()) -> list:
+        """[{root, kind, steps}] — every sink this function (or a
+        transitive callee fed by its data) can hit, with the call path."""
+        if fid in self._sink_memo:
+            return self._sink_memo[fid]
+        ent = self.fns[fid]
+        path, rec = ent["path"], ent["rec"]
+        facts = []
+        for s in rec["sinks"]:
+            tail = (path, s["line"], s["what"])
+            for root, steps in self.expand_origin(fid, s["origin"],
+                                                  depth, seen | {fid}):
+                facts.append({"root": root, "kind": s["kind"],
+                              "steps": steps + [tail]})
+        if depth < MAX_DEPTH:
+            for cs in rec["calls"]:
+                callee = self.resolve_cs(fid, cs)
+                if callee is None or callee in seen:
+                    continue
+                sub = self.sink_facts(callee, depth + 1, seen | {fid})
+                if not sub:
+                    continue
+                hop = (path, cs["line"], f"passed to `{cs['dotted']}`")
+                callee_rec = self.fns[callee]["rec"]
+                for fact in sub:
+                    if _origin_kind(fact["root"]) != "param":
+                        continue
+                    k = int(fact["root"].split(":", 1)[1])
+                    for o in self._cs_origins(callee_rec, cs, k):
+                        for root, steps in self.expand_origin(
+                                fid, o, depth, seen):
+                            facts.append({
+                                "root": root, "kind": fact["kind"],
+                                "steps": steps + [hop] + fact["steps"]})
+        if not seen:
+            self._sink_memo[fid] = facts
+        return facts
+
+    # ------------------------------------------------- blocking facts
+
+    def blocking_facts(self, fid: str, depth: int = 0,
+                       seen: frozenset = frozenset()) -> Optional[list]:
+        """Call-path steps to blocking evidence, or None."""
+        if fid in self._block_memo:
+            return self._block_memo[fid]
+        ent = self.fns[fid]
+        rec, path = ent["rec"], ent["path"]
+        steps = None
+        if rec["blocking"]:
+            steps = [(path, rec["blocking"]["line"],
+                      f"`{rec['qual']}` blocks: {rec['blocking']['what']}")]
+        elif depth < MAX_DEPTH:
+            for cs in rec["calls"]:
+                if cs["awaited"]:
+                    continue
+                callee = self.resolve_cs(fid, cs)
+                if callee is None or callee in seen:
+                    continue
+                sub = self.blocking_facts(callee, depth + 1, seen | {fid})
+                if sub:
+                    steps = [(path, cs["line"],
+                              f"calls `{cs['dotted']}`")] + sub
+                    break
+        if not seen:
+            self._block_memo[fid] = steps
+        return steps
+
+    # -------------------------------------------------- demote facts
+
+    def demote_facts(self, fid: str, exempt, memo: dict, depth: int = 0,
+                     seen: frozenset = frozenset()) -> Optional[list]:
+        """Call-path steps to an untagged demotion, or None.  `exempt`
+        path globs (the resilience engine itself) never contribute."""
+        if fid in memo:
+            return memo[fid]
+        ent = self.fns[fid]
+        rec, path = ent["rec"], ent["path"]
+        if _path_match(path, exempt):
+            memo[fid] = None
+            return None
+        steps = None
+        if rec["demote"]:
+            steps = [(path, rec["demote"]["line"],
+                      f"`{rec['qual']}` demotes untagged: "
+                      f"{rec['demote']['what']}")]
+        elif depth < MAX_DEPTH:
+            for cs in rec["calls"]:
+                if not cs["esc_untagged"]:
+                    continue  # the callee's demotion gets tagged here
+                callee = self.resolve_cs(fid, cs)
+                if callee is None or callee in seen:
+                    continue
+                sub = self.demote_facts(callee, exempt, memo, depth + 1,
+                                        seen | {fid})
+                if sub:
+                    steps = [(path, cs["line"],
+                              f"calls `{cs['dotted']}`")] + sub
+                    break
+        if not seen:
+            memo[fid] = steps
+        return steps
+
+
+def _flow_tuple(steps) -> tuple:
+    return tuple((p, int(line), note) for p, line, note in steps)
+
+
+class _TaintSinkRule(ProgramRule):
+    """Shared body of PIF118/PIF119 (they differ in sink kinds)."""
+
+    sink_kinds: tuple = ()
+    source_kinds: tuple = ("wire", "json", "env", "unpack")
+
+    def _message(self, root: str, fact: dict, hops: int) -> str:
+        kind = _origin_kind(root)
+        what = _origin_what(root)
+        src = f"{_SRC_DESC[kind]} `{what}`" if what else _SRC_DESC[kind]
+        sink_path, sink_line, sink_what = fact["steps"][-1]
+        via = f" across {hops} call(s)" if hops else ""
+        return (f"untrusted {src} reaches {_SINK_DESC[fact['kind']]} "
+                f"({sink_what}) at line {sink_line}{via} with no bounds "
+                f"check on the path — {self.advice}")
+
+    def check_program(self, program, config) -> Iterator[Finding]:
+        analysis = _Analysis.get(program)
+        seen_keys: set = set()
+        for fid in sorted(analysis.fns):
+            ent = analysis.fns[fid]
+            if not _path_match(ent["path"], config["paths"]):
+                continue
+            for fact in analysis.sink_facts(fid):
+                root = fact["root"]
+                if _origin_kind(root) not in self.source_kinds:
+                    continue
+                if fact["kind"] not in self.sink_kinds:
+                    continue
+                steps = fact["steps"]
+                first = steps[0]
+                sink = steps[-1]
+                key = (first[0], first[1], sink[0], sink[1],
+                       fact["kind"], _origin_what(root))
+                if key in seen_keys:
+                    continue
+                seen_keys.add(key)
+                hops = sum(1 for s in steps
+                           if s[2].startswith("passed to"))
+                yield Finding(
+                    rule=self.id, path=first[0], line=first[1], col=0,
+                    message=self._message(root, fact, hops),
+                    flow=_flow_tuple(steps))
+
+
+@register
+class WireFieldToAllocation(_TaintSinkRule):
+    id = "PIF118"
+    name = "untrusted-size-to-allocation"
+    summary = ("taint: a wire/JSON/env field reaches an allocation "
+               "size, frombuffer count/offset, or slot/ring index "
+               "across calls with no bounds check")
+    invariant = ("the binary front door's header fields are attacker-"
+                 "controlled (docs/SERVING.md \"The wire\"); any of "
+                 "them that sizes a buffer or indexes a ring must be "
+                 "bounds-checked before first use, or a hostile client "
+                 "sizes our memory")
+    advice = ("clamp or validate against a MAX_* cap before sizing "
+              "(docs/CHECKS.md PIF118)")
+    sink_kinds = ("alloc", "frombuffer", "index")
+    default_config = {
+        "paths": ("*/serve/*",),
+        "exempt": (),
+    }
+
+
+@register
+class UnvalidatedShapeToPlan(_TaintSinkRule):
+    id = "PIF119"
+    name = "unvalidated-shape-to-plan"
+    summary = ("taint: an unvalidated wire/JSON shape parameter "
+               "reaches plan construction (plan_for/PlanKey)")
+    invariant = ("compilation cost is admission-controlled "
+                 "(docs/SERVING.md): a client-picked size that reaches "
+                 "plan_for/PlanKey unvalidated compiles an arbitrary "
+                 "plan, bypassing the shape-vocabulary gate")
+    advice = ("route client sizes through the frozen shape vocabulary "
+              "(or an explicit cap) before planning "
+              "(docs/CHECKS.md PIF119)")
+    sink_kinds = ("plan",)
+    default_config = {
+        "paths": ("*/serve/*", "*/plans/*", "*/apps/*"),
+        "exempt": (),
+    }
+
+
+@register
+class LockHeldAcrossBlockingCallee(ProgramRule):
+    id = "PIF120"
+    name = "lock-held-across-blocking-callee"
+    summary = ("taint: a call made holding a sync lock resolves to a "
+               "callee that (transitively) blocks — interprocedural "
+               "PIF113")
+    invariant = ("the serve loop shares its locks across tasks; a "
+                 "callee that sleeps or joins while the caller holds a "
+                 "lock stalls every peer, invisibly to the "
+                 "per-function await-under-lock rule (PIF113)")
+    default_config = {
+        "paths": ("*/serve/*", "*/resilience/*", "*/obs/*"),
+        "exempt": (),
+    }
+
+    def check_program(self, program, config) -> Iterator[Finding]:
+        analysis = _Analysis.get(program)
+        for fid in sorted(analysis.fns):
+            ent = analysis.fns[fid]
+            if not _path_match(ent["path"], config["paths"]):
+                continue
+            for cs in ent["rec"]["calls"]:
+                if not cs["locks"] or cs["awaited"] or cs["partial"]:
+                    continue  # a partial BINDS the callee, it runs later
+                callee = analysis.resolve_cs(fid, cs)
+                if callee is None:
+                    continue
+                steps = analysis.blocking_facts(callee)
+                if not steps:
+                    continue
+                locks = ", ".join(f"`{t}`" for t in cs["locks"])
+                head = (ent["path"], cs["line"],
+                        f"call under lock {locks}")
+                yield Finding(
+                    rule=self.id, path=ent["path"], line=cs["line"],
+                    col=cs["col"],
+                    message=(f"`{cs['dotted']}(...)` is called while "
+                             f"holding {locks}, and the callee "
+                             f"(transitively) blocks: {steps[-1][2]} — "
+                             f"blocking under a shared lock stalls "
+                             f"every task contending for it; move the "
+                             f"blocking work outside the critical "
+                             f"section (docs/CHECKS.md PIF120)"),
+                    flow=_flow_tuple([head] + steps))
+
+
+@register
+class DegradeTagDroppedAcrossCall(ProgramRule):
+    id = "PIF121"
+    name = "degrade-tag-dropped-across-call"
+    summary = ("taint: a callee (transitively) demotes untagged and "
+               "the caller's path also escapes untagged — "
+               "interprocedural PIF115")
+    invariant = ("the never-silent rule (docs/RESILIENCE.md): every "
+                 "demotion is tagged before the value escapes.  A "
+                 "helper that demotes, called by a caller that never "
+                 "tags, silences the per-function rule in BOTH frames")
+    default_config = {
+        "paths": ("*/serve/*", "*/resilience/*", "*/plans/*",
+                  "*/parallel/*", "*bench.py"),
+        "exempt": ("*resilience/degrade.py",),
+    }
+
+    def check_program(self, program, config) -> Iterator[Finding]:
+        analysis = _Analysis.get(program)
+        memo: dict = {}
+        exempt = config.get("exempt", ())
+        for fid in sorted(analysis.fns):
+            ent = analysis.fns[fid]
+            if not _path_match(ent["path"], config["paths"]) or \
+                    _path_match(ent["path"], exempt):
+                continue
+            for cs in ent["rec"]["calls"]:
+                if not cs["esc_untagged"] or cs["partial"]:
+                    continue
+                last = cs["dotted"].rsplit(".", 1)[-1]
+                if last in summaries.RUNG_CALLS:
+                    continue  # the per-function PIF115 owns this site
+                callee = analysis.resolve_cs(fid, cs)
+                if callee is None:
+                    continue
+                steps = analysis.demote_facts(callee, exempt, memo)
+                if not steps:
+                    continue
+                head = (ent["path"], cs["line"],
+                        f"calls `{cs['dotted']}`, then escapes with "
+                        f"no `degraded` tag")
+                yield Finding(
+                    rule=self.id, path=ent["path"], line=cs["line"],
+                    col=cs["col"],
+                    message=(f"`{cs['dotted']}(...)` (transitively) "
+                             f"demotes untagged — {steps[-1][2]} — and "
+                             f"this caller's path from the call to its "
+                             f"exit never sets a `degraded` tag either: "
+                             f"the demotion escapes silently across "
+                             f"the call boundary (docs/RESILIENCE.md "
+                             f"never-silent rule; docs/CHECKS.md "
+                             f"PIF121)"),
+                    flow=_flow_tuple([head] + steps))

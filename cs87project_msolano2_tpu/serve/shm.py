@@ -82,6 +82,13 @@ class ShmRing:
     @classmethod
     def attach(cls, name: str, slots: int, slot_bytes: int) -> "ShmRing":
         shm = shared_memory.SharedMemory(name=name)
+        if slots < 1 or slot_bytes < 8 or slots * slot_bytes > shm.size:
+            # the geometry arrived over the wire (HELLO_ACK); a ring
+            # that does not fit the mapped segment would hand out slot
+            # views past the end of the buffer
+            shm.close()
+            raise ValueError(f"ring geometry {slots}x{slot_bytes} does "
+                             f"not fit the {shm.size}-byte segment")
         _untrack(shm)
         return cls(shm, slots, slot_bytes, owner=False)
 

@@ -95,6 +95,21 @@ MAX_EXTRAS_BYTES = 1 << 16
 #: plane payload cap (matches the JSON front's frame cap rationale)
 MAX_PAYLOAD_BYTES = 1 << 30
 
+#: transform-length / plane-width caps: ``n`` and ``width`` are header
+#: fields a hostile client picks and downstream code spends as
+#: ``frombuffer`` counts and staging sizes, so they are bounds-checked
+#: HERE, at the decode boundary, before any size arithmetic sees them
+#: (check rule PIF118).  Two float32 planes of ``width`` elements must
+#: fit the payload cap; ``n`` bounds the transform any dispatcher
+#: would admit.
+MAX_WIRE_N = 1 << 28
+MAX_WIRE_WIDTH = MAX_PAYLOAD_BYTES // 8
+
+#: shm grant caps: HELLO_ACK reuses ``n``/``width`` as slot count and
+#: slot bytes; a client must not size its free-slot list or map a ring
+#: from a hostile server's numbers unchecked
+MAX_SHM_SLOTS = 4096
+
 #: per-connection flow-control window granted in HELLO_ACK
 DEFAULT_CREDITS = 32
 
@@ -277,6 +292,11 @@ def parse_header(head: bytes) -> Frame:
     if payload_len > MAX_PAYLOAD_BYTES:
         raise WireError(f"payload_len {payload_len} exceeds the "
                         f"{MAX_PAYLOAD_BYTES}-byte cap")
+    if n > MAX_WIRE_N:
+        raise WireError(f"n {n} exceeds the {MAX_WIRE_N} cap")
+    if width > MAX_WIRE_WIDTH:
+        raise WireError(f"width {width} exceeds the "
+                        f"{MAX_WIRE_WIDTH} cap")
     return Frame(
         msg_type, flags,
         _lookup(op_i, WIRE_OPS, "op"),
@@ -402,6 +422,12 @@ class WireClient:
             if ack.flags & F_SHM and ack.payload:
                 from .shm import ShmRing
 
+                # the grant numbers come off the wire: a hostile server
+                # must not size our free-slot list or the ring mapping
+                if not 1 <= ack.n <= MAX_SHM_SLOTS or ack.width < 8:
+                    raise WireError(
+                        f"shm grant out of contract: {ack.n} slot(s) "
+                        f"x {ack.width} byte(s)")
                 self.shm = ShmRing.attach(
                     bytes(ack.payload).decode("utf-8"),
                     slots=ack.n, slot_bytes=ack.width)

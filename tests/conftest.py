@@ -21,6 +21,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # monkeypatch PIFFT_PLAN_CACHE to a tmp dir (it is re-read per call).
 os.environ["PIFFT_PLAN_CACHE"] = "off"
 os.environ.pop("PIFFT_PLAN_AUTOTUNE", None)
+# check subsystem: same rule for the summary cache — tests that
+# exercise the disk store monkeypatch PIFFT_CHECK_CACHE to a tmp file
+# (it is re-read per run).
+os.environ["PIFFT_CHECK_CACHE"] = "off"
 
 import jax  # noqa: E402
 

@@ -1576,3 +1576,187 @@ def test_cli_list_noqa_sarif_is_usage_error(tmp_path, capsys):
     assert check_cli_main([str(p), "--list-noqa",
                            "--format", "sarif"]) == 2
     assert "--list-noqa" in capsys.readouterr().err
+
+
+# ----------------------- the interprocedural layer's engine surface:
+# summary cache, --changed staleness closure, --stats, SARIF codeFlows
+
+ALLOC_CLEAN = (
+    "import numpy as np\n"
+    "\n"
+    "MAX = 4096\n"
+    "\n"
+    "def stage(width):\n"
+    "    width = min(width, MAX)\n"
+    "    return np.zeros(width)\n")
+
+ALLOC_UNCLAMPED = (
+    "import numpy as np\n"
+    "\n"
+    "def stage(width):\n"
+    "    return np.zeros(width)\n")
+
+RECV = (
+    "from pkg.serve.alloc import stage\n"
+    "\n"
+    "def on_frame(frame):\n"
+    "    return stage(frame.width)\n")
+
+
+@pytest.fixture
+def taint_repo(tmp_path, monkeypatch):
+    """A git repo with a serve-layer caller/callee pair (clean as
+    committed) and a live summary cache."""
+    repo = tmp_path / "r"
+    (repo / "pkg" / "serve").mkdir(parents=True)
+    _git(tmp_path, "init", "-q", str(repo))
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    (repo / "pkg" / "serve" / "alloc.py").write_text(ALLOC_CLEAN)
+    (repo / "pkg" / "serve" / "recv.py").write_text(RECV)
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "init")
+    monkeypatch.chdir(repo)
+    monkeypatch.setenv("PIFFT_CHECK_CACHE", str(tmp_path / "cache.json"))
+    return repo
+
+
+def test_changed_refires_caller_after_callee_edit(taint_repo, capsys):
+    """The edited-callee staleness fix: the caller's interprocedural
+    finding depends on the callee's summary, so a --changed run that
+    touched ONLY the callee must re-check the caller."""
+    # full warm run: clean, and the summary cache is now populated
+    assert check_cli_main(["pkg", "--rule", "PIF118"]) == 0
+    capsys.readouterr()
+    # edit ONLY the callee: drop the clamp
+    (taint_repo / "pkg" / "serve" / "alloc.py").write_text(
+        ALLOC_UNCLAMPED)
+    rc = check_cli_main(["pkg", "--changed", "HEAD",
+                         "--rule", "PIF118"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    # the finding anchors at the wire read in the UNCHANGED caller —
+    # reachable only because the cache's call edges pulled recv.py
+    # back into scope
+    assert "recv.py" in captured.out
+    assert "1 dependent caller file(s)" in captured.err
+
+
+def test_changed_without_dependents_stays_narrow(taint_repo, capsys):
+    assert check_cli_main(["pkg", "--rule", "PIF118"]) == 0
+    capsys.readouterr()
+    # a new leaf file calls nothing the others define and nothing
+    # calls it: no closure growth
+    (taint_repo / "pkg" / "serve" / "extra.py").write_text("x = 1\n")
+    assert check_cli_main(["pkg", "--changed", "HEAD",
+                           "--rule", "PIF118"]) == 0
+    assert "dependent caller" not in capsys.readouterr().err
+
+
+def test_summary_cache_warm_second_run(tmp_path):
+    from cs87project_msolano2_tpu.check import summaries
+
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "alloc.py").write_text(ALLOC_UNCLAMPED)
+    cpath = str(tmp_path / "c.json")
+
+    cold = engine.RunStats()
+    found1 = check.check_paths([str(d)], rules=["PIF118"], stats=cold,
+                               cache=summaries.SummaryCache(cpath))
+    assert cold.cache["misses"] == 1 and cold.cache["hits"] == 0
+    assert os.path.exists(cpath)
+
+    warm = engine.RunStats()
+    found2 = check.check_paths([str(d)], rules=["PIF118"], stats=warm,
+                               cache=summaries.SummaryCache(cpath))
+    assert warm.cache["misses"] == 0 and warm.cache["hits"] == 1
+    # cached summaries reproduce the findings exactly
+    assert [f.key() for f in found1] == [f.key() for f in found2]
+
+
+def test_summary_cache_invalidates_on_content_change(tmp_path):
+    from cs87project_msolano2_tpu.check import summaries
+
+    d = tmp_path / "serve"
+    d.mkdir()
+    p = d / "alloc.py"
+    p.write_text(ALLOC_CLEAN)
+    cpath = str(tmp_path / "c.json")
+    assert check.check_paths([str(d)], rules=["PIF118"],
+                             cache=summaries.SummaryCache(cpath)) == []
+    p.write_text(
+        "import numpy as np\n\ndef stage(ack):\n"
+        "    return np.zeros(ack.n)\n")
+    stats = engine.RunStats()
+    found = check.check_paths([str(d)], rules=["PIF118"], stats=stats,
+                              cache=summaries.SummaryCache(cpath))
+    assert stats.cache["misses"] == 1  # stale hash recomputed
+    assert rule_ids(found) == ["PIF118"]
+
+
+def test_cli_stats_json_shape(tmp_path, capsys):
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "snippet.py").write_text(
+        "def stage(ack):\n    return bytearray(ack.n)\n")
+    rc = check_cli_main([str(d), "--rule", "PIF118",
+                         "--format", "json", "--stats"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    stats = doc["stats"]
+    assert stats["files"] == 1
+    for phase in ("parse", "callgraph", "summaries", "taint"):
+        assert phase in stats["phases"]
+    assert stats["rules"]["PIF118"]["findings"] == 1
+    assert set(stats["cache"]) == {"hits", "misses", "path"}
+    # the findings themselves still carry the flow path
+    (rec,) = doc["findings"]
+    assert len(rec["flow"]) >= 2
+
+
+def test_cli_stats_human_table(tmp_path, capsys):
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "snippet.py").write_text("x = 1\n")
+    assert check_cli_main([str(d), "--rule", "PIF118", "--stats"]) == 0
+    out = capsys.readouterr().out
+    assert "--stats" in out and "summaries" in out
+    assert "PIF118" in out and "summary cache:" in out
+
+
+def test_sarif_codeflows_for_taint_findings():
+    findings = check.check_source(
+        "pkg/serve/snippet.py",
+        "import numpy as np\n\n"
+        "def land(frame, buf):\n"
+        "    return np.frombuffer(buf, np.float32, count=frame.width)\n",
+        rules=["PIF118"])
+    assert len(findings) == 1
+    doc = json.loads(engine.to_sarif(findings))
+    (result,) = doc["runs"][0]["results"]
+    locs = result["codeFlows"][0]["threadFlows"][0]["locations"]
+    assert len(locs) >= 2
+    texts = [l["location"]["message"]["text"] for l in locs]
+    assert any("width" in t for t in texts)
+    assert "count/offset" in texts[-1]
+    # non-interprocedural findings carry no codeFlows
+    plain = check.check_source(
+        "m.py", "import time\nt0 = time.perf_counter()\n",
+        rules=["PIF102"])
+    doc2 = json.loads(engine.to_sarif(plain))
+    assert all("codeFlows" not in r for r in doc2["runs"][0]["results"])
+
+
+def test_finding_flow_json_roundtrip():
+    f = engine.Finding(
+        rule="PIF118", path="a.py", line=3, col=0, message="m",
+        flow=(("a.py", 3, "read"), ("b.py", 9, "spent")))
+    rec = f.to_record()
+    assert rec["flow"] == [["a.py", 3, "read"], ["b.py", 9, "spent"]]
+    assert engine.Finding.from_record(rec) == f
+    # findings without a flow serialize exactly as before (baseline
+    # key and record stability)
+    bare = engine.Finding(rule="PIF102", path="a.py", line=1, col=0,
+                          message="m")
+    assert "flow" not in bare.to_record()

@@ -1085,3 +1085,469 @@ def test_pif112_unknown_receiver_still_matches_guarded_attr():
                 device.busy_s = 0.0
     """, "PIF112")
     assert rule_ids(found) == ["PIF112"]
+
+
+# ===================================================================
+# The interprocedural layer: PIF118-PIF121 (check/taint.py) — per
+# rule: positive, negative-via-sanitizer, cross-file two-hop, noqa,
+# scope.  Cross-file cases go through check.check_sources, which runs
+# several in-memory files as ONE program.
+
+
+def run_prog(sources, rule, report=None):
+    return check.check_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        rules=[rule], report=report)
+
+
+# ================================ PIF118 — untrusted size to sink
+
+
+def test_pif118_wire_width_to_frombuffer_count():
+    found = run("""
+        import numpy as np
+
+        def land(frame, buf):
+            return np.frombuffer(buf, np.float32, count=frame.width)
+    """, "PIF118")
+    assert rule_ids(found) == ["PIF118"]
+    (f,) = found
+    assert "width" in f.message and "frombuffer" in f.message
+    # the finding carries the source->sink path for codeFlows
+    assert len(f.flow) >= 2
+    assert "count/offset" in f.flow[-1][2]
+
+
+def test_pif118_wire_n_to_allocation():
+    found = run("""
+        def stage(ack):
+            return bytearray(ack.n)
+    """, "PIF118")
+    assert rule_ids(found) == ["PIF118"]
+    assert "allocation" in found[0].message
+
+
+def test_pif118_wire_slot_to_ring_index():
+    found = run("""
+        def view(ring, frame):
+            return ring[frame.slot]
+    """, "PIF118")
+    assert rule_ids(found) == ["PIF118"]
+    assert "index" in found[0].message
+
+
+def test_pif118_bounds_check_sanitizes():
+    found = run("""
+        import numpy as np
+
+        MAX_WIDTH = 1 << 20
+
+        def land(frame, buf):
+            width = frame.width
+            if width > MAX_WIDTH:
+                raise ValueError("width out of contract")
+            return np.frombuffer(buf, np.float32, count=width)
+    """, "PIF118")
+    assert found == []
+
+
+def test_pif118_range_guard_sanitizes_index():
+    found = run("""
+        def view(ring, frame):
+            slot = frame.slot
+            if not 0 <= slot < len(ring):
+                raise ValueError("slot out of range")
+            return ring[slot]
+    """, "PIF118")
+    assert found == []
+
+
+def test_pif118_cross_file_return_of_wire_field():
+    # the source is read in one file, spent in another: the callee
+    # returns frame.n, the caller sizes an array with it
+    found = run_prog({
+        "pkg/serve/decode.py": """
+            def read_n(frame):
+                return frame.n
+        """,
+        "pkg/serve/handler.py": """
+            import numpy as np
+
+            from pkg.serve.decode import read_n
+
+            def admit(frame):
+                n = read_n(frame)
+                return np.zeros(n)
+        """,
+    }, "PIF118")
+    assert rule_ids(found) == ["PIF118"]
+    (f,) = found
+    # anchored at the untrusted READ (the natural fix/noqa site); the
+    # flow walks into the caller that spends it
+    assert f.path == "pkg/serve/decode.py"
+    assert any(step[0] == "pkg/serve/handler.py" for step in f.flow)
+    assert f.flow[-1][0] == "pkg/serve/handler.py"
+
+
+def test_pif118_cross_file_taint_passed_to_callee_sink():
+    # the other direction: the caller reads the field and passes it to
+    # a callee whose body allocates
+    found = run_prog({
+        "pkg/serve/recv.py": """
+            from pkg.serve.alloc import stage
+
+            def on_frame(frame):
+                return stage(frame.width)
+        """,
+        "pkg/serve/alloc.py": """
+            import numpy as np
+
+            def stage(width):
+                return np.zeros(width)
+        """,
+    }, "PIF118")
+    assert rule_ids(found) == ["PIF118"]
+    (f,) = found
+    assert f.path == "pkg/serve/recv.py"
+    assert "across 1 call(s)" in f.message
+    assert any(step[0] == "pkg/serve/alloc.py" for step in f.flow)
+
+
+def test_pif118_decoder_bounds_check_trusts_field_programwide():
+    # a decode-boundary function (*_decode) that bounds-checks `width`
+    # promotes the field to trusted everywhere — the parse_header
+    # contract
+    sources = {
+        "pkg/serve/user.py": """
+            import numpy as np
+
+            def land(frame, buf):
+                return np.frombuffer(buf, np.float32,
+                                     count=frame.width)
+        """,
+    }
+    assert rule_ids(run_prog(sources, "PIF118")) == ["PIF118"]
+    sources["pkg/serve/codec.py"] = """
+        MAX_WIDTH = 4096
+
+        def header_decode(buf, frame):
+            width = frame.width
+            if width > MAX_WIDTH:
+                raise ValueError("width out of contract")
+            return width
+    """
+    assert run_prog(sources, "PIF118") == []
+
+
+def test_pif118_noqa_suppresses():
+    found = run("""
+        import numpy as np
+
+        def land(frame, buf):
+            w = frame.width  # pifft: noqa[PIF118]: smoke fixture, buf is trusted test data
+            return np.frombuffer(buf, np.float32, count=w)
+    """, "PIF118")
+    assert found == []
+
+
+def test_pif118_scope_is_serve_only():
+    code = """
+        def stage(ack):
+            return bytearray(ack.n)
+    """
+    assert run(code, "PIF118", "pkg/analyze/snippet.py") == []
+
+
+# ================================ PIF119 — unvalidated shape to plan
+
+
+def test_pif119_request_field_to_plan_for():
+    found = run("""
+        def admit(msg):
+            n = msg.get("n")
+            return plan_for(n)
+    """, "PIF119")
+    assert rule_ids(found) == ["PIF119"]
+    assert "plan construction" in found[0].message
+
+
+def test_pif119_vocab_clamp_sanitizes():
+    found = run("""
+        def admit(msg, vocab):
+            n = vocab.clamp(msg.get("n"))
+            return plan_for(n)
+    """, "PIF119")
+    assert found == []
+
+
+def test_pif119_max_cap_comparison_sanitizes():
+    found = run("""
+        MAX_N = 1 << 22
+
+        def admit(msg):
+            n = int(msg.get("n"))
+            if n > MAX_N:
+                raise ValueError("n out of contract")
+            return plan_for(n)
+    """, "PIF119")
+    assert found == []
+
+
+def test_pif119_cross_file_two_hop():
+    found = run_prog({
+        "pkg/serve/front.py": """
+            def parse_req(msg):
+                return msg.get("n")
+        """,
+        "pkg/plans/admit.py": """
+            from pkg.serve.front import parse_req
+
+            def plan_req(msg):
+                n = parse_req(msg)
+                return plan_for(n)
+        """,
+    }, "PIF119")
+    assert rule_ids(found) == ["PIF119"]
+    (f,) = found
+    # anchored at the request-field read; the sink is in the caller
+    assert f.path == "pkg/serve/front.py"
+    assert f.flow[-1][0] == "pkg/plans/admit.py"
+
+
+def test_pif119_noqa_suppresses():
+    found = run("""
+        def admit(msg):
+            n = msg.get("n")  # pifft: noqa[PIF119]: dispatcher re-validates against the vocabulary
+            return plan_for(n)
+    """, "PIF119")
+    assert found == []
+
+
+def test_pif119_scope_excludes_ops():
+    code = """
+        def admit(msg):
+            n = msg.get("n")
+            return plan_for(n)
+    """
+    assert run(code, "PIF119", "pkg/ops/snippet.py") == []
+
+
+# ====================== PIF120 — lock held across blocking callee
+
+
+def test_pif120_sleeping_callee_under_lock():
+    found = run("""
+        import time
+
+        def drain(q):
+            time.sleep(0.05)
+
+        def pump(q, state_lock):
+            with state_lock:
+                drain(q)
+    """, "PIF120")
+    assert rule_ids(found) == ["PIF120"]
+    (f,) = found
+    assert "state_lock" in f.message and "time.sleep" in f.message
+    assert len(f.flow) >= 2
+
+
+def test_pif120_call_outside_region_is_clean():
+    found = run("""
+        import time
+
+        def drain(q):
+            time.sleep(0.05)
+
+        def pump(q, state_lock):
+            with state_lock:
+                q.append(1)
+            drain(q)
+    """, "PIF120")
+    assert found == []
+
+
+def test_pif120_nonblocking_callee_is_clean():
+    found = run("""
+        def drain(q):
+            q.clear()
+
+        def pump(q, state_lock):
+            with state_lock:
+                drain(q)
+    """, "PIF120")
+    assert found == []
+
+
+def test_pif120_cross_file_transitive_blocking():
+    found = run_prog({
+        "pkg/serve/loop.py": """
+            from pkg.serve.util import settle
+
+            def pump(q, state_lock):
+                with state_lock:
+                    settle(q)
+        """,
+        "pkg/serve/util.py": """
+            import time
+
+            def settle(q):
+                flush(q)
+
+            def flush(q):
+                time.sleep(0.01)
+        """,
+    }, "PIF120")
+    assert rule_ids(found) == ["PIF120"]
+    (f,) = found
+    assert f.path == "pkg/serve/loop.py"
+    # the path walks settle -> flush -> time.sleep
+    assert sum(1 for step in f.flow
+               if step[0] == "pkg/serve/util.py") >= 2
+
+
+def test_pif120_noqa_suppresses():
+    found = run("""
+        import time
+
+        def drain(q):
+            time.sleep(0.05)
+
+        def pump(q, state_lock):
+            with state_lock:
+                drain(q)  # pifft: noqa[PIF120]: startup-only path, nothing contends yet
+    """, "PIF120")
+    assert found == []
+
+
+def test_pif120_scope_excludes_ops():
+    code = """
+        import time
+
+        def drain(q):
+            time.sleep(0.05)
+
+        def pump(q, state_lock):
+            with state_lock:
+                drain(q)
+    """
+    assert run(code, "PIF120", "pkg/ops/snippet.py") == []
+
+
+# ==================== PIF121 — degrade tag dropped across a call
+
+
+def test_pif121_untagged_demoting_callee():
+    found = run("""
+        def note_overload(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")
+            return outcome
+
+        def serve(outcome, rung):
+            out = note_overload(outcome, rung)
+            return out
+    """, "PIF121")
+    assert rule_ids(found) == ["PIF121"]
+    (f,) = found
+    assert "note_overload" in f.message
+    assert len(f.flow) >= 2
+
+
+def test_pif121_caller_tag_after_call_is_clean():
+    found = run("""
+        def note_overload(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")
+            return outcome
+
+        def serve(outcome, rung):
+            out = note_overload(outcome, rung)
+            out.degraded = True
+            return out
+    """, "PIF121")
+    assert found == []
+
+
+def test_pif121_callee_tags_internally_is_clean():
+    found = run("""
+        def note_overload(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")
+            outcome.degraded = True
+            return outcome
+
+        def serve(outcome, rung):
+            return note_overload(outcome, rung)
+    """, "PIF121")
+    assert found == []
+
+
+def test_pif121_cross_file_demotion():
+    found = run_prog({
+        "pkg/resilience/retry.py": """
+            def note(outcome, rung):
+                outcome.degrade.append(f"overload:{rung}")
+                return outcome
+        """,
+        "pkg/serve/front.py": """
+            from pkg.resilience.retry import note
+
+            def serve(outcome, rung):
+                return note(outcome, rung)
+        """,
+    }, "PIF121", report=["pkg/serve/front.py"])
+    assert rule_ids(found) == ["PIF121"]
+    (f,) = found
+    assert f.path == "pkg/serve/front.py"
+    assert any(step[0] == "pkg/resilience/retry.py" for step in f.flow)
+
+
+def test_pif121_degrade_engine_exempt():
+    # the resilience engine itself demotes for a living; calls into it
+    # do not indict the caller via THIS rule (PIF115 owns rung calls)
+    found = run_prog({
+        "pkg/resilience/degrade.py": """
+            def note(outcome, rung):
+                outcome.degrade.append(f"overload:{rung}")
+                return outcome
+        """,
+        "pkg/serve/front.py": """
+            from pkg.resilience.degrade import note
+
+            def serve(outcome, rung):
+                return note(outcome, rung)
+        """,
+    }, "PIF121", report=["pkg/serve/front.py"])
+    assert found == []
+
+
+def test_pif121_noqa_suppresses():
+    found = run("""
+        def note_overload(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")
+            return outcome
+
+        def serve(outcome, rung):
+            out = note_overload(outcome, rung)  # pifft: noqa[PIF121]: dispatcher tags at delivery
+            return out
+    """, "PIF121")
+    assert found == []
+
+
+def test_pif121_scope_excludes_analyze():
+    code = """
+        def note_overload(outcome, rung):
+            outcome.degrade.append(f"overload:{rung}")
+            return outcome
+
+        def serve(outcome, rung):
+            return note_overload(outcome, rung)
+    """
+    assert run(code, "PIF121", "pkg/analyze/snippet.py") == []
+
+
+# ------------------------------- interprocedural shipped-clean gate
+
+
+def test_shipped_package_clean_interprocedural():
+    found = check.check_paths(
+        [PKG], rules=["PIF118", "PIF119", "PIF120", "PIF121"])
+    assert found == [], engine.format_human(found)

@@ -559,3 +559,136 @@ def test_loader_parses_per_protocol_serve_load_rows(tmp_path):
     # every other sample keeps the "json" protocol backfill
     assert all(s.protocol == "json" for s in bench_samples(rnd)
                if not s.metric.startswith("serve_load"))
+
+
+# ------------------------------------------------- header fuzzing
+
+#: (offset, size) of the size-bearing header fields in the packed
+#: 48-byte layout ("<4sHHBBBBBBBBQIIIIQ")
+FIELD_OFFSETS = {
+    "n": (24, 4),
+    "width": (28, 4),
+    "extras_len": (32, 4),
+    "slot": (36, 4),
+    "payload_len": (40, 8),
+}
+
+
+def _parse_or_wire_error(buf):
+    """parse_header must be TOTAL over corrupted input: a Frame or a
+    WireError, never any other exception."""
+    try:
+        return wire.parse_header(bytes(buf))
+    except wire.WireError:
+        return None
+
+
+def test_header_bit_flip_fuzz_is_total():
+    """All 384 single-bit corruptions of a valid header either decode
+    or raise WireError; whatever decodes respects the decode-boundary
+    caps (PIF118's trusted-field contract)."""
+    good = bytes(wire.encode_frame(wire.MSG_REQUEST, rid=7, n=N,
+                                   width=N)[0])
+    assert len(good) == wire.HEADER.size == 48
+    for byte in range(len(good)):
+        for bit in range(8):
+            mutated = bytearray(good)
+            mutated[byte] ^= 1 << bit
+            frame = _parse_or_wire_error(mutated)
+            if frame is not None:
+                assert frame.n <= wire.MAX_WIRE_N
+                assert frame.width <= wire.MAX_WIRE_WIDTH
+                assert frame.extras <= wire.MAX_EXTRAS_BYTES
+                assert frame.payload <= wire.MAX_PAYLOAD_BYTES
+
+
+def test_header_boundary_value_fuzz_is_total():
+    """Boundary values planted in every size-bearing field: 0/1, the
+    32-bit edges, and each cap +-1.  Values past a cap MUST be
+    rejected; everything else decodes with the planted value intact."""
+    good = bytes(wire.encode_frame(wire.MSG_REQUEST, n=N, width=N)[0])
+    caps = {"n": wire.MAX_WIRE_N, "width": wire.MAX_WIRE_WIDTH,
+            "extras_len": wire.MAX_EXTRAS_BYTES,
+            "payload_len": wire.MAX_PAYLOAD_BYTES,
+            "slot": None}
+    for name, (off, size) in sorted(FIELD_OFFSETS.items()):
+        cap = caps[name]
+        values = [0, 1, 2**31 - 1, 2**32 - 1, 2**(8 * size) - 1]
+        if cap is not None:
+            values += [cap - 1, cap, cap + 1]
+        for value in values:
+            if value >= 1 << (8 * size):
+                continue
+            mutated = bytearray(good)
+            mutated[off:off + size] = value.to_bytes(size, "little")
+            frame = _parse_or_wire_error(mutated)
+            if cap is not None and value > cap:
+                assert frame is None, (name, value)
+            else:
+                assert frame is not None, (name, value)
+                decoded = {"n": frame.n, "width": frame.width,
+                           "extras_len": frame.extras,
+                           "slot": frame.slot,
+                           "payload_len": frame.payload}[name]
+                assert decoded == value
+
+
+def test_fuzzed_headers_never_kill_the_server(obs_run):
+    """A deterministic (seeded) battery of corrupted headers against a
+    live server: every connection ends in a structured reply or a
+    clean close — never a hang, never an unhandled exception — and the
+    server stays alive for the next well-formed client."""
+    rng = np.random.default_rng(0x11F)
+    good = bytes(wire.encode_frame(wire.MSG_REQUEST, n=N, width=N)[0])
+    mutants = []
+    for _ in range(10):
+        m = bytearray(good)
+        # keep the magic: these exercise the binary dialect, not
+        # dialect detection (the malformed-header test covers that)
+        for _ in range(int(rng.integers(1, 4))):
+            m[int(rng.integers(4, len(m)))] ^= 1 << int(rng.integers(8))
+        mutants.append(bytes(m))
+    for name, (off, size) in sorted(FIELD_OFFSETS.items()):
+        m = bytearray(good)
+        m[off:off + size] = (2**(8 * size) - 1).to_bytes(size, "little")
+        mutants.append(bytes(m))
+
+    async def main():
+        d, server, port = await _start_server()
+        try:
+            for m in mutants:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(m)
+                if writer.can_write_eof():
+                    writer.write_eof()  # no payload follows, ever
+                await writer.drain()
+                # bounded: the server replies or closes, never hangs
+                await asyncio.wait_for(reader.read(), timeout=15.0)
+                writer.close()
+            client = await wire.WireClient.connect("127.0.0.1", port)
+            assert await client.ping()
+            await client.close()
+        finally:
+            await _stop(d, server)
+
+    run_async(main())
+
+
+def test_shm_attach_rejects_out_of_contract_geometry():
+    """The ring geometry arrives over the wire (HELLO_ACK): an attach
+    whose slots x slot_bytes overruns the mapped segment must refuse,
+    not hand out views past the buffer."""
+    from cs87project_msolano2_tpu.serve.shm import ShmRing
+
+    ring = ShmRing.create(slots=2, slot_bytes=64)
+    try:
+        with pytest.raises(ValueError):
+            ShmRing.attach(ring.name, slots=4, slot_bytes=64)
+        with pytest.raises(ValueError):
+            ShmRing.attach(ring.name, slots=0, slot_bytes=64)
+        peer = ShmRing.attach(ring.name, slots=2, slot_bytes=64)
+        peer.close()
+    finally:
+        ring.close()
+        ring.unlink()
