@@ -8,7 +8,8 @@ SHELL := /bin/bash
 
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
         bench-chaos serve-smoke serve-slo serve-mesh-smoke wire-smoke \
-        rfft-smoke precision-smoke apps-smoke multichip-smoke \
+        rfft-smoke precision-smoke apps-smoke bluestein-smoke \
+        multichip-smoke \
         obs-live-smoke replicate run-experiments \
         run-experiments-and-analyze-results analyze analyze-datasets \
         analyze-smoke check check-stats lint
@@ -322,6 +323,78 @@ apps-smoke:
 	  apps corr --smoke
 	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.cli \
 	  apps solve --smoke
+
+# the CI any-length check (docs/PLANS.md, "Arbitrary n"): (1) parity
+# vs numpy across the variant matrix — primes (7 via the mixedradix
+# matmul, 127 and 8191 via Rader), composites (720 and 3072
+# mixed-radix, 999 Bluestein) and n=2, forward AND inverse, c2c AND
+# r2c/c2r — with the static router's variant choices asserted; (2)
+# the bench smoke with the obs meter armed — the conv_np* row's
+# METERED pifft_hbm_bytes_total delta at the cheapest mixed-radix
+# conv length must sit STRICTLY below the pad-to-pow2 control's
+# charge at next_pow2 of the same linear length (the pad-to-pow2 tax,
+# enforced from the meter, not the formula that feeds it); (3) an
+# injected CAPACITY fault at the anylen site must walk a non-pow2
+# plan PAST the pow2-only kernel rungs (their feasibility probes
+# refuse) to the jnp-fft escape with degraded:true and the demotion
+# recorded — results stay numpy-correct on the rung; (4) n=1000 c2c
+# + r2c requests served over the real socket protocol on a
+# mixed-radix PLAN (not a degrade rung), numpy parity asserted
+bluestein-smoke:
+	set -o pipefail; \
+	PIFFT_PLAN_CACHE=off python3 -c "import numpy as np; \
+	from cs87project_msolano2_tpu import plans; \
+	from cs87project_msolano2_tpu.models.real import rfft_planes_fast, irfft_planes_fast; \
+	rng = np.random.default_rng(0); \
+	ns = (2, 7, 127, 720, 999, 3072, 8191); \
+	rel = lambda got, ref: float(np.max(np.abs(got - ref)) / np.max(np.abs(ref))); \
+	asc = lambda t: np.asarray(t[0]) + 1j * np.asarray(t[1]); \
+	errs = {}; vars_ = {}; \
+	[(errs.__setitem__(('c2c', n), rel(asc(y), ref)), \
+	  errs.__setitem__(('ic2c', n), rel(asc(p.execute_inverse(np.asarray(y[0]), np.asarray(y[1]))), xr + 1j * xi)), \
+	  vars_.__setitem__(n, p.variant)) \
+	 for n in ns \
+	 for xr in [rng.standard_normal(n).astype(np.float32)] \
+	 for xi in [rng.standard_normal(n).astype(np.float32)] \
+	 for p in [plans.plan(n, layout='natural')] \
+	 for y in [p.execute(xr, xi)] \
+	 for ref in [np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128))]]; \
+	[(errs.__setitem__(('r2c', n), rel(asc(h), np.fft.rfft(x.astype(np.float64)))), \
+	  errs.__setitem__(('c2r', n), rel(np.asarray(irfft_planes_fast(np.asarray(h[0]), np.asarray(h[1]), n=n)), x.astype(np.float64)))) \
+	 for n in ns \
+	 for x in [rng.standard_normal(n).astype(np.float32)] \
+	 for h in [rfft_planes_fast(x)]]; \
+	bad = {k: e for k, e in errs.items() if e > (1e-4 if k[0] in ('ic2c', 'c2r') else 1e-5)}; \
+	assert not bad, bad; \
+	assert vars_[127] == 'rader' and vars_[8191] == 'rader', vars_; \
+	assert vars_[7] == vars_[720] == vars_[3072] == 'mixedradix', vars_; \
+	assert vars_[999] == 'bluestein', vars_; \
+	print('# anylen parity ok: ' + ', '.join('n=%d %s %.1e' % (n, vars_.get(n, 'ladder'), errs[('c2c', n)]) for n in ns) + ' (fwd+inv, c2c+r2c)')" && \
+	PIFFT_PLAN_CACHE=off python3 bench.py --smoke \
+	  --events /tmp/pifft-anylen-events.jsonl \
+	  | tee /tmp/pifft-anylen-smoke.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-anylen-smoke.json')); \
+	  got = r['conv_np768_hbm_bytes']; ctrl = r['conv_np768_pow2_hbm_bytes']; \
+	  assert got < ctrl, (got, ctrl); \
+	  assert r['conv_np768_parity_relerr'] <= 1e-5, r; \
+	  print('# anylen bytes gate ok: metered conv at n=768 moves %d B, pad-to-pow2 control %d B (%.0f%% tax gone, parity %.1e)' \
+	        % (got, ctrl, 100.0 * (1 - got / ctrl), r['conv_np768_parity_relerr']))" && \
+	PIFFT_PLAN_CACHE=off PIFFT_FAULT=anylen:capacity:1.0 \
+	  python3 -c "import numpy as np; \
+	from cs87project_msolano2_tpu import plans; \
+	rng = np.random.default_rng(0); n = 999; \
+	xr = rng.standard_normal(n).astype(np.float32); \
+	xi = rng.standard_normal(n).astype(np.float32); \
+	p = plans.plan(n, layout='natural'); \
+	y = p.execute(xr, xi); \
+	ref = np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128)); \
+	err = float(np.max(np.abs(np.asarray(y[0]) + 1j * np.asarray(y[1]) - ref)) / np.max(np.abs(ref))); \
+	assert p.degraded, 'walk never tagged the plan degraded'; \
+	assert p.demotions and p.demotions[-1]['to'] == 'jnp-fft', p.demotions; \
+	assert err <= 1e-5, err; \
+	print('# anylen degrade ok: injected capacity fault walked %s -> jnp-fft at n=%d, degraded tagged, parity %.1e' \
+	      % (p.demotions[-1]['from'], n, err))" && \
+	PIFFT_PLAN_CACHE=off python3 -m cs87project_msolano2_tpu.serve.anylen_smoke
 
 # the CI multichip check (docs/MULTICHIP.md): the four sharding
 # dryruns on a forced 8-device CPU host platform (incl. the asserted

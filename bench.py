@@ -470,6 +470,89 @@ def measure_conv_row(logn: int, smoke: bool = False) -> dict:
     return out
 
 
+def measure_conv_np_row(smoke: bool = False) -> dict:
+    """The any-length fftconv row (docs/PLANS.md "Arbitrary n"): the
+    fused circular-conv pipeline at the NON-pow2 transform length
+    `cheapest_length` actually picks for a 3·2^18-sample signal
+    (3·2^8 in smoke), beside the pad-to-pow2 control's metered
+    charge at next_pow2 of the same linear length.  The
+    bluestein-smoke bytes gate asserts `{tag}_hbm_bytes` is
+    STRICTLY below `{tag}_pow2_hbm_bytes` — the pad-to-pow2 tax,
+    read FROM THE METER, not from the formula that feeds it.
+    Smoke rows record parity vs the numpy oracle at the mixed-radix
+    length."""
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.apps.spectral import (
+        _fused_circular,
+        cheapest_length,
+        kernel_spectrum,
+        numpy_oracle,
+    )
+    from cs87project_msolano2_tpu.ops.anylen import next_pow2
+    from cs87project_msolano2_tpu.resilience import classify, maybe_fault
+    from cs87project_msolano2_tpu.utils.roofline import (
+        charge_spectral_traffic,
+        spectral_roofline_utilization,
+    )
+
+    import jax.numpy as jnp
+
+    # signal sized so the linear length la+lv-1 lands exactly on
+    # 3·2^k: cheapest_length keeps it (odd part 3), the pow2 control
+    # must pad 33% further to 2^(k+2)
+    lv = 129
+    la = (3 * (1 << 8) if smoke else 3 * (1 << 18)) - (lv - 1)
+    nn = cheapest_length(la + lv - 1)
+    pow2_n = next_pow2(la + lv - 1)
+    tag = f"conv_np{nn}"
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(nn).astype(np.float32)
+    k = rng.standard_normal(lv).astype(np.float32)
+    try:
+        kr, ki = kernel_spectrum(k, nn)
+        fused = _fused_circular("conv", nn, None)
+        xp = jnp.asarray(x)
+
+        def run_cell():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(fused, xp, kr, ki) if smoke else \
+                _timed_op_ms(fused, xp, kr, ki)
+
+        ms = _retry(run_cell, smoke=smoke, label=f"conv_np n={nn}")
+    except Exception as e:
+        plans.warn(f"conv_np {nn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return {}
+    out = {f"{tag}_ms": round(ms, 4),
+           f"{tag}_gflops": round(
+               2 * 2.5 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 1),
+           f"{tag}_op": "conv"}
+    _, hbm = _metered_hbm_delta(
+        lambda: charge_spectral_traffic("conv", nn))
+    # the pad-to-pow2 control: what the SAME op would have charged
+    # at next_pow2 — the tax this row exists to show is gone
+    _, hbm_pow2 = _metered_hbm_delta(
+        lambda: charge_spectral_traffic("conv", pow2_n))
+    if hbm:
+        out[f"{tag}_hbm_bytes"] = hbm
+    if hbm_pow2:
+        out[f"{tag}_pow2_hbm_bytes"] = hbm_pow2
+    key = plans.make_key(nn, layout="natural", domain="r2c")
+    util = spectral_roofline_utilization("conv", nn, ms,
+                                         key.device_kind)
+    if util is not None:
+        out[f"{tag}_roofline_util"] = round(util, 3)
+    if smoke:
+        y = np.asarray(fused(xp, kr, ki))
+        ref = numpy_oracle("conv", x.astype(np.float64),
+                           np.pad(k, (0, nn - k.shape[0]))
+                           .astype(np.float64), nn)
+        out[f"{tag}_parity_relerr"] = float(
+            np.max(np.abs(y - ref)) / np.max(np.abs(ref)))
+    return out
+
+
 def measure_os_row(logn: int, smoke: bool = False) -> dict:
     """One overlap-save streaming-convolution row (docs/APPS.md): a
     signal 4x the block convolved through ONE cached plan pair at
@@ -684,7 +767,10 @@ def _phase_probe(n: int) -> None:
         return
     from cs87project_msolano2_tpu.models.pi_fft import pi_fft_pi_layout
 
+    # the probe kernel is pi-layout (pow2-only): round any-length
+    # cell ns (conv_np*) down to the nearest power of two
     pn = min(n, 1 << 12)
+    pn = 1 << (pn.bit_length() - 1)
     rng = np.random.default_rng(0)
     xr = rng.standard_normal(pn).astype(np.float32)
     xi = rng.standard_normal(pn).astype(np.float32)
@@ -1162,6 +1248,13 @@ def main(argv=None) -> int:
                           lambda logn=logn: measure_os_row(
                               logn, smoke=args.smoke),
                           probe_n=1 << logn))
+    # the any-length conv row (docs/PLANS.md "Arbitrary n"): fused
+    # circular conv at the non-pow2 length cheapest_length picks,
+    # with the pad-to-pow2 control's metered charge beside it — the
+    # bluestein-smoke bytes gate reads both columns off this row
+    large.update(cell("conv_np",
+                      lambda: measure_conv_np_row(smoke=args.smoke),
+                      probe_n=3 * (1 << (8 if args.smoke else 18))))
     if args.smoke:
         # the interpret-safe sixstep cell (docs/KERNELS.md): rides only
         # in smoke mode — on hardware the 2^25..2^27 rows above exercise

@@ -367,6 +367,14 @@ def load_bench_rounds(paths) -> list:
 
 _LOGN_METRIC = re.compile(r"^n2\^(\d+)_")
 _RFFT_METRIC = re.compile(r"^rfft2\^(\d+)_")
+#: exact-n row prefixes (docs/PLANS.md "Arbitrary n"): non-pow2 cells
+#: carry the exact length (``n1000_``, ``rfft1000_``, ``conv_np768_``)
+#: — the ``n2^K`` forms above stay for pow2 cells, so every committed
+#: round parses unchanged.  NOTE the pow2 patterns cannot collide with
+#: these: ``n2^13_`` fails ``^n(\d+)_`` on the ``^`` character.
+_EXACTN_METRIC = re.compile(r"^n(\d+)_")
+_RFFT_EXACTN_METRIC = re.compile(r"^rfft(\d+)_")
+_OP_EXACTN_METRIC = re.compile(r"^(conv|corr|solve|os)_np(\d+)_")
 #: precision-mode row prefixes (docs/PRECISION.md): bench emits one
 #: row set per raced storage mode beside the split3 cells — the mode
 #: rides the metric name exactly as the domain does for rfft rows
@@ -426,6 +434,16 @@ def bench_samples(rnd: BenchRound) -> list:
             if m is not None:
                 domain = "r2c"
         n = (1 << int(m.group(1))) if m else None
+        if n is None:
+            # exact-n (non-pow2) cells — docs/PLANS.md "Arbitrary n"
+            em = _EXACTN_METRIC.match(name)
+            if em is None:
+                em = _RFFT_EXACTN_METRIC.match(name)
+                if em is not None:
+                    domain = "r2c"
+            if em is not None:
+                m = em
+                n = int(em.group(1))
         if m is None:
             pm = _PRECISION_METRIC.match(name)
             if pm is not None:
@@ -437,6 +455,12 @@ def bench_samples(rnd: BenchRound) -> list:
                 op = _OP_PREFIX[om.group(1)]
                 domain = "r2c"  # the ops ride the half-spectrum path
                 n = 1 << int(om.group(2))
+            else:
+                om = _OP_EXACTN_METRIC.match(name)
+                if om is not None:
+                    op = _OP_PREFIX[om.group(1)]
+                    domain = "r2c"
+                    n = int(om.group(2))
         values = val if isinstance(val, list) else [val]
         for rep, v in enumerate(values):
             out.append(Sample(

@@ -128,7 +128,10 @@ def _fusion_gate_problems() -> list:
         rng = np.random.default_rng(1)
         x = rng.standard_normal((1 << 12) - 32).astype(np.float32)
         k = rng.standard_normal(33).astype(np.float32)
-        n_pad = 1 << 12  # next_pow2(len(x) + len(k) - 1)
+        n_pad = 1 << 12  # cheapest_length(len(x) + len(k) - 1): the
+        # lengths are chosen so the sum is exactly 2^12 — both the
+        # fused path and the next-pow2 unfused control land on the
+        # same n, and the gate compares like with like
 
         def delta(fn):
             before = metrics.counter_value("pifft_hbm_bytes_total")

@@ -68,6 +68,34 @@ def next_pow2(v: int) -> int:
     return n
 
 
+#: odd parts the conv length chooser considers: 2^a * odd for these
+#: odd factors all have cheap mixed-radix plans (one small-matmul
+#: four-step split — ops.anylen), so the chooser can land well under
+#: the next power of two without ever picking a chirp-padded length
+_CHEAP_ODD_PARTS = (1, 3, 5, 9, 15)
+
+
+def cheapest_length(v: int) -> int:
+    """The cheapest feasible transform length >= v for the linear
+    conv/corr pipeline — the end of the pad-to-pow2 tax (docs/APPS.md):
+    spectral traffic scales linearly with n, so the cheapest length is
+    simply the SMALLEST even n >= v whose plan is efficient.  With the
+    any-length ladder that is the smallest ``odd * 2^a`` over the
+    mixed-radix-cheap odd parts — at v = 3*2^18 + 1 the old
+    ``next_pow2`` paid 2^20 (a 1.33x tax in bytes and time); this
+    picks 5*2^16 = 327680 (1.25x denser coverage caps the worst-case
+    tax at ~12.5%, odd part 9 vs 8).  Power-of-two v returns v
+    unchanged, so every existing pow2 call site is untouched."""
+    best = next_pow2(v)
+    for odd in _CHEAP_ODD_PARTS[1:]:
+        m = odd * 2  # even, so the r2c pack trick always applies
+        while m < v:
+            m *= 2
+        if m < best:
+            best = m
+    return best
+
+
 def _mul_half_spectrum(ar, ai, br, bi, conj: bool):
     """(a · b) or (a · conj(b)) on split half-spectrum planes."""
     if conj:
@@ -316,19 +344,19 @@ def circular_conv(x, k, op: str = "conv",
                   precision: Optional[str] = None,
                   n: Optional[int] = None) -> np.ndarray:
     """Circular convolution (or correlation, ``op="corr"``) of real
-    `x` with real `k` at length ``n`` (default: len(x), which must
-    then be an even power of two) — the fused served primitive.  The
-    kernel spectrum comes from the cache; the half-spectrum product
-    never leaves the device."""
+    `x` with real `k` at ANY length ``n >= 2`` (default: len(x)) —
+    the fused served primitive.  Non-pow2 lengths ride the any-length
+    plan ladder (docs/PLANS.md "Arbitrary n") through the same fused
+    pipeline.  The kernel spectrum comes from the cache; the
+    half-spectrum product never leaves the device."""
     if op not in ("conv", "corr"):
         raise ValueError(f"circular_conv serves conv/corr, not {op!r}")
     x = np.ascontiguousarray(np.asarray(x, np.float32))
     if x.ndim != 1:
         raise ValueError(f"signal must be 1-D, got shape {x.shape}")
     n = int(n) if n is not None else x.shape[0]
-    if n < 2 or n & (n - 1):
-        raise ValueError(f"circular length n={n} must be a power of "
-                         f"two >= 2 (the plan ladder's domain)")
+    if n < 2:
+        raise ValueError(f"circular length n={n} must be >= 2")
     if x.shape[0] > n:
         raise ValueError(f"signal of {x.shape[0]} exceeds n={n}")
     kr, ki = kernel_spectrum(k, n, precision)
@@ -372,13 +400,15 @@ def fftconv(x, k, mode: str = "full",
             precision: Optional[str] = None) -> np.ndarray:
     """Linear convolution of real 1-D `x` with real 1-D `k` via the
     fused spectral pipeline — ``numpy.convolve(x, k, mode)`` parity,
-    at O(n log n): pad to the next power of two >= len(x)+len(k)-1,
-    run the fused circular core (one cached kernel transform, the
-    pointwise multiply on device), slice the mode window."""
+    at O(n log n): pad to the CHEAPEST feasible length >=
+    len(x)+len(k)-1 (cheapest_length — not next-pow2; the any-length
+    ladder killed that tax), run the fused circular core (one cached
+    kernel transform, the pointwise multiply on device), slice the
+    mode window."""
     x = np.asarray(x, np.float32)
     k = np.asarray(k, np.float32)
     la, lv = x.shape[-1], k.shape[-1]
-    n = next_pow2(la + lv - 1)
+    n = cheapest_length(la + lv - 1)
     full = circular_conv(x, k, "conv", precision, n)[: la + lv - 1]
     return _mode_slice(full, la, lv, mode, "conv")
 
@@ -393,7 +423,7 @@ def fftcorr(x, k, mode: str = "full",
     x = np.asarray(x, np.float32)
     k = np.asarray(k, np.float32)
     la, lv = x.shape[-1], k.shape[-1]
-    n = next_pow2(la + lv - 1)
+    n = cheapest_length(la + lv - 1)
     circ = circular_conv(x, k, "corr", precision, n)
     # full output lag t - (lv-1), t = 0..la+lv-2: negative lags wrap
     full = np.concatenate([circ[n - (lv - 1):], circ[:la]]) \
@@ -433,7 +463,10 @@ def fftconv_unfused(x, k, mode: str = "full",
     transforms — exactly the anti-pattern the fused path exists to
     kill, charged honestly as one extra spectrum round trip so the
     metered delta EXCEEDS the fused floor and the gate discriminates.
-    Never serve this; it exists so the gate has a failing side."""
+    It also deliberately keeps the OLD next-pow2 padding, so it
+    doubles as the pad-to-pow2 control for the bluestein-smoke bytes
+    gate (the bench ``conv_np*`` row) at non-pow2 signal lengths.
+    Never serve this; it exists so the gates have a failing side."""
     from ..models.real import irfft_planes_fast, rfft_planes_fast
 
     x = np.asarray(x, np.float32)

@@ -67,15 +67,25 @@ def chunk_count(n: int, m: int, block: int) -> int:
 
 def block_candidates(m: int, n: Optional[int] = None) -> list:
     """The raced block-size ladder for an m-tap kernel: powers of two
-    from the smallest useful block (>= 2·(m-1), so at least half of
-    every transform is new samples) up to MAX_BLOCK — truncated to one
-    size past the whole padded signal when `n` is known (a block
-    bigger than the signal is a single-chunk transform; racing ten of
-    them is pure waste)."""
-    lo = next_pow2(max(2 * (m - 1), 2))
+    AND the 3·2^j mixed sizes between them (the any-length ladder
+    serves those as one-level mixed-radix plans — docs/PLANS.md
+    "Arbitrary n" — so the block race is no longer locked to octave
+    steps; a half-octave 1.5·2^j block can win where the pow2 above
+    wastes overlap and the one below multiplies chunks), from the
+    smallest useful block (>= 2·(m-1), so at least half of every
+    transform is new samples) up to MAX_BLOCK — truncated one size
+    past the whole padded signal when `n` is known (a block bigger
+    than the signal is a single-chunk transform; racing ten of them
+    is pure waste)."""
+    lo = max(2 * (m - 1), 2)
     cands = []
-    b = lo
+    b = next_pow2(lo)
     while b <= MAX_BLOCK:
+        half = 3 * b // 4  # 1.5x the previous pow2: 3*2^(j-2)
+        if lo <= half < b and half % 2 == 0:
+            cands.append(half)
+            if n is not None and half >= n + m - 1:
+                break
         cands.append(b)
         if n is not None and b >= n + m - 1:
             break
@@ -185,9 +195,11 @@ class OverlapSave:
         self.m = self.k.shape[0]
         self.block = int(block) if block is not None \
             else choose_block(self.m)
-        if self.block < 2 or self.block & (self.block - 1):
-            raise ValueError(f"block={self.block} must be a power of "
-                             f"two >= 2 (the plan ladder's domain)")
+        if self.block < 2 or self.block % 2:
+            raise ValueError(f"block={self.block} must be an even "
+                             f"length >= 2 (the r2c pack trick needs "
+                             f"the even/odd split; any even length is "
+                             f"a ladder plan — docs/PLANS.md)")
         if self.block < self.m:
             raise ValueError(f"block={self.block} < kernel length "
                              f"{self.m}: no valid outputs per chunk")
@@ -297,8 +309,8 @@ def overlap_add(x, k, block: Optional[int] = None,
     k = np.ascontiguousarray(np.asarray(k, np.float32))
     m = k.shape[0]
     block = int(block) if block is not None else choose_block(m)
-    if block < 2 or block & (block - 1):
-        raise ValueError(f"block={block} must be a power of two >= 2")
+    if block < 2 or block % 2:
+        raise ValueError(f"block={block} must be an even length >= 2")
     step = block - (m - 1)
     if step < 1:
         raise ValueError(f"block={block} < kernel length {m}")

@@ -1030,8 +1030,13 @@ class PlanKeyFieldCoverage(Rule):
                  "different compiled programs alias one cache entry")
     default_config = {
         "exempt": ("*plans/core.py",),
+        # "domain" joined the identity when the real paths landed and
+        # became load-bearing with the any-length ladder (an r2c and a
+        # c2c plan at the same non-pow2 n dispatch DIFFERENT variants
+        # — docs/PLANS.md "Arbitrary n"); a defaulted domain would
+        # alias them onto one cache entry
         "fields": ("device_kind", "n", "batch", "layout", "dtype",
-                   "precision"),
+                   "precision", "domain"),
     }
 
     def check(self, ctx: FileContext, config: dict) -> Iterator:

@@ -131,7 +131,9 @@ def irfft_executor(c2c_fn, n: int):
 def rfft(x, precision: str | None = None, plan=None):
     """1-D real-input DFT over the trailing axis: real in, the n//2+1
     leading (non-redundant) complex bins out — ``numpy.fft.rfft``
-    semantics on the plan ladder.  `n` must be a power of two >= 2.
+    semantics on the plan ladder.  Any n >= 2 is served: even n rides
+    the packed half-length c2c trick below; odd n a direct any-length
+    plan (docs/PLANS.md, "Arbitrary n").
 
     Dispatches through a ``domain="r2c"`` plan (docs/REAL.md): the
     packed c2c transform at n/2 runs whatever variant the ladder
@@ -159,7 +161,9 @@ def rfft(x, precision: str | None = None, plan=None):
 def irfft(x, precision: str | None = None, plan=None):
     """Inverse of :func:`rfft`: n//2+1 half-spectrum bins in, the
     length-n real signal out (``numpy.fft.irfft`` semantics; n is
-    inferred as 2·(bins-1) and must be a power of two >= 2)."""
+    inferred as 2·(bins-1) — even by construction; pass `n` to
+    :func:`irfft_planes_fast` (or pin an explicit c2r `plan`) to
+    recover an odd-length signal)."""
     x = jnp.asarray(x)
     if not jnp.iscomplexobj(x):
         x = x.astype(jnp.complex64)

@@ -1,0 +1,519 @@
+"""Any-length transforms on the plan ladder: Bluestein, Rader, and
+mixed-radix as first-class plan variants (docs/PLANS.md, "Arbitrary n").
+
+The kernel family speaks powers of two; these three variants make the
+WHOLE pipeline — autotuner, plan cache, domains, precision budgets,
+degrade chain, roofline meter — speak any n >= 2, with zero new Pallas
+kernels:
+
+* ``bluestein`` — the chirp-z identity (Bluestein 1970).  With
+  ``b[t] = exp(-i*pi*t^2/n)``:
+
+      X[k] = b[k] * sum_j (x[j]*b[j]) * conj(b[k-j])
+
+  i.e. ONE circular convolution at any padded length ``pad >= 2n-1``,
+  which is exactly the fused-conv core the apps layer already ships —
+  one padded power-of-two (or mixed) c2c SUBPLAN, chirp pre/post
+  multiplies on device, and the chirp-kernel spectrum cached per
+  (n, pad, domain, precision) with the PR-14 kernel-spectrum-cache
+  discipline (LRU bound, hit/miss counter).  Works for every n; the
+  fallback the other two variants race against.
+
+* ``rader`` — prime n (Rader 1968): the n-1 nonzero-index outputs are
+  a length-(n-1) CYCLIC convolution of the input permuted by a
+  primitive root g, so a prime transform rides the same padded-
+  convolution machinery at n-1.  The permutations and the kernel
+  spectrum are host-precomputed tables (float64 trig, like every
+  twiddle table — trig error never rides the kernel's error budget).
+
+* ``mixedradix`` — composite n = m * 2^a with odd m: the classic
+  four-step split.  Reshape to (m, 2^a); DFT the odd axis by one
+  m x m matmul (host-built DFT matrix — MXU food, m is small);
+  twiddle; then ONE BATCHED power-of-two subplan over the 2^a axis —
+  the whole existing ladder serves the even part.  The cheapest
+  variant when the odd part is small (n = 1000 = 8 * 125 pays a
+  125-point matmul plus 125 batched 8-point FFTs, not a 2048-point
+  Bluestein pad).
+
+Padded-size policy (:func:`pad_candidates`): the smallest FEASIBLE
+pads >= 2n-1 — the nearest power of two plus the nearest 3*2^j and
+5*2^j mixed sizes where those are smaller — cheapest first, raced by
+the autotuner exactly like tile/cb/tail.  A mixed pad's own subplan
+routes back through ``mixedradix`` (odd part 3 or 5), never through
+Bluestein again, so the recursion is one level deep by construction.
+
+Everything here is expressed on split float32 planes over the trailing
+axis and is batch-generic and traceable end to end: the subtransforms
+go through ``plans.get_plan`` on their own keys, so they inherit
+tuned winners, the plan cache, and the degradation chain.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+#: largest odd factor the mixedradix m x m DFT matmul will take on —
+#: above it the O(m^2) matrix work loses to a Bluestein pad
+MIXEDRADIX_MAX_ODD = 512
+
+#: primes above this take the Rader cyclic-convolution path; smaller
+#: primes are cheaper as a bare mixedradix DFT matmul (m = n, a = 0)
+RADER_MIN_N = 64
+
+ANYLEN_VARIANTS = ("bluestein", "rader", "mixedradix")
+
+
+def is_pow2(n: int) -> bool:
+    return n >= 1 and not (n & (n - 1))
+
+
+def next_pow2(v: int) -> int:
+    n = 2
+    while n < v:
+        n *= 2
+    return n
+
+
+def odd_split(n: int) -> tuple:
+    """(a, m) with n = m * 2^a and m odd."""
+    a = 0
+    while n % 2 == 0:
+        n //= 2
+        a += 1
+    return a, n
+
+
+def is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    if n < 4:
+        return True
+    if n % 2 == 0:
+        return False
+    f = 3
+    while f * f <= n:
+        if n % f == 0:
+            return False
+        f += 2
+    return True
+
+
+def primitive_root(p: int) -> int:
+    """Smallest primitive root of an odd prime p (host-side, once per
+    plan build — trial over the prime factors of p-1)."""
+    factors = set()
+    m = p - 1
+    f = 2
+    while f * f <= m:
+        while m % f == 0:
+            factors.add(f)
+            m //= f
+        f += 1
+    if m > 1:
+        factors.add(m)
+    for g in range(2, p):
+        if all(pow(g, (p - 1) // q, p) != 1 for q in factors):
+            return g
+    raise ValueError(f"no primitive root for p={p} (not an odd prime?)")
+
+
+def pad_candidates(n: int) -> list:
+    """The padded-convolution lengths raced for an n-point chirp (or
+    an n-point cyclic Rader convolution): every candidate is >= 2n-1
+    (linear-in-circular feasibility), even, and FEASIBLE on the ladder
+    — a power of two, or a 3*2^j / 5*2^j mixed size whose own subplan
+    is a one-level mixedradix split.  Cheapest (fewest bytes) first;
+    never more than three entries; never worse than the naive
+    next-pow2 pad (which is always in the list)."""
+    lo = max(2 * n - 1, 2)
+    p2 = next_pow2(lo)
+    cands = {p2}
+    for odd in (3, 5):
+        m = odd * 2  # keep mixed pads even (the conv rides r2c-style
+        while m < lo:  # machinery in apps; even also halves cleanly)
+            m *= 2
+        if m < p2:
+            cands.add(m)
+    return sorted(cands)
+
+
+def default_pad(n: int) -> int:
+    """The offline/static pad choice: the cheapest feasible candidate
+    (the race may still prefer another on real hardware)."""
+    return pad_candidates(n)[0]
+
+
+def plan_variant(n: int) -> str:
+    """The static-default any-length variant for a non-pow2 n:
+    ``rader`` for large primes, ``mixedradix`` while the odd factor
+    stays matmul-sized, ``bluestein`` for everything else (large odd
+    composites)."""
+    if is_pow2(n):
+        raise ValueError(f"n={n} is a power of two — the kernel ladder "
+                         f"serves it directly")
+    if n > RADER_MIN_N and is_prime(n):
+        return "rader"
+    _, m = odd_split(n)
+    if m <= MIXEDRADIX_MAX_ODD:
+        return "mixedradix"
+    return "bluestein"
+
+
+# --------------------------------------------- chirp-spectrum cache
+#
+# The PR-14 kernel-spectrum-cache discipline (apps/spectral.py): the
+# host-built convolution-kernel spectra (Bluestein chirp / Rader root
+# table) are pure functions of (n, pad, domain, precision) — cache
+# them LRU-bounded with a hit/miss counter, so repeated plan builds
+# and cache-evicted re-builds pay numpy trig once, not per build.
+
+_CHIRP_LOCK = threading.Lock()
+_CHIRP_CACHE: dict = {}
+
+#: bound on cached chirp/root spectra (mirrors KSPEC_CACHE_MAX): past
+#: it the least-recently-used entry is evicted (hits re-append)
+CHIRP_CACHE_MAX = 64
+
+
+def _cached_tables(key: tuple, build: Callable) -> tuple:
+    from ..obs import metrics
+
+    with _CHIRP_LOCK:
+        hit = _CHIRP_CACHE.pop(key, None)
+        if hit is not None:
+            _CHIRP_CACHE[key] = hit  # re-append: LRU recency
+    if hit is not None:
+        metrics.inc("pifft_anylen_chirp_cache_total", result="hit")
+        return hit
+    metrics.inc("pifft_anylen_chirp_cache_total", result="miss")
+    val = build()
+    with _CHIRP_LOCK:
+        _CHIRP_CACHE[key] = val
+        while len(_CHIRP_CACHE) > CHIRP_CACHE_MAX:
+            _CHIRP_CACHE.pop(next(iter(_CHIRP_CACHE)))
+    return val
+
+
+def chirp_cache_clear() -> None:
+    """Drop the cached chirp/root spectra (tests, memory pressure)."""
+    with _CHIRP_LOCK:
+        _CHIRP_CACHE.clear()
+
+
+def _circ_kernel_spectrum(lags: np.ndarray, pad: int) -> np.ndarray:
+    """FFT (float64, host) of a convolution kernel embedded circularly
+    at `pad`.  `lags` has length 2L-1, laid out [lag 0..L-1, then lag
+    -(L-1)..-1]: the positive lags land at h[0:L], the negative lag -t
+    wraps to h[pad-t].  pad >= 2L-1 keeps the two halves disjoint, so
+    a linear conv at `pad` reproduces the length-L circular conv on
+    its first L outputs."""
+    L = (lags.shape[0] + 1) // 2
+    h = np.zeros(pad, np.complex128)
+    h[:L] = lags[:L]
+    if L > 1:
+        h[pad - (L - 1):] = lags[L:]
+    return np.fft.fft(h)
+
+
+def bluestein_tables(n: int, pad: int,
+                     precision: Optional[str] = None) -> tuple:
+    """(br, bi, Hr, Hi) device planes for an n-point chirp transform
+    at pad >= 2n-1: the chirp ``b[t] = exp(-i*pi*t^2/n)`` (float64
+    trig on ``t^2 mod 2n`` so the angle never loses bits at large n)
+    and the padded spectrum of its conjugate kernel.  Cached per
+    (n, pad, domain, precision) — the plan-cache identity axes the
+    spectra may legally depend on (precision pins the storage the
+    subplan serves; the tables themselves stay float32)."""
+    if pad < 2 * n - 1:
+        raise ValueError(f"bluestein pad {pad} < 2n-1 = {2 * n - 1}")
+
+    def build():
+        t = np.arange(n, dtype=np.int64)
+        ang = np.pi * ((t * t) % (2 * n)).astype(np.float64) / float(n)
+        b = np.cos(ang) - 1j * np.sin(ang)          # exp(-i*pi*t^2/n)
+        h = np.conj(b)                               # kernel, symmetric
+        full = np.concatenate([h, h[1:][::-1]])      # lags 0.. , -(n-1)..
+        H = _circ_kernel_spectrum(full, pad)
+        return (jnp.asarray(b.real.astype(np.float32)),
+                jnp.asarray((-np.sin(ang)).astype(np.float32)),
+                jnp.asarray(H.real.astype(np.float32)),
+                jnp.asarray(H.imag.astype(np.float32)))
+
+    return _cached_tables(("bluestein", n, pad, "c2c",
+                           precision or "split3"), build)
+
+
+def rader_tables(p: int, pad: int,
+                 precision: Optional[str] = None) -> tuple:
+    """(perm_in, src, Hr, Hi) for a prime-p Rader transform whose
+    length-(p-1) cyclic convolution rides a padded transform at
+    ``pad >= 2(p-1)-1``: the primitive-root input permutation, the
+    output gather (conv index serving each nonzero bin), and the
+    padded spectrum of the root-of-unity kernel
+    ``bq[q] = exp(-2*pi*i*g^{-q}/p)``.  Cached like the chirp."""
+    L = p - 1
+    if pad < 2 * L - 1:
+        raise ValueError(f"rader pad {pad} < 2(p-1)-1 = {2 * L - 1}")
+
+    def build():
+        g = primitive_root(p)
+        g_inv = pow(g, p - 2, p)
+        perm_in = np.array([pow(g, q, p) for q in range(L)], np.int32)
+        dlog = np.zeros(p, np.int64)
+        for q in range(L):
+            dlog[pow(g, q, p)] = q
+        # X[k] (k >= 1) = x[0] + C[m] with g^{-m} = k, i.e.
+        # m = -dlog[k] mod L — src[k-1] gathers the conv output into
+        # natural bin order
+        src = np.array([(L - dlog[k]) % L for k in range(1, p)],
+                       np.int32)
+        q = np.arange(L, dtype=np.int64)
+        roots = np.array([pow(g_inv, int(m), p) for m in q], np.int64)
+        ang = 2.0 * np.pi * roots.astype(np.float64) / float(p)
+        bq = np.cos(ang) - 1j * np.sin(ang)
+        # cyclic period L: the negative-lag tail [-(L-1)..-1] wraps to
+        # bq[(L-t) mod L] = bq[1], bq[2], .., bq[L-1] in layout order
+        full = np.concatenate([bq, bq[1:]])
+        H = _circ_kernel_spectrum(full, pad)
+        return (jnp.asarray(perm_in), jnp.asarray(src),
+                jnp.asarray(H.real.astype(np.float32)),
+                jnp.asarray(H.imag.astype(np.float32)))
+
+    return _cached_tables(("rader", p, pad, "c2c",
+                           precision or "split3"), build)
+
+
+def mixedradix_tables(n: int, m: int, n2: int) -> tuple:
+    """(Dr, Di, Tr, Ti): the m x m odd-axis DFT matrix and the
+    (m, n2) inter-axis twiddles of the four-step split n = m * n2 —
+    float64 trig, cast once (the ops.twiddle discipline)."""
+
+    def build():
+        j1 = np.arange(m, dtype=np.float64)
+        ang = 2.0 * np.pi * np.outer(j1, j1) / float(m)
+        k1 = np.arange(m, dtype=np.float64)
+        j2 = np.arange(n2, dtype=np.float64)
+        tang = 2.0 * np.pi * np.outer(k1, j2) / float(n)
+        return (jnp.asarray(np.cos(ang).astype(np.float32)),
+                jnp.asarray((-np.sin(ang)).astype(np.float32)),
+                jnp.asarray(np.cos(tang).astype(np.float32)),
+                jnp.asarray((-np.sin(tang)).astype(np.float32)))
+
+    return _cached_tables(("mixedradix", n, m, n2), build)
+
+
+# ----------------------------------------------------- sub-executors
+
+
+def _sub_executor(key, n: int, batch_extra: tuple,
+                  mode: Optional[str]) -> Callable:
+    """The (xr, xi) -> (yr, yi) forward c2c executor for an internal
+    transform at `n` over the key's batch (plus `batch_extra` leading
+    dims), resolved through the plan subsystem — tuned winners, cache,
+    and degrade chain included.  Natural order (the pre/post passes
+    index naturally)."""
+    import dataclasses
+
+    from .. import plans
+
+    sub = dataclasses.replace(key, n=n,
+                              batch=tuple(key.batch) + batch_extra,
+                              layout="natural", domain="c2c",
+                              precision=mode or key.precision)
+    return plans.get_plan(sub).fn
+
+
+def _padded_conv(sub_fn: Callable, pad: int, inv_pad):
+    """(ar, ai, Hr, Hi) -> circular conv planes at `pad` through ONE
+    forward subplan: FFT, pointwise multiply by the cached kernel
+    spectrum, inverse via the conj trick on the SAME executor — the
+    rung/variant serving the forward serves the inverse too."""
+
+    def run(ar, ai, hr, hi):
+        fr, fi = sub_fn(ar, ai)
+        yr = fr * hr - fi * hi
+        yi = fr * hi + fi * hr
+        wr, wi = sub_fn(yr, -yi)
+        return wr * inv_pad, -wi * inv_pad
+
+    return run
+
+
+def _pad_to(xr, xi, pad: int):
+    w = pad - xr.shape[-1]
+    cfg = [(0, 0)] * (xr.ndim - 1) + [(0, w)]
+    return jnp.pad(xr, cfg), jnp.pad(xi, cfg)
+
+
+# ------------------------------------------------------ c2c executors
+
+
+def bluestein_executor(key, params: dict) -> Callable:
+    """The chirp-z c2c executor for any-n `key`: chirp pre-multiply,
+    one padded circular convolution (one pow2/mixed subplan, cached
+    chirp spectrum), chirp post-multiply, slice to n.  Batch-generic
+    over leading dims; traceable end to end."""
+    n = key.n
+    mode = params.get("precision") or key.precision
+    pad = int(params.get("pad") or default_pad(n))
+    if pad < 2 * n - 1:
+        raise ValueError(f"bluestein pad {pad} < 2n-1 = {2 * n - 1} "
+                         f"for n={n}")
+    br, bi, hr, hi = bluestein_tables(n, pad, mode)
+    sub_fn = _sub_executor(key, pad, (), mode)
+    conv = _padded_conv(sub_fn, pad, np.float32(1.0 / pad))
+    from ..resilience.inject import maybe_fault
+
+    def run(xr, xi):
+        maybe_fault("anylen")  # resilience injection site
+        ar = xr * br - xi * bi
+        ai = xr * bi + xi * br
+        ar, ai = _pad_to(ar, ai, pad)
+        wr, wi = conv(ar, ai, hr, hi)
+        wr, wi = wr[..., :n], wi[..., :n]
+        return wr * br - wi * bi, wr * bi + wi * br
+
+    return run
+
+
+def rader_executor(key, params: dict) -> Callable:
+    """The prime-n Rader c2c executor: permute by the primitive root,
+    one length-(n-1) cyclic convolution on the padded machinery,
+    gather back to natural bin order (DC bin served directly as the
+    input sum)."""
+    p = key.n
+    if not is_prime(p) or p < 3:
+        raise ValueError(f"rader serves odd primes; n={p} is not one")
+    mode = params.get("precision") or key.precision
+    L = p - 1
+    pad = int(params.get("pad") or default_pad(L))
+    perm_in, src, hr, hi = rader_tables(p, pad, mode)
+    sub_fn = _sub_executor(key, pad, (), mode)
+    conv = _padded_conv(sub_fn, pad, np.float32(1.0 / pad))
+    from ..resilience.inject import maybe_fault
+
+    def run(xr, xi):
+        maybe_fault("anylen")  # resilience injection site
+        ar = jnp.take(xr, perm_in, axis=-1)
+        ai = jnp.take(xi, perm_in, axis=-1)
+        ar, ai = _pad_to(ar, ai, pad)
+        cr, ci = conv(ar, ai, hr, hi)
+        tr = xr[..., :1] + jnp.take(cr[..., :L], src, axis=-1)
+        ti = xi[..., :1] + jnp.take(ci[..., :L], src, axis=-1)
+        dc_r = jnp.sum(xr, axis=-1, keepdims=True)
+        dc_i = jnp.sum(xi, axis=-1, keepdims=True)
+        return (jnp.concatenate([dc_r, tr], axis=-1),
+                jnp.concatenate([dc_i, ti], axis=-1))
+
+    return run
+
+
+def mixedradix_executor(key, params: dict) -> Callable:
+    """The four-step composite-n executor for n = m * 2^a (odd m):
+    odd-axis DFT by matmul, twiddle, one BATCHED pow2 subplan over the
+    even axis, index-merge.  The even part inherits the whole existing
+    ladder at its own (n=2^a, batch=batch+(m,)) key."""
+    n = key.n
+    a, m = odd_split(n)
+    if m == 1:
+        raise ValueError(f"n={n} is a power of two — not a mixedradix "
+                         f"shape")
+    if m > MIXEDRADIX_MAX_ODD:
+        raise ValueError(f"mixedradix odd factor m={m} exceeds "
+                         f"{MIXEDRADIX_MAX_ODD} — use bluestein")
+    n2 = 1 << a
+    mode = params.get("precision") or key.precision
+    dr, di, tr, ti = mixedradix_tables(n, m, n2)
+    sub_fn = _sub_executor(key, n2, (m,), mode) if n2 > 1 else None
+    from ..resilience.inject import maybe_fault
+
+    def run(xr, xi):
+        maybe_fault("anylen")  # resilience injection site
+        batch = xr.shape[:-1]
+        ar = xr.reshape(batch + (m, n2))
+        ai = xi.reshape(batch + (m, n2))
+        # odd-axis DFT: B[k1, j2] = sum_j1 D[k1, j1] * A[j1, j2]
+        br = jnp.einsum("kj,...jt->...kt", dr, ar) \
+            - jnp.einsum("kj,...jt->...kt", di, ai)
+        bi = jnp.einsum("kj,...jt->...kt", dr, ai) \
+            + jnp.einsum("kj,...jt->...kt", di, ar)
+        # twiddle: C[k1, j2] = B[k1, j2] * W_n^{j2*k1}
+        cr = br * tr - bi * ti
+        ci = br * ti + bi * tr
+        if sub_fn is not None:
+            cr, ci = sub_fn(cr, ci)
+        # X[k1 + m*k2] = D[k1, k2]: flat index k2*m + k1
+        yr = jnp.swapaxes(cr, -1, -2).reshape(batch + (n,))
+        yi = jnp.swapaxes(ci, -1, -2).reshape(batch + (n,))
+        return yr, yi
+
+    return run
+
+
+# ----------------------------------------------- odd-n real executors
+
+
+def rfft_odd_executor(key, variant: str, params: dict) -> Callable:
+    """The odd-n r2c executor (docs/REAL.md): the pack trick needs an
+    even/odd split, so odd n runs the DIRECT any-length c2c at n on
+    the real planes and keeps the n//2+1 leading bins — still half
+    the output traffic, one full-length transform of work."""
+    c2c = build_anylen_executor(key, variant, params, _force_c2c=True)
+    bins = key.n // 2 + 1
+
+    def run(xr, xi):
+        del xi  # real by declaration (domain="r2c")
+        yr, yi = c2c(xr, jnp.zeros_like(xr))
+        return yr[..., :bins], yi[..., :bins]
+
+    return run
+
+
+def irfft_odd_executor(key, variant: str, params: dict) -> Callable:
+    """The odd-n c2r executor: rebuild the full Hermitian spectrum
+    from the n//2+1 stored bins (X[n-k] = conj(X[k])), one inverse
+    any-length c2c at n via the conj trick, take the real plane."""
+    c2c = build_anylen_executor(key, variant, params, _force_c2c=True)
+    n = key.n
+    inv_n = np.float32(1.0 / n)
+
+    def run(xr, xi):
+        mr = xr[..., 1:][..., ::-1]
+        mi = xi[..., 1:][..., ::-1]
+        fr = jnp.concatenate([xr, mr], axis=-1)
+        fi = jnp.concatenate([xi, -mi], axis=-1)
+        wr, wi = c2c(fr, -fi)  # IFFT_n = conj(FFT_n(conj(X))) / n
+        yr = wr * inv_n
+        return yr, jnp.zeros_like(yr)
+
+    return run
+
+
+def build_anylen_executor(key, variant: str, params: dict,
+                          _force_c2c: bool = False) -> Callable:
+    """Ladder dispatch for the any-length variants (called from
+    ``plans.ladder.build_executor``).  Raises ValueError for
+    statically infeasible combinations — the tuner records those as
+    rejections, the degrade walker moves on."""
+    if key.layout != "natural":
+        raise ValueError(
+            f"variant {variant!r} produces natural order only (pi "
+            f"order is per-transform bit reversal — power-of-two n)")
+    if not _force_c2c and key.domain != "c2c":
+        # only odd n lands here (even real domains ride the half-
+        # length c2c sub-key — plans.ladder.c2c_subkey)
+        if key.domain == "r2c":
+            return rfft_odd_executor(key, variant, params)
+        return irfft_odd_executor(key, variant, params)
+    if variant == "bluestein":
+        return bluestein_executor(key, params)
+    if variant == "rader":
+        return rader_executor(key, params)
+    if variant == "mixedradix":
+        return mixedradix_executor(key, params)
+    raise ValueError(f"unknown any-length variant {variant!r}")

@@ -88,13 +88,16 @@ def get_plan(key: PlanKey) -> Plan:
     # it here would kill the opt-in for the rest of the process
     if hit is not None and not (opt_in and hit.source == "static"):
         return hit
-    if key.domain != "c2c":
-        # the real domains RIDE the c2c plan at n/2 (docs/REAL.md):
-        # resolve that key through this same path — a tuned/cached c2c
-        # winner (or the opted-in tune, which then benefits every c2c
-        # caller too) carries straight over, with the pack/Hermitian
-        # wrapping added by the ladder's executor builder.  ms is NOT
-        # copied: the inner timing is not the real path's timing.
+    if key.domain != "c2c" and key.n % 2 == 0:
+        # the EVEN-n real domains RIDE the c2c plan at n/2
+        # (docs/REAL.md): resolve that key through this same path — a
+        # tuned/cached c2c winner (or the opted-in tune, which then
+        # benefits every c2c caller too) carries straight over, with
+        # the pack/Hermitian wrapping added by the ladder's executor
+        # builder.  ms is NOT copied: the inner timing is not the real
+        # path's timing.  ODD n has no pack split: those keys resolve
+        # like c2c below (the any-length ladder serves them directly —
+        # docs/PLANS.md "Arbitrary n").
         from . import ladder
 
         inner = get_plan(ladder.c2c_subkey(key))

@@ -41,8 +41,14 @@ DOMAINS = ("c2c", "r2c", "c2r")
 # carry a per-candidate precision override — a v2 store's winners were
 # raced under the old semantics, so its tokens are refused by
 # from_token and skipped-with-ONE-warn by the disk store loader, never
-# silently served)
-SCHEMA_VERSION = 3
+# silently served; schema 4 made n ANY int >= 1: the any-length
+# variants (bluestein/rader/mixedradix, docs/PLANS.md "Arbitrary n")
+# joined the ladder, real domains accept odd n via the direct chirp
+# path, and tuned params may carry a raced ``pad`` — a v3 store never
+# held non-pow2 keys, but its pow2 winners were raced without the
+# any-length entries in the field, so the same refuse-and-warn-once
+# policy applies)
+SCHEMA_VERSION = 4
 
 
 def warn(msg: str) -> None:
@@ -114,9 +120,9 @@ class PlanKey:
     domain: "c2c" (complex-to-complex), "r2c" (real forward: real
     planes of length n in, half-spectrum planes of length n//2+1 out),
     or "c2r" (the inverse: half-spectrum in, real signal of length n
-    out).  The real domains require natural layout and even n — the
-    half-spectrum has no pi order, and the pack trick needs an
-    even/odd split (docs/REAL.md).
+    out).  The real domains require natural layout (the half-spectrum
+    has no pi order); EVEN n rides the c2c plan at n/2 via the pack
+    trick, ODD n takes the direct any-length path (docs/REAL.md).
     """
 
     device_kind: str
@@ -135,6 +141,13 @@ class PlanKey:
                 f"precision={self.precision!r} not in {PRECISIONS}")
         if self.n < 1:
             raise ValueError(f"n={self.n} must be positive")
+        if self.layout == "pi" and (self.n & (self.n - 1)):
+            # pi order IS per-transform bit reversal — it has no
+            # definition at a non-power-of-two n (the any-length
+            # variants produce natural order only, docs/PLANS.md)
+            raise ValueError(
+                f"layout='pi' requires a power-of-two n (bit-reversed "
+                f"order is undefined otherwise), got n={self.n}")
         if self.domain not in DOMAINS:
             raise ValueError(f"domain={self.domain!r} not in {DOMAINS}")
         if self.domain != "c2c":
@@ -142,11 +155,10 @@ class PlanKey:
                 raise ValueError(
                     f"domain={self.domain!r} requires natural layout "
                     f"(the half-spectrum has no pi order)")
-            if self.n % 2:
+            if self.n < 2:
                 raise ValueError(
-                    f"domain={self.domain!r} requires even n (the "
-                    f"pack-two-halves trick splits even/odd samples), "
-                    f"got n={self.n}")
+                    f"domain={self.domain!r} requires n >= 2, got "
+                    f"n={self.n}")
 
     def input_shape(self) -> tuple:
         """The float-plane shape this key's executor consumes: the

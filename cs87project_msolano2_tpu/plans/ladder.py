@@ -91,11 +91,13 @@ def _pow2(n: int) -> bool:
 
 
 def c2c_subkey(key: PlanKey) -> PlanKey:
-    """The half-length natural-order c2c key an r2c/c2r key rides
-    (docs/REAL.md): the pack trick turns a length-n real transform
-    into ONE c2c transform at n/2, so candidates, static defaults,
-    and executors for the real domains all delegate here — the real
-    path inherits the whole ladder with zero new kernels."""
+    """The half-length natural-order c2c key an EVEN-n r2c/c2r key
+    rides (docs/REAL.md): the pack trick turns a length-n real
+    transform into ONE c2c transform at n/2, so candidates, static
+    defaults, and executors for the even real domains all delegate
+    here — the real path inherits the whole ladder with zero new
+    kernels.  ODD n has no even/odd split: those keys take the direct
+    any-length path (ops.anylen) and never call this."""
     import dataclasses
 
     return dataclasses.replace(key, n=key.n // 2, layout="natural",
@@ -218,10 +220,19 @@ def candidates(key: PlanKey) -> list:
     pinned in ``params["precision"]`` — expected winner (the narrow
     storage, half the bytes on a memory-bound family) first — so the
     tuner measures storage against variant/tile/cb in ONE race and the
-    cache persists whichever precision actually won."""
-    if key.domain != "c2c":
+    cache persists whichever precision actually won.
+
+    NON-POWER-OF-TWO n races the any-length ladder (ops.anylen,
+    docs/PLANS.md "Arbitrary n"): the routed-best variant's entries
+    first (rader for large primes, mixedradix for small odd factors),
+    then the Bluestein entries across the 2-3 nearest feasible pads —
+    the padded size is itself a raced axis, exactly like tile/cb."""
+    if key.domain != "c2c" and key.n % 2 == 0:
         return candidates(c2c_subkey(key))
-    cands = _base_candidates(key)
+    if key.domain != "c2c":
+        cands = _anylen_candidates(key)  # odd-n real: direct path
+    else:
+        cands = _base_candidates(key)
     from ..ops.precision import race_modes
 
     modes = race_modes(key.precision)
@@ -231,9 +242,34 @@ def candidates(key: PlanKey) -> list:
     return cands
 
 
+def _anylen_candidates(key: PlanKey) -> list:
+    """The any-length race for a non-pow2 key: the statically routed
+    variant leads (its pad choices cheapest-bytes first), the chirp
+    entries always ride so the race can catch a routing miss — every
+    entry's subtransform resolves through the ladder recursively
+    (pads have odd part 1/3/5, so recursion is one level deep)."""
+    from ..ops import anylen
+
+    if key.layout != "natural":
+        return []
+    n = key.n
+    best = anylen.plan_variant(n)
+    cands = []
+    if best == "rader":
+        cands += [("rader", {"pad": p})
+                  for p in anylen.pad_candidates(n - 1)]
+    elif best == "mixedradix":
+        cands.append(("mixedradix", {}))
+    cands += [("bluestein", {"pad": p})
+              for p in anylen.pad_candidates(n)]
+    return cands
+
+
 def _base_candidates(key: PlanKey) -> list:
     """The variant/parameter race for a c2c key, before the precision
     axis is expanded (see candidates)."""
+    if not _pow2(key.n):
+        return _anylen_candidates(key)
     cands = []
     if _rows_eligible(key):
         # tail=128 measured best for short rows (the S=2 tail's strided
@@ -272,11 +308,27 @@ def static_default(key: PlanKey):
     """Measured-good (variant, params) used when no tuned/cached plan
     exists — the ONLY source offline mode serves.  Mirrors the dispatch
     the library shipped before the plan layer, so un-tuned behavior is
-    never worse than it was.  Real-domain keys take the half-length
-    c2c sub-key's default — the variant namespace is shared, and
-    build_executor adds the pack/Hermitian wrapping."""
-    if key.domain != "c2c":
+    never worse than it was.  EVEN-n real-domain keys take the
+    half-length c2c sub-key's default — the variant namespace is
+    shared, and build_executor adds the pack/Hermitian wrapping; odd
+    real n and every non-pow2 c2c n route to the any-length ladder
+    (ops.anylen.plan_variant picks rader/mixedradix/bluestein, the
+    cheapest feasible pad is the static pad choice)."""
+    if key.domain != "c2c" and key.n % 2 == 0:
         return static_default(c2c_subkey(key))
+    if not _pow2(key.n):
+        if key.layout != "natural":
+            raise ValueError(
+                f"layout='pi' requires a power-of-two n (bit-reversed "
+                f"order is undefined otherwise), got n={key.n}")
+        from ..ops import anylen
+
+        best = anylen.plan_variant(key.n)
+        if best == "rader":
+            return "rader", {"pad": anylen.default_pad(key.n - 1)}
+        if best == "mixedradix":
+            return "mixedradix", {}
+        return "bluestein", {"pad": anylen.default_pad(key.n)}
     natural = key.layout == "natural"
     # NOTE: precision="fp32" takes the SAME dispatch as every other
     # mode — it used to dead-end on the jnp stage path (refusing every
@@ -360,7 +412,17 @@ def build_executor(key: PlanKey, variant: str, params: dict):
     pinned one (precision is a raced axis — see candidates), else the
     key's mode; it resolves through the sanctioned site into the
     MXU-tail precision AND the plane/table storage dtype
-    (docs/PRECISION.md — bf16 storage is the bytes-halving notch)."""
+    (docs/PRECISION.md — bf16 storage is the bytes-halving notch).
+
+    Any-length variants (bluestein/rader/mixedradix) build in
+    ops.anylen around their own ladder-resolved subplans; odd-n real
+    keys take the DIRECT any-length real executors there (no even/odd
+    pack exists), even-n real keys wrap the half-length c2c executor
+    as before — n=1000 r2c rides a mixedradix c2c at 500."""
+    if key.domain != "c2c" and key.n % 2:
+        from ..ops import anylen
+
+        return anylen.build_anylen_executor(key, variant, params)
     if key.domain != "c2c":
         from ..models import real as real_mod
 
@@ -368,6 +430,10 @@ def build_executor(key: PlanKey, variant: str, params: dict):
         if key.domain == "r2c":
             return real_mod.rfft_executor(inner, key.n)
         return real_mod.irfft_executor(inner, key.n)
+    if variant in ("bluestein", "rader", "mixedradix"):
+        from ..ops import anylen
+
+        return anylen.build_anylen_executor(key, variant, params)
     natural = key.layout == "natural"
     n = key.n
     mode = params.get("precision") or key.precision

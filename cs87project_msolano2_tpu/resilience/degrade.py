@@ -88,6 +88,12 @@ def _rungs_after(variant: str) -> tuple:
         return DEGRADE_CHAIN
     if variant == "two-kernel":
         return DEGRADE_CHAIN[2:]
+    if variant in ("bluestein", "rader", "mixedradix"):
+        # the any-length variants (docs/PLANS.md "Arbitrary n") skip
+        # the kernel rungs — fourstep/rql are power-of-two paths and
+        # the plan's n is not — and land on the escapes, which speak
+        # any n natively (jnp.fft/numpy.fft are mixed-radix engines)
+        return DEGRADE_CHAIN[2:]
     if variant == "jnp":
         return DEGRADE_CHAIN[3:]
     return DEGRADE_CHAIN[1:]
@@ -119,10 +125,17 @@ def build_rung(key, rung: str) -> Callable:
     traffic)."""
     real_domain = getattr(key, "domain", "c2c") != "c2c"
     inner_n = key.n // 2 if real_domain else key.n
+    pow2 = key.n >= 1 and not (key.n & (key.n - 1))
 
     if rung == "fourstep":
         from ..plans import ladder
 
+        if not pow2:
+            # per-rung feasibility probe (docs/PLANS.md "Arbitrary
+            # n"): the kernel rungs are power-of-two paths — a
+            # demoting any-length plan walks past them to the escapes
+            raise ValueError(f"fourstep rung requires power-of-two n, "
+                             f"got n={key.n}")
         if key.batch != ():
             raise ValueError("fourstep rung is a 1-D whole-transform "
                              "path")
@@ -141,6 +154,9 @@ def build_rung(key, rung: str) -> Callable:
     if rung == "rql":
         from ..plans import ladder
 
+        if not pow2:
+            raise ValueError(f"rql rung requires power-of-two n, got "
+                             f"n={key.n}")
         if key.batch != ():
             raise ValueError("rql rung is a 1-D whole-transform path")
         return ladder.build_executor(key, "rql", dict(_RQL_PARAMS))

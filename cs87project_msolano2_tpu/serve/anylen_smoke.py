@@ -1,0 +1,145 @@
+"""The any-length serving smoke (docs/PLANS.md, "Arbitrary n"):
+prove on THIS machine that the serve front door answers a NON-power-
+of-two length with a real plan, not a degrade rung.
+
+Run by ``make bluestein-smoke``:
+
+    python -m cs87project_msolano2_tpu.serve.anylen_smoke
+
+n=1000 c2c and r2c requests travel the real wire (JSON dialect over a
+loopback socket) through the real dispatcher — warm path, coalescing
+batcher, the lot — and every reply must carry
+
+* numpy parity within the split3 error budget,
+* a ``plan_variant`` from the any-length ladder (n=1000 = 8·125
+  routes to ``mixedradix``) — NOT ``jnp-fft``/``numpy-ref``,
+* ``degraded: false`` with an empty degrade trail.
+
+Exit 0 only when every assertion holds — the serving leg of the
+bluestein-smoke CI gate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+import numpy as np
+
+#: the served non-pow2 length (= 8 · 125: odd part 125 <= 512, so the
+#: static router picks the mixed-radix variant)
+N = 1000
+
+#: split3 relative-error budget (utils/errors.py) — the served
+#: precision here
+TOL = 1e-5
+
+#: the any-length plan variants (ops/anylen.py); a reply naming
+#: anything else either fell to a degrade rung or took a path this
+#: smoke does not cover
+ANYLEN_VARIANTS = ("bluestein", "rader", "mixedradix")
+
+
+def _relerr(got: np.ndarray, ref: np.ndarray) -> float:
+    return float(np.max(np.abs(got - ref)) / np.max(np.abs(ref)))
+
+
+async def _run(problems: list) -> int:
+    from .dispatcher import Dispatcher, ServeConfig
+    from .protocol import handle_connection, request_over_socket
+    from .shapes import ShapeSpec
+
+    rng = np.random.default_rng(87)
+    specs = [ShapeSpec(n=N), ShapeSpec(n=N, domain="r2c")]
+    cfg = ServeConfig(max_wait_ms=2.0)
+    served = 0
+    async with Dispatcher(cfg, specs) as d:
+        server = await asyncio.start_server(
+            lambda r, w: handle_connection(d, r, w), "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            # --- c2c at n=1000: parity vs numpy, a plan not a rung
+            xr = rng.standard_normal(N).astype(np.float32)
+            xi = rng.standard_normal(N).astype(np.float32)
+            reply = await request_over_socket("127.0.0.1", port, xr, xi)
+            if not reply.get("ok"):
+                problems.append(f"c2c n={N} refused: "
+                                f"{reply.get('error')}")
+            else:
+                served += 1
+                ref = np.fft.fft(xr.astype(np.float64)
+                                 + 1j * xi.astype(np.float64))
+                got = (np.asarray(reply["yr"])
+                       + 1j * np.asarray(reply["yi"]))
+                err = _relerr(got, ref)
+                if err > TOL:
+                    problems.append(f"c2c n={N} parity {err:.2e} > "
+                                    f"{TOL:.0e}")
+                if reply.get("plan_variant") not in ANYLEN_VARIANTS:
+                    problems.append(
+                        f"c2c n={N} served by "
+                        f"{reply.get('plan_variant')!r} — want an "
+                        f"any-length plan {ANYLEN_VARIANTS}")
+                if reply.get("degraded"):
+                    problems.append(f"c2c n={N} tagged degraded "
+                                    f"({reply.get('degrade')})")
+
+            # --- r2c at n=1000: the even-n pack trick over the wire
+            xr = rng.standard_normal(N).astype(np.float32)
+            reply = await request_over_socket("127.0.0.1", port, xr,
+                                              domain="r2c")
+            if not reply.get("ok"):
+                problems.append(f"r2c n={N} refused: "
+                                f"{reply.get('error')}")
+            else:
+                served += 1
+                ref = np.fft.rfft(xr.astype(np.float64))
+                got = (np.asarray(reply["yr"])
+                       + 1j * np.asarray(reply["yi"]))
+                if got.shape[-1] != N // 2 + 1:
+                    problems.append(f"r2c n={N} returned "
+                                    f"{got.shape[-1]} bins, want "
+                                    f"{N // 2 + 1}")
+                else:
+                    err = _relerr(got, ref)
+                    if err > TOL:
+                        problems.append(f"r2c n={N} parity "
+                                        f"{err:.2e} > {TOL:.0e}")
+                if reply.get("plan_variant") not in ANYLEN_VARIANTS:
+                    problems.append(
+                        f"r2c n={N} served by "
+                        f"{reply.get('plan_variant')!r} — want an "
+                        f"any-length plan {ANYLEN_VARIANTS}")
+                if reply.get("degraded"):
+                    problems.append(f"r2c n={N} tagged degraded "
+                                    f"({reply.get('degrade')})")
+        finally:
+            server.close()
+            await server.wait_closed()
+    return served
+
+
+def main() -> int:
+    from .. import obs
+
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    problems: list = []
+    try:
+        served = asyncio.run(_run(problems))
+    finally:
+        if owned:
+            obs.disable()
+    for p in problems:
+        print(f"# FAIL: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"# anylen serve smoke ok: {served} non-pow2 (n={N}) "
+          f"requests served over the socket on a mixed-radix plan, "
+          f"numpy parity within {TOL:.0e}, zero degrade rungs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -372,8 +372,12 @@ class Dispatcher:
                 raise ServeError(f"request planes must be matching 1-D "
                                  f"arrays, got {xr.shape} / {xi.shape}")
             n = xr.shape[0]
-            if n < 2 or n & (n - 1):
-                raise ServeError(f"n={n} is not a power of two >= 2")
+            if n < 2 or n > shapes_mod.MAX_SERVED_N:
+                # ANY length in range is a plan (docs/PLANS.md
+                # "Arbitrary n") — refusal is for degenerate or
+                # memory-unbounded requests only
+                raise ServeError(f"n={n} must be 2 <= n <= "
+                                 f"{shapes_mod.MAX_SERVED_N}")
             group = GroupKey(n=n, layout=layout,
                              precision=precision or "split3",
                              inverse=False, domain="r2c", op=op)
@@ -394,10 +398,18 @@ class Dispatcher:
             n = 2 * (xr.shape[0] - 1)
         else:
             n = xr.shape[0]
-        if n < 2 or n & (n - 1):
-            raise ServeError(f"n={n} is not a power of two >= 2"
-                             + (" (c2r planes must carry n//2+1 bins)"
+        if n < 2 or n > shapes_mod.MAX_SERVED_N:
+            # any length in range is a plan (docs/PLANS.md "Arbitrary
+            # n"); note a c2r request's n is DECODED as 2*(bins-1), so
+            # the wire expresses even real lengths only
+            raise ServeError(f"n={n} must be 2 <= n <= "
+                             f"{shapes_mod.MAX_SERVED_N}"
+                             + (" (c2r planes carry n//2+1 bins)"
                                 if domain == "c2r" else ""))
+        if layout == "pi" and n & (n - 1):
+            raise ServeError(f"layout='pi' requires a power-of-two n "
+                             f"(bit-reversed order is undefined "
+                             f"otherwise), got n={n}")
         if inverse and layout != "natural":
             raise ServeError("inverse requires natural layout (the "
                              "conj-trick contract, plans.core)")

@@ -43,6 +43,18 @@ from .batcher import GroupKey
 from .dispatcher import Dispatcher, QueueFull, ServeError
 
 
+def shape_label(n: int, layout: str, op: str = "fft") -> str:
+    """The serve_load row's shape label: the familiar ``n2^K`` for
+    powers of two, the EXACT length (``n1000``) otherwise —
+    ``n.bit_length()-1`` silently mislabeled every non-pow2 n as the
+    pow2 below it (n=1000 as n2^9), which would have aliased any-length
+    rows onto pow2 rows in the analyze loader.  analyze/loader.py
+    parses both forms; committed pow2 rounds are unchanged."""
+    head = (f"n2^{n.bit_length() - 1}" if n >= 1 and not (n & (n - 1))
+            else f"n{n}")
+    return f"{head}:{layout}" + (f":{op}" if op != "fft" else "")
+
+
 def verify_response(n: int, layout: str, domain: str, inverse: bool,
                     precision: str, xr, xi, resp,
                     op: str = "fft") -> Optional[str]:
@@ -172,8 +184,7 @@ async def run_offered_load(dispatcher: Dispatcher, n: int, rps: float,
         return round(v * scale, 4) if v is not None else None
 
     return {
-        "shape": f"n2^{n.bit_length() - 1}:{layout}"
-                 + (f":{op}" if op != "fft" else ""),
+        "shape": shape_label(n, layout, op),
         "n": n,
         "op": op,
         "offered_rps": round(rps, 1),
@@ -605,10 +616,8 @@ async def run_wire_load(host: str, port: int, protocol_name: str,
 
     ns = sorted({s["n"] for s in specs})
     shape = ("mixed" if len(specs) > 1 else
-             f"n2^{specs[0]['n'].bit_length() - 1}"
-             f":{specs[0]['layout']}"
-             + (f":{specs[0]['op']}" if specs[0]["op"] != "fft"
-                else ""))
+             shape_label(specs[0]["n"], specs[0]["layout"],
+                         specs[0]["op"]))
     return {
         "shape": shape,
         "n": ns[-1],

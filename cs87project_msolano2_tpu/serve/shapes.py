@@ -34,6 +34,14 @@ import json
 
 from .. import plans
 
+#: hard admission cap on served transform lengths (front door AND
+#: shape files): any n >= 2 below this is a plan — power of two on
+#: the kernel ladder, everything else on the any-length ladder
+#: (docs/PLANS.md "Arbitrary n").  The cap bounds per-request device
+#: memory exactly like the batch buckets bound batch dims; an over-cap
+#: n is a structured refusal, never an OOM mid-plan.
+MAX_SERVED_N = 1 << 24
+
 
 @dataclasses.dataclass(frozen=True)
 class ShapeSpec:
@@ -57,9 +65,14 @@ class ShapeSpec:
     op: str = "fft"
 
     def __post_init__(self):
-        if self.n < 2 or self.n & (self.n - 1):
-            raise ValueError(f"served n={self.n} must be a power of two "
-                             f">= 2 (the plan ladder's domain)")
+        if self.n < 2 or self.n > MAX_SERVED_N:
+            raise ValueError(f"served n={self.n} must be 2 <= n <= "
+                             f"{MAX_SERVED_N} (any length in range is "
+                             f"a plan — docs/PLANS.md 'Arbitrary n')")
+        if self.layout == "pi" and self.n & (self.n - 1):
+            raise ValueError(f"layout='pi' requires a power-of-two n "
+                             f"(bit-reversed order is undefined "
+                             f"otherwise), got n={self.n}")
         from ..plans.core import DOMAINS
         from ..utils.roofline import SPECTRAL_OPS
 

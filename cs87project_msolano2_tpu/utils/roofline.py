@@ -61,6 +61,15 @@ PLAN_CARRY_PASSES = {
     "two-kernel": 1,
     "mf": 1,
     "sixstep": 2,       # outer carry + in-place sub-carry
+    # the any-length variants (docs/PLANS.md "Arbitrary n"): the
+    # chirp/Rader paths materialize the padded planes into and out of
+    # their internal convolution (two extra round trips at pad_n —
+    # charged pad-aware via fft_hbm_bytes(pad_n=...)); the four-step
+    # split materializes one (m, 2^a) intermediate between the matmul
+    # and the batched subtransform
+    "bluestein": 2,
+    "rader": 2,
+    "mixedradix": 1,
 }
 
 
@@ -69,6 +78,17 @@ def plan_carry_passes(variant: str) -> Optional[int]:
     rung), or None for paths whose traffic this model does not cover
     (the jnp/XLA/numpy fallbacks own their internal dataflow)."""
     return PLAN_CARRY_PASSES.get(variant)
+
+
+def _n_label(n: int) -> str:
+    """The gauge's n label: the familiar ``2^K`` for powers of two,
+    the EXACT length otherwise — ``n.bit_length()-1`` silently
+    mislabels n=1000 as 2^9, the same bug the loadgen shape labels
+    had (docs/PLANS.md "Arbitrary n")."""
+    n = max(n, 1)
+    if not (n & (n - 1)):
+        return f"2^{n.bit_length() - 1}"
+    return str(n)
 
 
 def hbm_peak_bytes_per_s(device_kind: str) -> Optional[float]:
@@ -108,16 +128,27 @@ def fft_min_hbm_bytes(n: int, domain: str = "c2c",
 
 
 def fft_hbm_bytes(n: int, carry_passes: int = 0,
-                  domain: str = "c2c", storage_bytes: int = 4) -> int:
+                  domain: str = "c2c", storage_bytes: int = 4,
+                  pad_n: Optional[int] = None) -> int:
     """The traffic an n-point transform with `carry_passes` materialized
     intermediates actually moves: the per-domain per-dtype floor plus
     one full write+read round trip of the planes per carry pass.  The
     carries ride the STORAGE dtype too (the fourstep/sixstep HBM
     carries are declared at it — ops/pallas_fft.py), so the bf16
     halving holds pass for pass, exactly like the r2c one.  This — not
-    the floor — is what the bytes-moved meter charges."""
+    the floor — is what the bytes-moved meter charges.
+
+    PAD-AWARE (docs/PLANS.md "Arbitrary n"): an any-length plan's
+    carries materialize at its internal PADDED length, not at n — a
+    Bluestein n=1000 at pad 2048 moves its two carry round trips over
+    2048-point planes while its I/O floor stays at 1000.  Pass the
+    plan's ``params["pad"]`` as `pad_n` and the carries are charged at
+    it; the floor — what any implementation must move — is ALWAYS at
+    the actual n, which is exactly how killing the pad-to-pow2 tax
+    shows up in `util_of_ceiling` and the metered bytes."""
+    carry_unit = fft_min_hbm_bytes(pad_n or n, domain, storage_bytes)
     return fft_min_hbm_bytes(n, domain, storage_bytes) \
-        * (1 + carry_passes)
+        + carry_passes * carry_unit
 
 
 # ---------------------------------------------------- spectral ops
@@ -211,8 +242,7 @@ def spectral_roofline_utilization(op: str, n: int, ms: float,
     util = spectral_min_hbm_bytes(op, n, storage_bytes) \
         / (ms * 1e-3) / peak
     metrics.set_gauge("pifft_roofline_util", util, op=op,
-                      n=f"2^{max(n, 1).bit_length() - 1}",
-                      storage=f"{storage_bytes}B")
+                      n=_n_label(n), storage=f"{storage_bytes}B")
     return util
 
 
@@ -229,13 +259,17 @@ def roofline_ceiling(carry_passes: Optional[int]) -> Optional[float]:
 def roofline_utilization(n: int, ms: float, device_kind: str,
                          carry_passes: int = 0,
                          domain: str = "c2c",
-                         storage_bytes: int = 4) -> Optional[float]:
+                         storage_bytes: int = 4,
+                         pad_n: Optional[int] = None) -> Optional[float]:
     """Achieved fraction of the HBM roofline for an n-point transform
     measured at `ms` per call, charging the minimum traffic of the
     transform's DOMAIN and STORAGE dtype (see fft_min_hbm_bytes — the
     real domains' floor is half the c2c one, bf16 storage half the
     fp32 one) so the figure reads against the 1/(1+p) ceiling of the
-    path's declared carry passes.  None when the device peak is
+    path's declared carry passes.  `pad_n` is an any-length plan's
+    internal padded length (``params["pad"]``): the meter then charges
+    the carries at the pad while the floor/utilization stay at the
+    actual n (see fft_hbm_bytes).  None when the device peak is
     unknown or the measurement is degenerate."""
     from ..obs import metrics
 
@@ -249,7 +283,7 @@ def roofline_utilization(n: int, ms: float, device_kind: str,
                     fft_min_hbm_bytes(n, domain, storage_bytes))
         metrics.inc("pifft_hbm_bytes_total",
                     fft_hbm_bytes(n, carry_passes, domain,
-                                  storage_bytes))
+                                  storage_bytes, pad_n))
     peak = hbm_peak_bytes_per_s(device_kind)
     if peak is None or ms is None or ms <= 0.0:
         return None
@@ -259,6 +293,5 @@ def roofline_utilization(n: int, ms: float, device_kind: str,
     # sibling's reading at the same {domain, n} — the same collision
     # the domain label resolved when r2c rows landed beside c2c
     metrics.set_gauge("pifft_roofline_util", util, domain=domain,
-                      n=f"2^{max(n, 1).bit_length() - 1}",
-                      storage=f"{storage_bytes}B")
+                      n=_n_label(n), storage=f"{storage_bytes}B")
     return util

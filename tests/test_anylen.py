@@ -1,0 +1,393 @@
+"""Any-length plan tests (docs/PLANS.md, "Arbitrary n") — all offline
+(CPU, tier-1-safe): pad-policy properties, static variant routing,
+numpy parity across the Bluestein/Rader/mixedradix matrix (forward +
+inverse, c2c + r2c/c2r, batched), the chirp-spectrum cache, the
+degrade walk past the pow2-only kernel rungs, schema-v4 key
+validation, the cheapest-length bytes property the fftconv gate rides,
+exact-n shape labels, and the serve front door at arbitrary n."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import plans
+from cs87project_msolano2_tpu.ops import anylen
+from cs87project_msolano2_tpu.plans import cache as plan_cache
+from cs87project_msolano2_tpu.plans import ladder
+from cs87project_msolano2_tpu.plans.core import (
+    SCHEMA_VERSION,
+    PlanKey,
+)
+
+#: split3 forward budget / looser roundtrip budget (two transforms)
+TOL = 1e-5
+RT_TOL = 1e-4
+
+
+@pytest.fixture(autouse=True)
+def fresh_memory_cache():
+    plan_cache.clear(memory=True, disk=False)
+    yield
+    plan_cache.clear(memory=True, disk=False)
+
+
+def _rel(got, ref):
+    return float(np.max(np.abs(got - ref)) / np.max(np.abs(ref)))
+
+
+def _planes(rng, n, batch=()):
+    return (rng.standard_normal(batch + (n,)).astype(np.float32),
+            rng.standard_normal(batch + (n,)).astype(np.float32))
+
+
+# ------------------------------------------------------ pad policy
+
+
+def test_pad_candidates_properties():
+    for n in (3, 5, 7, 63, 100, 127, 719, 720, 999, 1000, 4097, 8190):
+        cands = anylen.pad_candidates(n)
+        lo = max(2 * n - 1, 2)
+        naive = anylen.next_pow2(lo)
+        assert cands == sorted(cands)
+        assert 1 <= len(cands) <= 3
+        assert naive in cands  # the naive pad is always raced
+        for p in cands:
+            assert p >= lo  # linear-in-circular feasibility
+            assert p <= naive  # never worse than next-pow2
+            _, m = anylen.odd_split(p)
+            assert m in (1, 3, 5)  # one-level mixedradix subplans
+        assert anylen.default_pad(n) == cands[0]
+
+
+def test_plan_variant_routing():
+    assert anylen.plan_variant(127) == "rader"
+    assert anylen.plan_variant(8191) == "rader"
+    # primes at or below RADER_MIN_N are cheaper as a bare DFT matmul
+    assert anylen.plan_variant(7) == "mixedradix"
+    assert anylen.plan_variant(720) == "mixedradix"
+    assert anylen.plan_variant(1000) == "mixedradix"
+    assert anylen.plan_variant(3072) == "mixedradix"
+    # odd part 999 = 27*37 > MIXEDRADIX_MAX_ODD and composite
+    assert anylen.plan_variant(999) == "bluestein"
+    with pytest.raises(ValueError):
+        anylen.plan_variant(1024)
+
+
+def test_primitive_root_generates():
+    for p in (7, 127, 8191):
+        g = anylen.primitive_root(p)
+        seen = {pow(g, q, p) for q in range(p - 1)}
+        assert seen == set(range(1, p))
+
+
+# ------------------------------------------------- parity: the matrix
+
+
+@pytest.mark.parametrize("n", [2, 7, 127, 720, 999, 3072])
+def test_c2c_forward_and_inverse_parity(n):
+    rng = np.random.default_rng(n)
+    xr, xi = _planes(rng, n)
+    p = plans.plan(n, layout="natural")
+    yr, yi = p.execute(xr, xi)
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128))
+    assert _rel(np.asarray(yr) + 1j * np.asarray(yi), ref) <= TOL
+    ir, ii = p.execute_inverse(np.asarray(yr), np.asarray(yi))
+    assert _rel(np.asarray(ir) + 1j * np.asarray(ii),
+                xr + 1j * xi) <= RT_TOL
+    if n != 2:
+        assert p.variant == anylen.plan_variant(n)
+        assert not p.degraded
+
+
+def test_rader_large_prime_parity():
+    n = 8191  # Mersenne prime: the real Rader reach case
+    rng = np.random.default_rng(13)
+    xr, xi = _planes(rng, n)
+    p = plans.plan(n, layout="natural")
+    assert p.variant == "rader"
+    yr, yi = p.execute(xr, xi)
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128))
+    assert _rel(np.asarray(yr) + 1j * np.asarray(yi), ref) <= TOL
+
+
+@pytest.mark.parametrize("n", [7, 720, 999, 1000])
+def test_real_domain_parity(n):
+    from cs87project_msolano2_tpu.models.real import (
+        irfft_planes_fast,
+        rfft_planes_fast,
+    )
+
+    rng = np.random.default_rng(n)
+    x = rng.standard_normal(n).astype(np.float32)
+    hr, hi = rfft_planes_fast(x)
+    ref = np.fft.rfft(x.astype(np.float64))
+    assert hr.shape[-1] == n // 2 + 1
+    assert _rel(np.asarray(hr) + 1j * np.asarray(hi), ref) <= TOL
+    back = irfft_planes_fast(np.asarray(hr), np.asarray(hi), n=n)
+    assert _rel(np.asarray(back), x.astype(np.float64)) <= RT_TOL
+
+
+def test_batched_any_length():
+    n = 1000
+    rng = np.random.default_rng(5)
+    xr, xi = _planes(rng, n, batch=(3,))
+    p = plans.plan_for((3, n), layout="natural")
+    yr, yi = p.execute(xr, xi)
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128), axis=-1)
+    assert _rel(np.asarray(yr) + 1j * np.asarray(yi), ref) <= TOL
+
+
+def test_chirp_cache_hits():
+    from cs87project_msolano2_tpu import obs
+    from cs87project_msolano2_tpu.obs import metrics
+
+    anylen.chirp_cache_clear()
+    owned = not obs.enabled()
+    if owned:
+        obs.enable()
+    try:
+        anylen.bluestein_tables(999, 2048)
+        miss = metrics.counter_value("pifft_anylen_chirp_cache_total",
+                                     result="miss")
+        anylen.bluestein_tables(999, 2048)
+        hit = metrics.counter_value("pifft_anylen_chirp_cache_total",
+                                    result="hit")
+        assert miss >= 1 and hit >= 1
+    finally:
+        if owned:
+            obs.disable()
+
+
+# --------------------------------------------------- ladder routing
+
+
+def test_candidates_race_pads():
+    key = plans.make_key(999, layout="natural")
+    cands = ladder.candidates(key)
+    blu = [(v, p) for v, p in cands if v == "bluestein"]
+    assert blu, cands
+    assert {p["pad"] for _, p in blu} >= set(anylen.pad_candidates(999))
+    # every raced candidate for a non-pow2 key is an any-length
+    # variant (the precision race re-lists the same variants)
+    assert all(v in anylen.ANYLEN_VARIANTS for v, _ in cands), cands
+
+
+def test_static_default_variants():
+    for n, want in ((127, "rader"), (1000, "mixedradix"),
+                    (999, "bluestein")):
+        key = plans.make_key(n, layout="natural")
+        variant, params = ladder.static_default(key)
+        assert variant == want
+        if want == "rader":
+            assert params["pad"] == anylen.default_pad(n - 1)
+        if want == "bluestein":
+            assert params["pad"] == anylen.default_pad(n)
+
+
+# ------------------------------------------------ degrade + schema
+
+
+def test_anylen_degrade_walks_to_jnp():
+    from cs87project_msolano2_tpu.resilience.inject import inject
+
+    n = 999
+    rng = np.random.default_rng(7)
+    xr, xi = _planes(rng, n)
+    with inject("anylen", "capacity", prob=1.0):
+        p = plans.plan(n, layout="natural")
+        yr, yi = p.execute(xr, xi)
+    assert p.degraded
+    assert p.demotions[-1]["to"] == "jnp-fft"
+    # the pow2-only kernel rungs never claim to have served
+    assert all("fourstep" not in d["to"] and d["to"] != "rql"
+               for d in p.demotions)
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128))
+    assert _rel(np.asarray(yr) + 1j * np.asarray(yi), ref) <= TOL
+
+
+def test_any_n_key_token_round_trip():
+    key = PlanKey(device_kind="TPU test-kind", n=1000, batch=(3,),
+                  layout="natural", precision="split3")
+    tok = key.token()
+    assert f'"v":{SCHEMA_VERSION}' in tok.replace(" ", "")
+    assert PlanKey.from_token(tok) == key
+
+
+def test_old_schema_token_refused():
+    import json
+
+    key = PlanKey(device_kind="TPU test-kind", n=1000, batch=(),
+                  layout="natural", precision="split3")
+    d = json.loads(key.token())
+    d["v"] = SCHEMA_VERSION - 1
+    with pytest.raises(ValueError):
+        PlanKey.from_token(json.dumps(d))
+
+
+def test_pi_layout_still_requires_pow2():
+    with pytest.raises(ValueError):
+        PlanKey(device_kind="cpu", n=1000, batch=(), layout="pi",
+                precision="split3")
+    # pow2 pi keys are untouched
+    PlanKey(device_kind="cpu", n=1024, batch=(), layout="pi",
+            precision="split3")
+
+
+def test_real_domain_any_n_keys():
+    for n in (999, 1000):
+        PlanKey(device_kind="cpu", n=n, batch=(), layout="natural",
+                precision="split3", domain="r2c")
+    with pytest.raises(ValueError):
+        PlanKey(device_kind="cpu", n=1, batch=(), layout="natural",
+                precision="split3", domain="r2c")
+
+
+# --------------------------------------- cheapest_length + roofline
+
+
+def test_cheapest_length_properties():
+    from cs87project_msolano2_tpu.apps.spectral import (
+        _CHEAP_ODD_PARTS,
+        cheapest_length,
+    )
+
+    for v in (2, 100, 768, 896, 1000, 4097, 100000):
+        n = cheapest_length(v)
+        assert n >= v
+        assert n % 2 == 0
+        assert n <= anylen.next_pow2(v)
+        _, m = anylen.odd_split(n)
+        assert m in _CHEAP_ODD_PARTS
+    # identity on powers of two: the committed fusion gate's length
+    # (4096) must not move
+    for v in (2, 4096, 1 << 20):
+        assert cheapest_length(v) == v
+
+
+def test_spectral_bytes_never_worse_than_pow2():
+    from cs87project_msolano2_tpu.apps.spectral import cheapest_length
+    from cs87project_msolano2_tpu.utils.roofline import (
+        spectral_hbm_bytes,
+    )
+
+    for v in (896, 1000, 3 * (1 << 8), 100000):
+        cheap = spectral_hbm_bytes("conv", cheapest_length(v))
+        pow2 = spectral_hbm_bytes("conv", anylen.next_pow2(v))
+        assert cheap <= pow2
+    # the non-trivial case strictly wins
+    assert spectral_hbm_bytes("conv", cheapest_length(3 * (1 << 8))) \
+        < spectral_hbm_bytes("conv", anylen.next_pow2(3 * (1 << 8)))
+
+
+def test_fft_hbm_bytes_pad_aware():
+    from cs87project_msolano2_tpu.utils.roofline import fft_hbm_bytes
+
+    n, pad = 999, 2048
+    padded = fft_hbm_bytes(n, 2, pad_n=pad)
+    unpadded = fft_hbm_bytes(n, 2)
+    assert padded > unpadded  # carries charged at the pad length
+    assert fft_hbm_bytes(n, 0, pad_n=pad) == fft_hbm_bytes(n, 0)
+
+
+def test_fftconv_picks_cheap_length():
+    from cs87project_msolano2_tpu.apps.spectral import fftconv
+
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal(640).astype(np.float32)
+    v = rng.standard_normal(129).astype(np.float32)
+    got = np.asarray(fftconv(a, v))  # linear length 768 = 3*2^8
+    ref = np.convolve(a.astype(np.float64), v.astype(np.float64))
+    assert got.shape[0] == ref.shape[0]
+    assert _rel(got, ref) <= TOL
+
+
+# ------------------------------------------------- labels + loader
+
+
+def test_shape_label_exact_n():
+    from cs87project_msolano2_tpu.serve.loadgen import shape_label
+
+    assert shape_label(1024, "natural") == "n2^10:natural"
+    assert shape_label(1000, "natural") == "n1000:natural"
+    assert shape_label(1000, "natural", "conv") == "n1000:natural:conv"
+
+
+def test_loader_parses_exact_n_rows():
+    from cs87project_msolano2_tpu.analyze.loader import (
+        BenchRound,
+        Fingerprint,
+        bench_samples,
+    )
+
+    rnd = BenchRound(index=1, path="BENCH_r01.json",
+                     metrics={"n2^13_ms": 1.0, "n1000_ms": 2.0,
+                              "rfft1000_ms": 3.0, "conv_np768_ms": 4.0,
+                              "conv_np768_hbm_bytes": 5.0},
+                     fingerprint=Fingerprint())
+    by = {s.metric: s for s in bench_samples(rnd)}
+    assert by["n2^13_ms"].n == 1 << 13
+    assert by["n1000_ms"].n == 1000
+    assert by["n1000_ms"].domain == "c2c"
+    assert by["rfft1000_ms"].n == 1000
+    assert by["rfft1000_ms"].domain == "r2c"
+    assert by["conv_np768_ms"].n == 768
+    assert by["conv_np768_ms"].op == "conv"
+    assert by["conv_np768_hbm_bytes"].n == 768
+
+
+# ------------------------------------------------- serve front door
+
+
+def test_shape_spec_any_n():
+    from cs87project_msolano2_tpu.serve.shapes import (
+        MAX_SERVED_N,
+        ShapeSpec,
+    )
+
+    ShapeSpec(n=1000)
+    ShapeSpec(n=999, domain="r2c")
+    with pytest.raises(ValueError):
+        ShapeSpec(n=1)
+    with pytest.raises(ValueError):
+        ShapeSpec(n=MAX_SERVED_N + 1)
+    with pytest.raises(ValueError):
+        ShapeSpec(n=1000, layout="pi")
+    ShapeSpec(n=1024, layout="pi")
+
+
+def test_dispatcher_serves_non_pow2():
+    from cs87project_msolano2_tpu.serve import (
+        Dispatcher,
+        ServeConfig,
+        ServeError,
+    )
+
+    rng = np.random.default_rng(9)
+    n = 1000
+    xr, xi = _planes(rng, n)
+
+    async def run():
+        cfg = ServeConfig(max_wait_ms=1.0)
+        async with Dispatcher(cfg) as d:
+            resp = await d.submit(xr, xi)
+            bad = None
+            try:
+                await d.submit(np.zeros(1, np.float32),
+                               np.zeros(1, np.float32))
+            except ServeError as e:
+                bad = e
+            return resp, bad
+
+    resp, bad = asyncio.run(run())
+    assert resp.plan_variant in anylen.ANYLEN_VARIANTS
+    assert not resp.degraded
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128))
+    assert _rel(np.asarray(resp.yr) + 1j * np.asarray(resp.yi),
+                ref) <= TOL
+    assert bad is not None  # n=1 is a structured refusal

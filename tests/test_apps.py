@@ -108,10 +108,17 @@ class TestSpectral:
                            k.astype(np.float64), n)
         assert rel_err(got, ref) < TOL
 
-    def test_circular_refuses_non_pow2(self):
-        with pytest.raises(ValueError, match="power of two"):
-            circular_conv(np.zeros(100, np.float32),
-                          np.zeros(3, np.float32))
+    def test_circular_serves_any_length(self):
+        # non-pow2 lengths are first-class plans now (docs/PLANS.md,
+        # "Arbitrary n"); only degenerate n < 2 is refused
+        x = RNG.standard_normal(100).astype(np.float32)
+        k = RNG.standard_normal(3).astype(np.float32)
+        got = circular_conv(x, k)
+        ref = np.real(np.fft.ifft(np.fft.fft(x) * np.fft.fft(k, 100)))
+        assert rel_err(got, ref.astype(np.float32)) < TOL
+        with pytest.raises(ValueError, match="n=1 must be >= 2"):
+            circular_conv(np.zeros(1, np.float32),
+                          np.zeros(1, np.float32))
 
     def test_kernel_spectrum_cache_one_forward_transform(self,
                                                          obs_armed):
@@ -237,16 +244,40 @@ class TestOverlapSave:
         assert conv.chunks == chunk_count(1000, 17, 64)
 
     def test_block_validation(self):
-        with pytest.raises(ValueError, match="power of two"):
-            OverlapSave(self.KERNEL, block=100)
+        # odd blocks have no r2c pack split; any EVEN block is now a
+        # ladder plan (the any-length variants — docs/PLANS.md)
+        with pytest.raises(ValueError, match="even"):
+            OverlapSave(self.KERNEL, block=101)
         with pytest.raises(ValueError, match="kernel length"):
             OverlapSave(RNG.standard_normal(80).astype(np.float32),
                         block=64)
 
+    def test_block_mixed_radix_accepted(self):
+        """A 3*2^j block (the new half-octave candidates) streams
+        correctly through the fused chunk pipeline."""
+        x = RNG.standard_normal(1000).astype(np.float32)
+        conv = OverlapSave(self.KERNEL, block=96)
+        y = np.concatenate([conv.push(x), conv.flush()])
+        ref = np.convolve(x.astype(np.float64),
+                          self.KERNEL.astype(np.float64), "full")
+        assert rel_err(y, ref) < TOL
+
     def test_block_choice_model(self):
         m = 33
         cands = block_candidates(m)
-        assert all(b & (b - 1) == 0 for b in cands)
+        # pow2 and 3*2^j half-octave blocks, nothing else — and every
+        # candidate even (the r2c pack split) and deduplicated
+        odd_parts = set()
+        for b in cands:
+            assert b % 2 == 0
+            o = b
+            while o % 2 == 0:
+                o //= 2
+            odd_parts.add(o)
+        assert odd_parts <= {1, 3}
+        assert 3 in odd_parts, cands  # the mixed sizes are raced
+        assert len(set(cands)) == len(cands)
+        assert cands == sorted(cands)
         assert cands[0] >= 2 * (m - 1)
         best = choose_block(m)
         assert block_cost(best, m) == min(block_cost(b, m)

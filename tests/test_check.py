@@ -939,10 +939,25 @@ def test_pif401_fully_specified_and_kwargs_splat():
         from cs87project_msolano2_tpu.plans import PlanKey
 
         a = PlanKey(device_kind="cpu-interpret", n=8, batch=(), \
-layout="pi", dtype="float32", precision="split3")
+layout="pi", dtype="float32", precision="split3", domain="c2c")
         b = PlanKey(**base)  # not statically analyzable: skipped
     """
     assert run(code, "PIF401") == []
+
+
+def test_pif401_domain_is_compile_relevant():
+    """domain joined the covered fields with the any-length ladder:
+    an r2c and a c2c key at one non-pow2 n dispatch different
+    variants, so a defaulted domain aliases cache entries."""
+    code = """
+        from cs87project_msolano2_tpu.plans import PlanKey
+
+        a = PlanKey(device_kind="cpu-interpret", n=1000, batch=(), \
+layout="natural", dtype="float32", precision="split3")
+    """
+    found = run(code, "PIF401")
+    assert rule_ids(found) == ["PIF401"]
+    assert "domain" in found[0].message
 
 
 def test_pif401_core_module_exempt():

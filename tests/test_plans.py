@@ -89,7 +89,10 @@ def test_static_rows_plan_executes_correctly():
 
 def test_pi_layout_requires_kernel_eligible_shape():
     with pytest.raises(ValueError, match="kernel-eligible"):
-        plans.plan_for((7, 96), layout="pi")  # n < 128: no kernel path
+        plans.plan_for((7, 64), layout="pi")  # n < 128: no kernel path
+    # non-pow2 n never has a pi order at all — refused at the key
+    with pytest.raises(ValueError, match="power-of-two"):
+        plans.plan_for((7, 96), layout="pi")
 
 
 def test_fp32_gets_the_kernel_path():
@@ -100,8 +103,11 @@ def test_fp32_gets_the_kernel_path():
     assert plan.variant == "rows"
     pi = plans.plan_for((4096,), layout="pi", precision="fp32")
     assert pi.variant == "rows"
-    # the jnp stage path still serves shapes with no eligible kernel
-    assert plans.plan_for((96,), precision="fp32").variant == "jnp"
+    # non-pow2 n is an any-length plan now (96 = 3·32 → mixed-radix,
+    # docs/PLANS.md "Arbitrary n"); the jnp stage path still serves
+    # pow2 shapes too small for any kernel
+    assert plans.plan_for((96,), precision="fp32").variant == "mixedradix"
+    assert plans.plan_for((2,), precision="fp32").variant == "jnp"
 
 
 # --------------------------------------------------------------- cache

@@ -117,9 +117,13 @@ def test_fp32_gets_the_real_kernel_path():
     # pi layout works now: the kernel path exists
     pi_key = plans.make_key(4096, layout="pi", precision="fp32")
     assert ladder.static_default(pi_key)[0] == "rows"
-    # the jnp fallback still serves where no kernel is eligible
+    # non-pow2 n routes an any-length variant now (96 = 3·32 →
+    # mixed-radix); the jnp fallback still serves pow2 shapes too
+    # small for any kernel
     odd = plans.make_key(96, precision="fp32")
-    assert ladder.static_default(odd)[0] == "jnp"
+    assert ladder.static_default(odd)[0] == "mixedradix"
+    tiny = plans.make_key(2, precision="fp32")
+    assert ladder.static_default(tiny)[0] == "jnp"
     # and the numbers are full-precision
     xr, xi = planes(512, seed=1)
     yr, yi = plans.get_plan(key).execute(xr, xi)
@@ -381,7 +385,7 @@ def test_v2_token_refused_and_v3_round_trips():
     key = plans.make_key(1024, layout="pi", precision="bf16",
                          device_kind="TPU test-kind")
     assert plans.PlanKey.from_token(key.token()) == key
-    assert json.loads(key.token())["v"] == 3
+    assert json.loads(key.token())["v"] == 4  # any-n bump (PLANS.md)
     v2 = json.dumps({
         "v": 2, "device_kind": "TPU test-kind", "n": 1024,
         "batch": [], "layout": "pi", "dtype": "float32",

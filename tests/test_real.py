@@ -168,8 +168,11 @@ def test_plan_key_domain_validation():
         plans.make_key(512, domain="half")
     with pytest.raises(ValueError, match="natural"):
         plans.make_key(512, layout="pi", domain="r2c")
-    with pytest.raises(ValueError, match="even"):
-        plans.make_key(9, domain="r2c")
+    # odd n is served by the direct any-length real path now
+    # (docs/PLANS.md "Arbitrary n"); only degenerate n is refused
+    assert plans.make_key(9, domain="r2c").n == 9
+    with pytest.raises(ValueError, match="n >= 2"):
+        plans.make_key(1, domain="r2c")
 
 
 def test_plan_key_io_shapes():

@@ -90,8 +90,13 @@ def test_load_shapes_rejects_bad_records(tmp_path):
     p.write_text("# only comments\n")
     with pytest.raises(ValueError, match="no shapes"):
         load_shapes(str(p))
-    with pytest.raises(ValueError, match="power of two"):
-        ShapeSpec(n=1000)
+    # any n >= 2 under the cap is admissible now (docs/PLANS.md,
+    # "Arbitrary n") — only degenerate n and pi non-pow2 are refused
+    assert ShapeSpec(n=1000).n == 1000
+    with pytest.raises(ValueError, match="2 <= n"):
+        ShapeSpec(n=1)
+    with pytest.raises(ValueError, match="power-of-two"):
+        ShapeSpec(n=1000, layout="pi")
 
 
 def test_dispatcher_warm_memoizes_plans():
@@ -176,9 +181,11 @@ def test_inverse_and_pi_layout_requests():
 def test_submit_validates_requests():
     async def main():
         async with Dispatcher() as d:
-            with pytest.raises(ServeError, match="power of two"):
-                await d.submit(np.zeros(100, np.float32),
-                               np.zeros(100, np.float32))
+            # n=100 is a served any-length plan now; only degenerate
+            # n < 2 (and over-cap) is refused at admission
+            with pytest.raises(ServeError, match="2 <= n"):
+                await d.submit(np.zeros(1, np.float32),
+                               np.zeros(1, np.float32))
             with pytest.raises(ServeError, match="1-D"):
                 await d.submit(np.zeros((2, 64), np.float32),
                                np.zeros((2, 64), np.float32))
