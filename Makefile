@@ -9,7 +9,7 @@ SHELL := /bin/bash
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
         bench-chaos serve-smoke serve-slo serve-mesh-smoke wire-smoke \
         rfft-smoke precision-smoke apps-smoke bluestein-smoke \
-        multichip-smoke \
+        multichip-smoke fleet-smoke \
         obs-live-smoke replicate run-experiments \
         run-experiments-and-analyze-results analyze analyze-datasets \
         analyze-smoke check check-stats lint
@@ -408,6 +408,35 @@ multichip-smoke:
 	JAX_PLATFORMS=cpu PIFFT_PLAN_CACHE=off \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python3 -m cs87project_msolano2_tpu.cli multichip smoke
+
+# the closed fleet loop, end-to-end on CPU (docs/FLEET.md): healthy
+# traffic captures drift baselines from the LIVE /slo reservoir (no
+# drift flagged); the `shifted` arrival process + an injected device
+# stall forces a Mann-Whitney drift verdict; the canary race promotes
+# a faster plan into the shared store under journal epoch 1 and live
+# p99 RECOVERS after the stall clears; an injected promote-site fault
+# rolls back to a BYTE-IDENTICAL store with the schema'd
+# fleet_rollback demotion; and a restarted empty-spec mesh prewarms
+# every previously-hot GroupKey from the drain-persisted arrival
+# model (zero tuning events after restart).  The smoke asserts each
+# transition internally and self-provisions a throwaway plan-cache
+# dir; the tail re-asserts the summary it printed.
+fleet-smoke:
+	set -o pipefail; \
+	JAX_PLATFORMS=cpu \
+	  python3 -m cs87project_msolano2_tpu.fleet.smoke \
+	  | tee /tmp/pifft-fleet-smoke.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-fleet-smoke.json')); \
+	  assert r['ok'], r; p = r['phases']; \
+	  assert any(f['drifted'] for f in p['B']['drift']), p['B']; \
+	  c = p['C']['outcome']; \
+	  assert c['promoted'] and not c['rolled_back'] and c['epoch'] == 1, c; \
+	  assert p['C']['recovered_p99_ms'] < p['C']['drifted_p99_ms'], p['C']; \
+	  d = p['D']['outcome']; \
+	  assert d['rolled_back'] and not d['promoted'], d; \
+	  assert p['E']['prewarmed'], p['E']; \
+	  assert r['events']['fleet'] == sorted(['fleet_canary', 'fleet_drift', 'fleet_prewarm', 'fleet_promote', 'fleet_rollback']), r['events']; \
+	  print('# fleet loop ok: drift -> promote (epoch %d) -> recover -> rollback -> prewarm %s' % (c['epoch'], p['E']['prewarmed']))"
 
 # the CI live-telemetry check (docs/OBSERVABILITY.md, "The live
 # plane"): end-to-end request tracing + the streaming endpoints + the

@@ -48,8 +48,9 @@ import numpy as np
 
 from .loader import BenchRound
 
-__all__ = ["Regression", "GateResult", "change_points", "compare_pair",
-           "detect_regressions", "direction_of", "gate_rounds",
+__all__ = ["LiveVerdict", "Regression", "GateResult", "change_points",
+           "compare_pair", "detect_regressions", "direction_of",
+           "gate_rounds", "live_improved", "live_regressed",
            "load_perf_baseline", "mann_whitney", "write_perf_baseline"]
 
 #: default practical-significance threshold (relative change in the
@@ -129,6 +130,73 @@ def mann_whitney(a, b) -> tuple:
     # H1: b smaller than a  <=>  a's ranks high  <=>  u_a large
     z = (u_a - mean_u - 0.5) / math.sqrt(var_u)
     return u_a, _norm_sf(z)
+
+
+@dataclasses.dataclass
+class LiveVerdict:
+    """One live-population comparison (the fleet loop's drift and
+    canary gates — docs/FLEET.md).  ``significant`` applies the same
+    two-part contract as the replicated bench gate: the one-sided
+    Mann-Whitney p must clear ``alpha`` AND the median shift must
+    clear the practical floor, so a distribution-shape wobble never
+    drives a promotion or a rollback."""
+
+    significant: bool
+    p_value: float
+    med_change: float         # median(b)/median(a) - 1, signed
+    test: str                 # "mann-whitney" | "insufficient"
+    samples: tuple            # (len(a), len(b))
+
+    def to_json(self) -> dict:
+        return {"significant": self.significant,
+                "p_value": round(self.p_value, 6),
+                "med_change": round(self.med_change, 6),
+                "test": self.test,
+                "samples": list(self.samples)}
+
+
+def _live_compare(a, b, h1: str, alpha: float,
+                  min_change: float) -> LiveVerdict:
+    a = [float(v) for v in a]
+    b = [float(v) for v in b]
+    med_a = float(np.median(a)) if a else 0.0
+    med_b = float(np.median(b)) if b else 0.0
+    med_change = (med_b / med_a - 1.0) if med_a > 0 else 0.0
+    # the same >= 5-per-side floor as compare_pair: below it the normal
+    # approximation is anticonservative, so the verdict is "not enough
+    # evidence", never a guess
+    if len(a) < 5 or len(b) < 5:
+        return LiveVerdict(False, 1.0, med_change, "insufficient",
+                           (len(a), len(b)))
+    if h1 == "larger":          # H1: b larger than a (regression)
+        _, p = mann_whitney([-v for v in a], [-v for v in b])
+        shifted = med_change > min_change
+    else:                       # H1: b smaller than a (improvement)
+        _, p = mann_whitney(a, b)
+        shifted = -med_change > min_change
+    return LiveVerdict(bool(p < alpha and shifted), float(p),
+                       med_change, "mann-whitney", (len(a), len(b)))
+
+
+def live_regressed(baseline, live, alpha: float = DEFAULT_ALPHA,
+                   min_change: float = REPLICATED_MIN_CHANGE) \
+        -> LiveVerdict:
+    """Has this lower-better LIVE latency population drifted worse
+    than its healthy baseline?  One-sided Mann-Whitney, H1 = "live
+    tends larger" — the fleet drift detector's calibrated verdict
+    (the same orientation compare_pair applies to lower-better bench
+    metrics), never an ad-hoc threshold."""
+    return _live_compare(baseline, live, "larger", alpha, min_change)
+
+
+def live_improved(live, candidate, alpha: float = DEFAULT_ALPHA,
+                  min_change: float = REPLICATED_MIN_CHANGE) \
+        -> LiveVerdict:
+    """Does the canary candidate beat the live population?  One-sided
+    Mann-Whitney, H1 = "candidate tends smaller" — the promotion gate:
+    a winner is promoted into the shared plan cache only on a
+    significant verdict here (docs/FLEET.md)."""
+    return _live_compare(live, candidate, "smaller", alpha, min_change)
 
 
 @dataclasses.dataclass

@@ -730,6 +730,10 @@ def main(argv=None) -> int:
         from .apps.cli import apps_main
 
         return apps_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        from .fleet.cli import fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "check":
         from .check.cli import main as check_main
 

@@ -1,0 +1,332 @@
+"""The fleet-loop acceptance drive (``make fleet-smoke``): the whole
+closed loop, end-to-end, on CPU (docs/FLEET.md).
+
+    python3 -m cs87project_msolano2_tpu.fleet.smoke
+
+Phases — every transition asserted, not just exercised:
+
+A. PRIME + BASELINE — warm a 2-shape mesh, serve healthy shifted-free
+   traffic, capture the drift baselines from the LIVE ``/slo``
+   reservoir (never a bench file).
+B. DRIFT — the ``shifted`` arrival process changes the mix mid-run
+   while a ``device*`` stall fault slows every batch: the scan must
+   flag drift with a Mann-Whitney verdict from live samples.
+C. CANARY + PROMOTE — the racer shadow-races the drifted shape on the
+   designated canary device over mirrored traffic; the winner must
+   pass ``live_improved`` and land in the shared plan cache under a
+   journaled promotion epoch; after the stall clears, live p99 must
+   RECOVER (asserted against the drifted p99).
+D. ROLLBACK — ``PIFFT_FAULT=promote:permanent:1.0:1`` fires between
+   the journal record and the store write: the rollback must leave
+   the shared plan-cache store BYTE-IDENTICAL to its pre-race state
+   and emit the schema'd ``fleet_rollback`` demotion.
+E. PREWARM — a drain persists the arrival model beside the plan
+   cache; a RESTARTED mesh (empty shape set) must warm every
+   previously-hot GroupKey from the model and serve each group's
+   first request on a warm plan (no tuning event, no autotune span,
+   verified against the numpy oracle).
+
+Every event emitted across the run is schema-validated at the end.
+Prints a JSON summary; exit 0 only if every assertion held.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from ..obs import events, metrics
+from ..obs.spans import clock
+from ..plans import cache
+from ..resilience.inject import inject
+from ..serve import loadgen
+from ..serve.dispatcher import QueueFull
+from ..serve.mesh import MeshConfig, MeshDispatcher
+from ..serve.shapes import ShapeSpec
+from .loop import FleetController
+from .prewarm import ArrivalModel, model_path
+
+RPS = 150.0
+STALL_S = 0.03
+WINDOW_S = 1.0
+
+#: the served population: n=256 dominates the healthy mix, the shift
+#: flips the weight onto n=512 (the step the drift scan must see
+#: alongside the stall)
+POPULATION = [
+    (3.0, {"n": 256, "shifted_weight": 1.0}),
+    (1.0, {"n": 512, "shifted_weight": 3.0}),
+]
+
+
+def _say(msg: str) -> None:
+    print(f"[fleet-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+async def _drive(mesh, specs, inputs, process: str, rps: float,
+                 duration_s: float, seed: int = 0,
+                 on_shift=None) -> dict:
+    """Open-loop arrivals over the population schedule (the loadgen
+    ``shifted`` process under test); `on_shift` fires once at the
+    schedule's shift point (the smoke arms the stall there)."""
+    rng = np.random.default_rng(seed)
+    offsets, draws = loadgen.population_schedule(
+        process, POPULATION, rps, duration_s, rng)
+    t_shift = loadgen.SHIFT_AT_FRAC * duration_s
+    shifted = False
+    counts: dict = {"ok": 0, "rejected": 0, "failed": {}}
+
+    async def one(si: int):
+        spec = specs[si]
+        xr, xi = inputs[si]
+        try:
+            await mesh.submit(xr, xi, layout=spec.layout,
+                              precision=spec.precision,
+                              domain=spec.domain, op=spec.op)
+        except QueueFull:
+            counts["rejected"] += 1
+            return
+        except Exception as exc:
+            # an open-loop driver must keep the schedule, but a failed
+            # submit is still evidence — keep the per-type tally in the
+            # phase summary so a broken phase is attributable
+            name = type(exc).__name__
+            counts["failed"][name] = counts["failed"].get(name, 0) + 1
+            return
+        counts["ok"] += 1
+
+    t0 = clock()
+    tasks = []
+    for i, off in enumerate(offsets):
+        if on_shift is not None and not shifted and off >= t_shift:
+            on_shift()
+            shifted = True
+        delay = (t0 + off) - clock()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one(int(draws[i]))))
+    await asyncio.gather(*tasks)
+    return counts
+
+
+async def _main(tmp: str) -> dict:
+    summary: dict = {"phases": {}}
+    events_path = os.path.join(tmp, "events.jsonl")
+    events.enable(events_path, run_id="fleet-smoke")
+    journal_path = os.path.join(tmp, "fleet-journal.jsonl")
+
+    specs = [ShapeSpec(**{k: v for k, v in rec.items()
+                          if k != "shifted_weight"})
+             for _w, rec in POPULATION]
+    labels = [s.label() for s in specs]
+    rng = np.random.default_rng(7)
+    inputs = [(rng.standard_normal(s.n).astype(np.float32),
+               rng.standard_normal(s.n).astype(np.float32))
+              for s in specs]
+
+    config = MeshConfig(devices=4, max_batch=4, max_wait_ms=1.0,
+                        queue_depth=512)
+    mesh = MeshDispatcher(config, shape_specs=list(specs))
+    fleet = FleetController(mesh, journal_path=journal_path,
+                            window_s=WINDOW_S)
+
+    async with mesh:
+        # ---- A. prime + healthy baseline ------------------------
+        _say("phase A: prime + baseline")
+        for burst in (1, 2, 4):       # compile every batch bucket
+            for si in range(len(specs)):
+                await asyncio.gather(*[
+                    asyncio.ensure_future(mesh.submit(
+                        inputs[si][0], inputs[si][1],
+                        layout=specs[si].layout,
+                        precision=specs[si].precision,
+                        domain=specs[si].domain, op=specs[si].op))
+                    for _ in range(burst)])
+        a = await _drive(mesh, specs, inputs, "uniform", RPS, 1.2,
+                         seed=1)
+        captured = fleet.drift.capture_baseline(WINDOW_S)
+        assert set(labels) <= set(captured), \
+            f"baseline capture missed labels: {captured} vs {labels}"
+        healthy = {f.label: f for f in fleet.drift.scan(WINDOW_S)}
+        assert not any(f.drifted for f in healthy.values()), \
+            "healthy traffic must not flag drift"
+        summary["phases"]["A"] = {
+            "traffic": a, "baselines": captured,
+            "baseline_p99_ms": {
+                k: f.baseline_p99_ms for k, f in healthy.items()}}
+
+        # ---- B. shifted traffic + stall => drift ----------------
+        _say("phase B: shift + stall => drift scan")
+        with contextlib.ExitStack() as stack:
+            stall = {}
+
+            def arm():
+                stall["spec"] = stack.enter_context(
+                    inject("device*", "stall", stall_s=STALL_S))
+
+            b = await _drive(mesh, specs, inputs, "shifted", RPS, 2.4,
+                             seed=2, on_shift=arm)
+            assert stall.get("spec") is not None and \
+                stall["spec"].fired > 0, "stall fault never fired"
+
+            # ---- C1. race + MW-gated promotion (stall still live,
+            # exactly the regime the canary exists for) ------------
+            _say("phase C: canary race + promotion")
+            step = fleet.step(WINDOW_S, max_races=1)
+        drifted = [f for f in step["findings"] if f.drifted]
+        assert drifted, "shifted+stalled traffic must flag drift"
+        finding = drifted[0]
+        assert finding.verdict.test == "mann-whitney"
+        assert finding.live_p99_ms > finding.baseline_p99_ms
+        assert step["outcomes"], "a drifted served label must race"
+        outcome = step["outcomes"][0]
+        assert outcome.promoted, \
+            f"canary must promote a faster plan: {outcome.to_json()}"
+        assert outcome.verdict.significant and \
+            outcome.verdict.p_value < fleet.canary.alpha
+        assert outcome.epoch == 1
+        store = cache.store_path(outcome.plan.key.device_kind)
+        with open(store, encoding="utf-8") as fh:
+            assert outcome.token in json.load(fh)["plans"], \
+                "promoted plan missing from the shared store"
+        journal_cells = fleet.canary.journal.load()
+        assert f"promote:{outcome.token}:e1" in journal_cells
+        assert f"promoted:{outcome.token}:e1" in journal_cells
+        summary["phases"]["B"] = {
+            "traffic": b, "stall_fired": stall["spec"].fired,
+            "drift": [f.to_json() for f in step["findings"]]}
+
+        # ---- C2. stall cleared => p99 recovers ------------------
+        c = await _drive(mesh, specs, inputs, "uniform", RPS, 1.2,
+                         seed=3)
+        recovered = fleet.verify_recovery(outcome, WINDOW_S)
+        assert recovered and not outcome.rolled_back, \
+            "live p99 must recover after the stall clears"
+        post = {f.label: f for f in fleet.drift.scan(WINDOW_S)}
+        live_p99 = post[finding.label].live_p99_ms
+        assert live_p99 < finding.live_p99_ms, \
+            (f"p99 did not recover: {live_p99} ms vs drifted "
+             f"{finding.live_p99_ms} ms")
+        summary["phases"]["C"] = {
+            "traffic": c, "outcome": outcome.to_json(),
+            "drifted_p99_ms": finding.live_p99_ms,
+            "recovered_p99_ms": live_p99}
+
+        # ---- D. injected fault mid-promotion => rollback --------
+        _say("phase D: fault mid-promotion => rollback")
+        with open(store, "rb") as fh:
+            pre_bytes = fh.read()
+        os.environ["PIFFT_FAULT"] = "promote:permanent:1.0:1"
+        try:
+            spec = fleet._spec_for(finding.label)
+            rolled = fleet.canary.race(
+                spec.key(), finding.live_ms,
+                group=fleet._group_for(spec),
+                mirror=fleet.tap.mirror)
+        finally:
+            os.environ.pop("PIFFT_FAULT", None)
+        assert rolled.rolled_back and not rolled.promoted, \
+            f"promote fault must roll back: {rolled.to_json()}"
+        with open(store, "rb") as fh:
+            post_bytes = fh.read()
+        assert post_bytes == pre_bytes, \
+            "rollback must leave the shared store byte-identical"
+        assert metrics.counter_value("pifft_fleet_rollback_total") \
+            == 1.0
+        assert f"rollback:{rolled.token}:e2" in \
+            fleet.canary.journal.load()
+        summary["phases"]["D"] = {
+            "outcome": rolled.to_json(),
+            "store_bytes": len(post_bytes)}
+
+        # ---- E1. drain persists the arrival model ---------------
+        _say("phase E: drain-persisted model => restart prewarm")
+        await mesh.drain_device("vdev1")
+        mpath = model_path()
+        assert mpath is not None and os.path.exists(mpath), \
+            f"drain must persist the arrival model at {mpath}"
+
+    # ---- E2. restart: prewarm from the persisted model ----------
+    seq_restart = (events.snapshot() or [{}])[-1].get("seq", 0)
+    mesh2 = MeshDispatcher(MeshConfig(devices=4, max_batch=4,
+                                      max_wait_ms=1.0),
+                           shape_specs=[])
+    fleet2 = FleetController(mesh2, journal_path=journal_path,
+                             model=ArrivalModel.load())
+    async with mesh2:
+        mesh2.warm()
+        warmed = [s.label() for s in mesh2.specs]
+        assert set(labels) <= set(warmed), \
+            f"prewarm must restore the hot set: {warmed}"
+        problems = []
+        for si, spec in enumerate(specs):
+            group = fleet2._group_for(spec)
+            _dev, _why, warmth, _load = mesh2.router.choose(group)
+            assert warmth >= 2, \
+                f"{group.label()} not warm anywhere after prewarm"
+            xr, xi = inputs[si]
+            resp = await mesh2.submit(
+                xr, xi, layout=spec.layout,
+                precision=spec.precision, domain=spec.domain,
+                op=spec.op)
+            problem = loadgen.verify_response(
+                spec.n, spec.layout, spec.domain, False,
+                spec.precision, xr, xi, resp, op=spec.op)
+            if problem:
+                problems.append(problem)
+        assert not problems, f"restart responses wrong: {problems}"
+    cold = [r for r in events.snapshot()
+            if r.get("seq", 0) > seq_restart
+            and (r.get("kind") == "plan_tuned"
+                 or (r.get("kind") == "span"
+                     and "autotune" in str(
+                         (r.get("payload") or {}).get("name", ""))))]
+    assert not cold, \
+        f"restart must serve warm (no tuning/compile events): {cold}"
+    summary["phases"]["E"] = {"prewarmed": warmed,
+                              "model_path": mpath}
+
+    # ---- validate every event emitted across the run ------------
+    events.flush()
+    records, dropped = events.load_events(events_path)
+    assert dropped == 0, f"{dropped} malformed event lines"
+    bad = [(r.get("kind"), p) for r in records
+           for p in events.validate_event(r)]
+    assert not bad, f"schema-invalid events: {bad[:8]}"
+    kinds = {r.get("kind") for r in records}
+    for wanted in ("fleet_drift", "fleet_canary", "fleet_promote",
+                   "fleet_rollback", "fleet_prewarm"):
+        assert wanted in kinds, f"missing {wanted} event"
+    summary["events"] = {"total": len(records),
+                         "fleet": sorted(k for k in kinds
+                                         if k.startswith("fleet_"))}
+    summary["ok"] = True
+    events.disable()
+    return summary
+
+
+def main() -> int:
+    if not os.environ.get("PIFFT_PLAN_CACHE") \
+            or cache.cache_dir() is None:
+        # hermetic by default: the loop IS the plan cache's feedback
+        # path, so the smoke needs an ENABLED store — but promoting
+        # into the operator's real ~/.cache store (the unset-env
+        # default) would leave smoke artifacts behind.  An explicit
+        # env value is respected (the Makefile points one at a
+        # mktemp dir).
+        os.environ["PIFFT_PLAN_CACHE"] = tempfile.mkdtemp(
+            prefix="pifft-fleet-cache-")
+    with tempfile.TemporaryDirectory(prefix="pifft-fleet-") as tmp:
+        summary = asyncio.run(_main(tmp))
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -313,6 +313,18 @@ _KIND_PAYLOAD = {
     # supports — the wire-smoke gate asserts both shapes
     "serve_wire_negotiated": ("protocol", "version", "credits"),
     "serve_wire_fallback": ("offered", "supported"),
+    # the fleet control loop (fleet/, docs/FLEET.md): a drift finding
+    # must carry the statistical verdict that flagged it (p-value from
+    # the calibrated Mann-Whitney detector, never an ad-hoc threshold),
+    # a promotion its journaled epoch and the verdict that gated it, a
+    # rollback the demotion-record discipline (from/to/kind/reason —
+    # the same shape resilience.degrade journals), and a prewarm which
+    # group the arrival model predicted hot
+    "fleet_drift": ("shape", "p_value", "live_p99_ms", "baseline_p99_ms"),
+    "fleet_canary": ("shape", "promote", "p_value"),
+    "fleet_promote": ("token", "variant", "p_value", "epoch"),
+    "fleet_rollback": ("token", "from", "to", "kind", "reason"),
+    "fleet_prewarm": ("shape", "weight"),
 }
 
 

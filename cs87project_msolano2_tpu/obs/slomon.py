@@ -39,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 import fnmatch
 import json
+import os
 from collections import deque
 from typing import Optional
 
@@ -112,7 +113,13 @@ def load_objectives(path: str) -> tuple:
             raise ValueError(
                 f"{path}: not JSON and PyYAML is unavailable — "
                 f"write the objectives as JSON") from e
-        doc = yaml.safe_load(text)
+        try:
+            doc = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            # the contract is ValueError for any unparseable file —
+            # the hot-reload path keys its warn-once on it
+            raise ValueError(f"{path}: neither JSON nor YAML: "
+                             f"{e}") from e
     if isinstance(doc, list):
         doc = {"objectives": doc}
     if not isinstance(doc, dict) or not isinstance(
@@ -180,6 +187,82 @@ class SloMonitor:
         self._alerting: dict = {o.name: False for o in self.objectives}
         self._level: Optional[str] = None
         self._t_eval: Optional[float] = None
+        # hot-reload state (:meth:`watch`): the objectives file being
+        # tracked, its last-seen mtime, the warn-once latch for a bad
+        # edit, and the last stat time (the mtime poll is throttled to
+        # the short window so per-batch evaluation stays syscall-free)
+        self._source_path: Optional[str] = None
+        self._source_mtime: Optional[float] = None
+        self._reload_warned = False
+        self._t_stat: Optional[float] = None
+
+    # ------------------------------------------------------ hot reload
+
+    def watch(self, path: str) -> None:
+        """Track `path` (the ``--slo-objectives`` file) for mtime
+        changes: :meth:`evaluate` re-reads it when it changes, so SLO
+        targets tighten in production without a restart.  A reload
+        that fails to parse warns ONCE and keeps the last good set —
+        a fat-fingered edit must never strip a serving session of its
+        objectives."""
+        self._source_path = path
+        try:
+            self._source_mtime = os.path.getmtime(path)
+        except OSError:
+            self._source_mtime = None
+        self._reload_warned = False
+
+    def maybe_reload(self, now: Optional[float] = None) -> bool:
+        """Reload the watched objectives file if its mtime moved;
+        returns True when a new set was installed.  Sample deques and
+        alert flags survive for objectives whose NAME survives (their
+        history is still valid evidence); renamed or dropped
+        objectives start fresh."""
+        path = self._source_path
+        if path is None:
+            return False
+        now = clock() if now is None else now
+        if self._t_stat is not None \
+                and now - self._t_stat < self.windows[0]:
+            return False
+        self._t_stat = now
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return False  # vanished: keep serving the last good set
+        if mtime == self._source_mtime:
+            return False
+        self._source_mtime = mtime
+        from ..plans.core import warn
+
+        try:
+            objectives, windows = load_objectives(path)
+            names = [o.name for o in objectives]
+            dups = {n for n in names if names.count(n) > 1}
+            if dups:
+                raise ValueError(f"duplicate objective name(s) "
+                                 f"{sorted(dups)}")
+        except (OSError, ValueError) as e:
+            if not self._reload_warned:
+                self._reload_warned = True
+                warn(f"slo objectives reload failed ({path}): {e}; "
+                     f"keeping the last good set")
+            return False
+        self._reload_warned = False
+        self.objectives = list(objectives)
+        self.windows = (float(windows[0]), float(windows[1]))
+        self._samples = {o.name: self._samples.get(o.name, deque())
+                         for o in self.objectives}
+        self._alerting = {o.name: self._alerting.get(o.name, False)
+                          for o in self.objectives}
+        metrics.inc("pifft_slo_reloads_total")
+        events.emit("slo_reload", path=path,
+                    objectives=[o.name for o in self.objectives],
+                    windows=list(self.windows))
+        warn(f"slo objectives reloaded from {path}: "
+             f"{len(self.objectives)} objective(s), windows "
+             f"{self.windows[0]:g}s/{self.windows[1]:g}s")
+        return True
 
     # ------------------------------------------------------ ingestion
 
@@ -222,6 +305,7 @@ class SloMonitor:
         Returns ``{objective: {"burn": {window: rate}, "alerting":
         bool}}``."""
         now = clock() if t is None else t
+        self.maybe_reload(now)
         out = {}
         level = None
         for obj in self.objectives:

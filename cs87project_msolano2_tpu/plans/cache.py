@@ -24,11 +24,7 @@ import json
 import os
 import re
 import threading
-
-try:
-    import fcntl
-except ImportError:  # non-POSIX: merges fall back to last-writer-wins
-    fcntl = None
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -170,6 +166,65 @@ def lookup(key: PlanKey) -> Optional[Plan]:
     return plan
 
 
+#: bounded-retry lock parameters for the disk-store merge-write: worst
+#: case ~1 s of waiting before falling back to last-writer-wins with a
+#: warn (a stuck peer must never wedge the process that just tuned)
+_LOCK_RETRIES = 50
+_LOCK_WAIT_S = 0.02
+#: a lockfile older than this is an orphan (a writer killed between
+#: acquire and release) and is broken, not waited on
+_LOCK_STALE_S = 10.0
+
+
+def _acquire_store_lock(path: str) -> Optional[tuple]:
+    """Exclusive-create lockfile with bounded retry — the portable
+    cross-process serialization for the read-merge-write below
+    (``O_EXCL`` is atomic on every platform the store runs on, where
+    ``fcntl.flock`` is POSIX-only and silently advisory elsewhere).
+    Returns ``(fd, lock_path)`` or None when the retries are exhausted
+    (caller proceeds unlocked, last-writer-wins, announced)."""
+    lock_path = f"{path}.lock"
+    for _ in range(_LOCK_RETRIES):
+        try:
+            fd = os.open(lock_path,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            # a holder that died between acquire and release leaves the
+            # file behind forever: break locks past the staleness bound
+            # instead of waiting on a corpse
+            try:
+                age = time.time() - os.path.getmtime(lock_path)  # pifft: noqa[PIF102]: not a measurement — staleness vs another process's mtime needs the wall clock; the timing relay's monotonic clock is per-process
+            except OSError:
+                continue  # released between open and stat: retry now
+            if age > _LOCK_STALE_S:
+                warn(f"plan store lock {lock_path} is {age:.0f}s old "
+                     f"(orphaned holder); breaking it")
+                try:
+                    os.unlink(lock_path)
+                except OSError:
+                    pass
+                continue
+            time.sleep(_LOCK_WAIT_S)
+            continue
+        try:
+            os.write(fd, f"{os.getpid()}\n".encode())
+        except OSError:
+            pass  # the lock is held; the pid note is diagnostics only
+        return fd, lock_path
+    return None
+
+
+def _release_store_lock(fd: int, lock_path: str) -> None:
+    try:
+        os.close(fd)
+    except OSError:
+        pass
+    try:
+        os.unlink(lock_path)
+    except OSError:
+        pass
+
+
 def store(plan: Plan, persist: bool = True) -> None:
     """Memoize and (unless disabled) merge into the disk store.  Disk
     failures are swallowed: a read-only HOME must never break the
@@ -183,10 +238,14 @@ def store(plan: Plan, persist: bool = True) -> None:
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         # serialize the read-merge-write across processes: two tuners
-        # finishing together must not drop each other's fresh winner
-        with open(f"{path}.lock", "w") as lk:
-            if fcntl is not None:
-                fcntl.flock(lk, fcntl.LOCK_EX)
+        # (or a mesh worker and the fleet promotion agent) finishing
+        # together must not drop each other's fresh winner
+        lock = _acquire_store_lock(path)
+        if lock is None:
+            warn(f"plan store lock {path}.lock still contended after "
+                 f"{_LOCK_RETRIES} tries; writing unlocked "
+                 f"(last-writer-wins)")
+        try:
             # merge over the FULL store contents, stale tokens
             # included: the read path skips them, but the write path
             # must carry them through verbatim — a mixed-version
@@ -207,6 +266,9 @@ def store(plan: Plan, persist: bool = True) -> None:
             with open(tmp, "w") as fh:
                 json.dump(data, fh, indent=1, sort_keys=True)
             os.replace(tmp, path)
+        finally:
+            if lock is not None:
+                _release_store_lock(*lock)
     except OSError as e:
         # deliberate swallow (a read-only HOME must never break the
         # transform that just tuned) — but logged: a session silently

@@ -72,6 +72,16 @@ KNOWN_SITES = {
               "through consensus and re-routes its queued and "
               "in-flight requests to survivors (docs/SERVING.md, "
               "failover)",
+    "canary": "fleet/canary.py shadow re-race entry — the mirrored "
+              "(non-served) candidate timing loop on the designated "
+              "canary device; a fault here aborts the race before any "
+              "verdict, leaving the shared plan cache untouched "
+              "(docs/FLEET.md)",
+    "promote": "fleet/canary.py promotion write — between the journaled "
+               "promotion epoch and the shared plan-cache store; a "
+               "fault here triggers the automatic rollback path "
+               "(byte-identical cache restore + fleet_rollback "
+               "demotion event — docs/FLEET.md)",
 }
 
 KINDS = ("transient", "capacity", "permanent", "timeout", "stall")

@@ -268,7 +268,12 @@ class Dispatcher:
             return spec
         if isinstance(spec, str):
             objectives, windows = slomon_mod.load_objectives(spec)
-            return slomon_mod.SloMonitor(objectives, windows)
+            mon = slomon_mod.SloMonitor(objectives, windows)
+            # a file-backed monitor hot-reloads on mtime change, so SLO
+            # targets tighten in production without a restart
+            # (docs/OBSERVABILITY.md)
+            mon.watch(spec)
+            return mon
         return slomon_mod.SloMonitor(list(spec))
 
     # ----------------------------------------------------- lifecycle

@@ -373,7 +373,12 @@ async def run_mesh_chaos_load(mesh, specs, rps: float,
 #: (docs/SERVING.md): real front doors never see the uniform schedule
 #: the classic cells use — diurnal swing, bursts and heavy-tailed
 #: think time are what the credit window and the coalescer must absorb
-ARRIVAL_PROCESSES = ("uniform", "diurnal", "bursty", "heavytail")
+ARRIVAL_PROCESSES = ("uniform", "diurnal", "bursty", "heavytail",
+                     "shifted")
+
+#: where the ``shifted`` process flips the population mix, as a
+#: fraction of the run (the drift scenario's default step point)
+SHIFT_AT_FRAC = 0.5
 
 
 def arrival_offsets(process: str, rps: float, duration_s: float,
@@ -389,9 +394,13 @@ def arrival_offsets(process: str, rps: float, duration_s: float,
       4x the mean rate (the coalescer's best case, admission's worst).
     - ``heavytail``: Pareto (alpha=1.5) interarrivals with mean
       ``1/rps`` — long gaps, hot clumps, no second moment to speak of.
+    - ``shifted``: the uniform grid — the step change this process
+      models lives in the POPULATION MIX, not the rate
+      (:func:`population_schedule` flips the draw weights at the
+      shift offset; the fleet smoke's drift scenario — docs/FLEET.md).
     """
     total = max(1, int(rps * duration_s))
-    if process == "uniform":
+    if process in ("uniform", "shifted"):
         return [i / rps for i in range(total)]
     if process == "diurnal":
         # invert the cumulative rate Lambda(t) on a grid: arrival i
@@ -432,6 +441,44 @@ def arrival_offsets(process: str, rps: float, duration_s: float,
 _SPEC_DEFAULTS = {"op": "fft", "domain": "c2c", "layout": "natural",
                   "precision": None, "inverse": False,
                   "priority": "normal", "tenant": "default"}
+
+
+def population_schedule(process: str, population, rps: float,
+                        duration_s: float, rng,
+                        shift_frac: float = SHIFT_AT_FRAC) -> tuple:
+    """``(offsets, spec_indices)`` for one replay trace: arrival times
+    from :func:`arrival_offsets` plus the population draw for each.
+
+    Every process draws the mix i.i.d. from the entries' ``weight`` —
+    except ``shifted``, which applies a DETERMINISTIC step-change at
+    ``shift_frac * duration_s``: draws before the step use ``weight``,
+    draws from the step on use each spec's ``"shifted_weight"`` key
+    (default: its ``weight``, i.e. unchanged).  That is how a replay
+    trace emits "the shape/op/priority mix moved under the fleet" as a
+    normal population, reproducible from the seed (docs/FLEET.md)."""
+    if not 0.0 <= shift_frac <= 1.0:
+        raise ValueError(f"shift_frac must be in [0, 1], got "
+                         f"{shift_frac}")
+    weights = np.asarray([float(w) for w, _s in population])
+    if weights.sum() <= 0:
+        raise ValueError("population weights sum to zero")
+    weights = weights / weights.sum()
+    offsets = arrival_offsets(process, rps, duration_s, rng)
+    if process != "shifted":
+        draws = rng.choice(len(population), size=len(offsets),
+                           p=weights)
+        return offsets, [int(d) for d in draws]
+    shifted = np.asarray([float(s.get("shifted_weight", w))
+                          for w, s in population])
+    if shifted.sum() <= 0:
+        raise ValueError("shifted_weight values sum to zero")
+    shifted = shifted / shifted.sum()
+    t_shift = float(shift_frac) * duration_s
+    draws = []
+    for off in offsets:
+        p = weights if off < t_shift else shifted
+        draws.append(int(rng.choice(len(population), p=p)))
+    return offsets, draws
 
 
 def _replay_input(spec: dict, rng):
@@ -520,7 +567,8 @@ async def run_wire_load(host: str, port: int, protocol_name: str,
                         population, rps: float, duration_s: float,
                         process: str = "uniform", seed: int = 0,
                         connections: int = 2,
-                        use_shm: bool = False) -> dict:
+                        use_shm: bool = False,
+                        shift_frac: float = SHIFT_AT_FRAC) -> dict:
     """One replay cell driven over REAL socket connections — the wire
     dialect's full cost (framing, parse, credits) is inside the
     client-observed latency, which is the entire point of the
@@ -535,8 +583,6 @@ async def run_wire_load(host: str, port: int, protocol_name: str,
     from . import wire
 
     rng = np.random.default_rng(seed)
-    weights = np.asarray([float(w) for w, _s in population])
-    weights = weights / weights.sum()
     specs = [dict(_SPEC_DEFAULTS, **s) for _w, s in population]
     inputs = [_replay_input(s, rng) for s in specs]
 
@@ -588,8 +634,9 @@ async def run_wire_load(host: str, port: int, protocol_name: str,
         else:
             failed.append(rec.get("error") or {"type": "unknown"})
 
-    offsets = arrival_offsets(process, rps, duration_s, rng)
-    draws = rng.choice(len(specs), size=len(offsets), p=weights)
+    offsets, draws = population_schedule(process, population, rps,
+                                         duration_s, rng,
+                                         shift_frac=shift_frac)
     t_start = clock()
     tasks = []
     try:

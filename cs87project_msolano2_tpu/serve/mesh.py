@@ -185,6 +185,12 @@ class MeshDispatcher(Dispatcher):
         self.router = Router(self.devices)
         self.admission = AdmissionController(quota=config.tenant_quota)
         self.t_open = clock()
+        #: the fleet-loop tap (fleet/prewarm.py FleetTap, duck-typed so
+        #: serve/ never imports fleet/): when attached, every admitted
+        #: request feeds the decayed arrival model + the shadow-traffic
+        #: mirror, warm() consults the model's hot groups, and a drain
+        #: persists the model beside the plan cache (docs/FLEET.md)
+        self.fleet_tap = None
 
     # ----------------------------------------------------- lifecycle
 
@@ -195,6 +201,18 @@ class MeshDispatcher(Dispatcher):
         affinity map the router spreads load by."""
         from . import shapes as shapes_mod
 
+        if self.fleet_tap is not None:
+            # predictive prewarm (docs/FLEET.md): groups the persisted
+            # arrival model expects hot join the served set BEFORE the
+            # round-robin, so a restarted mesh serves its first request
+            # of every previously-hot GroupKey on a warm plan
+            for spec in self.fleet_tap.hot_specs():
+                sig = (spec.n, spec.layout, spec.precision, spec.domain,
+                       getattr(spec, "op", "fft"))
+                if sig in self._served:
+                    continue
+                self.specs.append(spec)
+                self._served.add(sig)
         out = []
         for i, spec in enumerate(self.specs):
             device = self.devices[i % len(self.devices)]
@@ -261,6 +279,12 @@ class MeshDispatcher(Dispatcher):
         xr, xi, group = self._validated(xr, xi, layout, precision,
                                         inverse, domain, priority, op)
         self._check_served(group)
+        tap = self.fleet_tap
+        if tap is not None:
+            # one dict/deque update per request (the tap locks its own
+            # state): the arrival model learns the live mix, and the
+            # mirror keeps the planes the canary race replays
+            tap.observe(group, xr, xi)
         ctx = trace_mod.ensure(trace)
         t_submit = t_recv if t_recv is not None else clock()
         # choose first, RECORD only after admission passes: a shed
@@ -448,6 +472,9 @@ class MeshDispatcher(Dispatcher):
         warn(f"mesh device {device.id} FAILED ({kind} "
              f"{type(exc).__name__}: {str(exc)[:120]}); re-routing its "
              f"queue to survivors")
+        # a dead device's live-window keys are retired with it — the
+        # /slo table reports survivors, not ghosts
+        self.stats.retire(device=device.id)
         return self._evacuate_queues(device)
 
     @staticmethod
@@ -583,6 +610,15 @@ class MeshDispatcher(Dispatcher):
             await asyncio.gather(*device.workers.values(),
                                  return_exceptions=True)
         device.state = "drained"
+        # a drained device's live-window keys will never fill again:
+        # retire them so the /slo table stops carrying zero-count rows
+        self.stats.retire(device=device.id)
+        if self.fleet_tap is not None:
+            # prewarm-at-handoff (docs/FLEET.md): persist the arrival
+            # model beside the plan cache NOW, while the handed-off
+            # warmth is fresh — the rolling restart that follows a
+            # drain reloads it and warms every previously-hot group
+            await loop.run_in_executor(None, self.fleet_tap.save)
         if journal is not None:
             await loop.run_in_executor(
                 None, functools.partial(journal.record,

@@ -76,10 +76,23 @@ class Router:
 
     def __init__(self, devices):
         self.devices = list(devices)
+        #: the DESIGNATED CANARY device id, or None: the fleet canary
+        #: racer (docs/FLEET.md) sets it so production traffic never
+        #: lands there — the mirrored (shadowed, non-served) candidate
+        #: re-race owns the device until it is released
+        self.canary: Optional[str] = None
+
+    def set_canary(self, device_id: Optional[str]) -> None:
+        """Designate (or with None, release) the canary device.
+        Designation is a routing statement only — the device stays
+        healthy, its queues keep draining; it just receives no NEW
+        production placements while the shadow race runs."""
+        self.canary = device_id
 
     def candidates(self, exclude=()) -> list:
         return [d for d in self.devices
-                if d.state == "healthy" and d.id not in exclude]
+                if d.state == "healthy" and d.id not in exclude
+                and d.id != self.canary]
 
     def choose(self, group: GroupKey, exclude=(),
                reason: Optional[str] = None) -> tuple:

@@ -157,6 +157,43 @@ class LatencyStats:
                 out[key] = row
         return out
 
+    def window_totals(self, window_s: Optional[float] = None) -> dict:
+        """Raw total-latency populations (seconds, queue + compute) per
+        window key over the trailing window — the replicated samples
+        the fleet drift detector feeds to the Mann-Whitney machinery
+        (docs/FLEET.md): the verdict runs on the latencies requests
+        actually saw, not on the summarized percentiles."""
+        horizon = clock() - (window_s or self.window_s)
+        out = {}
+        with self._lock:
+            for key, dq in self._window.items():
+                while dq and dq[0][0] < horizon:
+                    dq.popleft()
+                out[key] = [s[1] + s[2] for s in dq]
+        return out
+
+    def retire(self, label: Optional[str] = None,
+               device: Optional[str] = None) -> list:
+        """Drop the live-window keys of a RETIRED group or device, so
+        the /slo table stops carrying zero-count rows for shapes (or
+        drained/dead mesh devices) that will never serve again.  By
+        label, by device, or both; returns the removed keys.  The
+        cumulative end-of-run tallies are untouched — retirement is a
+        live-table statement, not history rewriting."""
+        removed = []
+        with self._lock:
+            for key in list(self._window):
+                klabel, _, kdev = key.partition("@")
+                if label is not None and klabel != label:
+                    continue
+                if device is not None and kdev != device:
+                    continue
+                if label is None and device is None:
+                    continue
+                del self._window[key]
+                removed.append(key)
+        return removed
+
 
 def format_summary(summary: dict) -> str:
     """The human table ``pifft serve --smoke`` prints."""

@@ -1,0 +1,528 @@
+"""Fleet-loop unit tests (docs/FLEET.md): live-vs-baseline Mann-Whitney
+detectors, the drift scanner, the canary racer's promote/abort/rollback
+contract (byte-identical store restore, journaled epochs, demotion
+discipline), the decayed arrival model's persistence semantics, the
+``shifted`` load process, plan-cache store locking, slomon hot-reload,
+and the schema'd fleet event kinds.  The end-to-end loop (drift →
+race → promote → recover → rollback → prewarm across a mesh restart)
+is the ``fleet-smoke`` CI gate; these tests pin the pieces."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs, plans
+from cs87project_msolano2_tpu.analyze import regress
+from cs87project_msolano2_tpu.fleet import (
+    ArrivalModel,
+    CanaryController,
+    DriftDetector,
+    TrafficMirror,
+    model_path,
+)
+from cs87project_msolano2_tpu.obs import events, metrics
+from cs87project_msolano2_tpu.plans import cache as plan_cache
+from cs87project_msolano2_tpu.plans.core import Plan
+from cs87project_msolano2_tpu.resilience.inject import inject
+from cs87project_msolano2_tpu.resilience.journal import Journal
+from cs87project_msolano2_tpu.serve import loadgen
+from cs87project_msolano2_tpu.serve.batcher import GroupKey
+from cs87project_msolano2_tpu.serve.mesh import MeshDevice
+from cs87project_msolano2_tpu.serve.router import (
+    NoDeviceAvailable,
+    Router,
+)
+
+
+@pytest.fixture
+def obs_run():
+    rid = obs.enable()
+    yield rid
+    obs.disable()
+    metrics.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_memory():
+    plan_cache.clear(memory=True, disk=False)
+    yield
+    plan_cache.clear(memory=True, disk=False)
+
+
+# --------------------------------------------------------- detectors
+
+
+def test_live_regressed_flags_only_real_shifts():
+    base = [1.0 + 0.01 * i for i in range(40)]
+    slow = [2.0 + 0.01 * i for i in range(40)]
+    v = regress.live_regressed(base, slow)
+    assert v.significant and v.test == "mann-whitney"
+    assert v.med_change > 0.5 and v.p_value < 0.05
+    same = regress.live_regressed(base, list(base))
+    assert not same.significant
+    # an IMPROVEMENT is not a regression, however significant
+    fast = [0.1] * 40
+    assert not regress.live_regressed(base, fast).significant
+
+
+def test_live_detectors_refuse_tiny_populations():
+    v = regress.live_regressed([1.0] * 3, [9.0] * 40)
+    assert not v.significant and v.test == "insufficient"
+    v = regress.live_improved([9.0] * 40, [1.0] * 4)
+    assert not v.significant and v.test == "insufficient"
+    assert v.samples == (40, 4)
+
+
+def test_live_improved_requires_min_change():
+    live = [1.0 + 0.001 * i for i in range(40)]
+    better = [0.5] * 20
+    assert regress.live_improved(live, better).significant
+    # statistically distinguishable but practically identical
+    barely = [v - 0.02 for v in live[:20]]
+    assert not regress.live_improved(
+        live, barely, min_change=0.25).significant
+
+
+class _StubStats:
+    def __init__(self, totals):
+        self.totals = totals
+
+    def window_totals(self, window_s=None):
+        return self.totals
+
+
+def test_drift_detector_merges_devices_and_emits(obs_run):
+    stats = _StubStats({
+        "256:natural:split3@vdev0": [0.030] * 10,
+        "256:natural:split3@vdev1": [0.032] * 10,
+        "512:natural:split3@vdev0": [0.002] * 10,
+    })
+    det = DriftDetector(stats, min_samples=8)
+    det.set_baseline("256:natural:split3", [2.0] * 20)   # ms
+    det.set_baseline("512:natural:split3", [2.0] * 20)
+    findings = {f.label: f for f in det.scan()}
+    f = findings["256:natural:split3"]
+    assert f.drifted and len(f.live_ms) == 20   # both devices merged
+    assert f.live_p99_ms > f.baseline_p99_ms
+    assert not findings["512:natural:split3"].drifted
+    drift_events = [r for r in events.snapshot()
+                    if r["kind"] == "fleet_drift"]
+    assert len(drift_events) == 1
+    assert not events.validate_event(drift_events[0])
+    assert metrics.counter_value("pifft_fleet_drift_total",
+                                 shape="256:natural:split3") == 1.0
+
+
+def test_drift_detector_baseline_capture_respects_min_samples():
+    stats = _StubStats({"a": [0.001] * 20, "b": [0.001] * 3})
+    det = DriftDetector(stats, min_samples=8)
+    assert det.capture_baseline() == ["a"]
+    assert det.baselines() == ["a"]
+    # too few live samples: the scan stays silent rather than running
+    # an anticonservative MW on a half-empty window
+    stats.totals = {"a": [0.5] * 4}
+    assert det.scan() == []
+
+
+# ------------------------------------------------------------ canary
+
+
+def _fast_timer(ms=1.0):
+    def timer(fn, key):
+        return ms
+    return timer
+
+
+def test_canary_promotes_on_verdict_and_journals_epoch(
+        tmp_path, monkeypatch, obs_run):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path / "cache"))
+    journal = Journal(str(tmp_path / "journal.jsonl"))
+    ctl = CanaryController(journal=journal)
+    key = plans.make_key(256)
+    live_ms = [30.0 + 0.1 * i for i in range(40)]
+    out = ctl.race(key, live_ms, timer=_fast_timer(),
+                   candidate_samples=[1.0 + 0.01 * i
+                                      for i in range(8)])
+    assert out.promoted and not out.rolled_back
+    assert out.epoch == 1 and out.verdict.significant
+    store = plan_cache.store_path(key.device_kind)
+    with open(store, encoding="utf-8") as fh:
+        assert key.token() in json.load(fh)["plans"]
+    cells = journal.load()
+    assert f"promote:{key.token()}:e1" in cells
+    assert f"promoted:{key.token()}:e1" in cells
+    kinds = [r["kind"] for r in events.snapshot()]
+    assert "fleet_canary" in kinds and "fleet_promote" in kinds
+    for rec in events.snapshot():
+        assert not events.validate_event(rec), rec
+
+
+def test_canary_rejects_insignificant_candidate(
+        tmp_path, monkeypatch, obs_run):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path / "cache"))
+    ctl = CanaryController()
+    key = plans.make_key(256)
+    live_ms = [1.0 + 0.01 * i for i in range(40)]
+    # the candidate population straddles the live median: no verdict
+    out = ctl.race(key, live_ms, timer=_fast_timer(),
+                   candidate_samples=[1.16 + 0.01 * i
+                                      for i in range(8)])
+    assert not out.promoted and not out.rolled_back
+    assert out.epoch is None
+    store = plan_cache.store_path(key.device_kind)
+    assert store is None or not os.path.exists(store)
+    # the unpromoted shadow winner must not serve from the LRU
+    assert plans.get_plan(key).source != "tuned"
+
+
+def test_canary_site_fault_aborts_before_any_write(
+        tmp_path, monkeypatch, obs_run):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path / "cache"))
+    journal = Journal(str(tmp_path / "journal.jsonl"))
+    ctl = CanaryController(journal=journal)
+    key = plans.make_key(256)
+    with inject("canary", "transient", count=1):
+        out = ctl.race(key, [30.0] * 40, timer=_fast_timer(),
+                       candidate_samples=[1.0] * 8)
+    assert not out.promoted and not out.rolled_back
+    assert "aborted" in out.reason
+    assert journal.load() == {}
+    store = plan_cache.store_path(key.device_kind)
+    assert store is None or not os.path.exists(store)
+    assert metrics.counter_value("pifft_fleet_rollback_total") == 0.0
+
+
+def test_promote_fault_rolls_back_byte_identical(
+        tmp_path, monkeypatch, obs_run):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path / "cache"))
+    journal = Journal(str(tmp_path / "journal.jsonl"))
+    key = plans.make_key(256)
+    # a pre-existing store entry (another key) must survive untouched
+    other = plans.make_key(512)
+    plan_cache.store(Plan(key=other, variant="rql", params={},
+                          source="tuned", ms=1.0))
+    store = plan_cache.store_path(key.device_kind)
+    with open(store, "rb") as fh:
+        pre = fh.read()
+
+    ctl = CanaryController(journal=journal)
+    live_ms = [30.0 + 0.1 * i for i in range(40)]
+    with inject("promote", "permanent", count=1):
+        out = ctl.race(key, live_ms, timer=_fast_timer(),
+                       candidate_samples=[1.0] * 8)
+    assert out.rolled_back and not out.promoted
+    with open(store, "rb") as fh:
+        assert fh.read() == pre
+    assert f"rollback:{key.token()}:e1" in journal.load()
+    assert metrics.counter_value("pifft_fleet_rollback_total") == 1.0
+    rb = [r for r in events.snapshot()
+          if r["kind"] == "fleet_rollback"]
+    assert len(rb) == 1 and not events.validate_event(rb[0])
+    payload = rb[0]["payload"]
+    assert payload["kind"] == "permanent"
+    assert payload["to"] == out.prior_variant
+    # demotion discipline on the demoted candidate plan
+    assert out.plan.degraded and out.plan.demotions[-1]["kind"] == \
+        "permanent"
+
+
+def test_traffic_mirror_copies_and_bounds():
+    mirror = TrafficMirror(per_group=2)
+    group = GroupKey(n=8)
+    xr = np.ones(8, dtype=np.float32)
+    mirror.observe(group, xr, None)
+    xr[0] = 99.0   # the mirror must hold a COPY
+    mirror.observe(group, np.full(8, 2.0), np.full(8, 3.0))
+    mirror.observe(group, np.full(8, 4.0), np.full(8, 5.0))
+    planes = mirror.planes(group)
+    assert len(planes) == 2   # newest two
+    assert planes[0][0][0] == 2.0 and planes[1][0][0] == 4.0
+    assert mirror.planes(GroupKey(n=16)) == []
+
+
+def test_router_canary_designation_excludes_device():
+    devices = [MeshDevice(i) for i in range(3)]
+    router = Router(devices)
+    group = GroupKey(n=8)
+    router.set_canary("vdev2")
+    assert [d.id for d in router.candidates()] == ["vdev0", "vdev1"]
+    device, _why, _warmth, _load = router.choose(group)
+    assert device.id != "vdev2"
+    router.set_canary(None)
+    assert len(router.candidates()) == 3
+    for d in devices:
+        d.state = "dead"
+    with pytest.raises(NoDeviceAvailable):
+        router.choose(group)
+
+
+# ----------------------------------------------------- arrival model
+
+
+def test_arrival_model_decay_and_hot_order():
+    model = ArrivalModel(half_life_s=10.0, min_weight=0.5)
+    hot_group = GroupKey(n=256)
+    cold_group = GroupKey(n=512)
+    for _ in range(8):
+        model.observe(hot_group, now=100.0)
+    model.observe(cold_group, now=100.0)
+    hot = model.hot(now=100.0)
+    assert [k[0] for _w, k in hot] == [256, 512]
+    # two half-lives later the cold shape decays under the floor
+    # (0.25 < min_weight) while the hot one is still worth a compile
+    hot = model.hot(now=120.0)
+    assert [k[0] for _w, k in hot] == [256]
+    assert hot[0][0] == pytest.approx(2.0)
+
+
+def test_arrival_model_persistence_rebases_clock(tmp_path):
+    path = str(tmp_path / "arrivals.json")
+    model = ArrivalModel(path=path, half_life_s=10.0)
+    model.observe(GroupKey(n=64), now=50.0)
+    model.observe(GroupKey(n=64), now=50.0)
+    assert model.save(now=60.0) == path   # decayed to 1.0 at save
+    doc = json.load(open(path))
+    assert doc["arrivals"][0]["weight"] == pytest.approx(1.0)
+    assert "t" not in doc["arrivals"][0]   # no process-local clocks
+
+    # a restart loads the decayed mass at ITS "now" — downtime is not
+    # charged against the mix
+    loaded = ArrivalModel.load(path, half_life_s=10.0, now=7.0)
+    assert loaded.hot(now=7.0)[0][0] == pytest.approx(1.0)
+    specs = loaded.hot_specs(now=7.0)
+    assert [s.n for s in specs] == [64]
+
+
+def test_arrival_model_corrupt_file_starts_cold(tmp_path):
+    path = str(tmp_path / "arrivals.json")
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    assert ArrivalModel.load(path).hot() == []
+    with open(path, "w") as fh:
+        json.dump({"schema": 999, "arrivals": []}, fh)
+    assert ArrivalModel.load(path).hot() == []
+
+
+def test_model_path_follows_plan_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", "off")
+    assert model_path() is None
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    assert model_path() == str(tmp_path / "fleet-arrivals.json")
+
+
+# ------------------------------------------------- shifted load mix
+
+
+def test_population_schedule_shifted_flips_mix():
+    population = [(9.0, {"n": 256, "shifted_weight": 1.0}),
+                  (1.0, {"n": 512, "shifted_weight": 9.0})]
+    rng = np.random.default_rng(0)
+    offsets, draws = loadgen.population_schedule(
+        "shifted", population, rps=100.0, duration_s=4.0, rng=rng)
+    assert len(offsets) == len(draws) == 400
+    t_shift = loadgen.SHIFT_AT_FRAC * 4.0
+    pre = [d for off, d in zip(offsets, draws) if off < t_shift]
+    post = [d for off, d in zip(offsets, draws) if off >= t_shift]
+    assert np.mean(pre) < 0.3 and np.mean(post) > 0.7
+
+    # deterministic given the seed: a replay is only a replay if two
+    # runs see the same schedule
+    offsets2, draws2 = loadgen.population_schedule(
+        "shifted", population, rps=100.0, duration_s=4.0,
+        rng=np.random.default_rng(0))
+    assert offsets2 == offsets and draws2 == draws
+
+
+def test_population_schedule_validation_and_defaults():
+    rng = np.random.default_rng(1)
+    # shifted_weight defaults to weight: no shift in effect
+    population = [(1.0, {"n": 64}), (1.0, {"n": 128})]
+    _off, draws = loadgen.population_schedule(
+        "shifted", population, rps=50.0, duration_s=2.0, rng=rng)
+    assert set(draws) == {0, 1}
+    with pytest.raises(ValueError, match="shift_frac"):
+        loadgen.population_schedule("shifted", population, 50.0, 2.0,
+                                    rng, shift_frac=1.5)
+    with pytest.raises(ValueError, match="sum to zero"):
+        loadgen.population_schedule("uniform",
+                                    [(0.0, {"n": 64})], 50.0, 2.0, rng)
+    with pytest.raises(ValueError, match="shifted_weight"):
+        loadgen.population_schedule(
+            "shifted", [(1.0, {"n": 64, "shifted_weight": 0.0})],
+            50.0, 2.0, rng)
+    assert "shifted" in loadgen.ARRIVAL_PROCESSES
+
+
+# ------------------------------------------------- plan-store locking
+
+
+def test_store_lock_serializes_concurrent_writers(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    keys = [plans.make_key(n) for n in (64, 128, 256, 512)]
+    errors = []
+
+    def write(key):
+        try:
+            plan_cache.store(Plan(key=key, variant="rql", params={},
+                                  source="tuned", ms=1.0))
+        except Exception as exc:   # pragma: no cover - the assertion
+            errors.append(exc)
+
+    threads = [threading.Thread(target=write, args=(k,))
+               for k in keys for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    path = plan_cache.store_path(keys[0].device_kind)
+    with open(path, encoding="utf-8") as fh:
+        stored = json.load(fh)["plans"]
+    # no lost update: every key's merge-write survived the race
+    assert {k.token() for k in keys} <= set(stored)
+    assert not os.path.exists(f"{path}.lock")
+
+
+def test_store_lock_breaks_stale_locks(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = plans.make_key(64)
+    path = plan_cache.store_path(key.device_kind)
+    lock = f"{path}.lock"
+    with open(lock, "w") as fh:
+        fh.write("999999")   # a dead writer's leftover
+    stale = time.time() - 2 * plan_cache._LOCK_STALE_S
+    os.utime(lock, (stale, stale))
+    plan_cache.store(Plan(key=key, variant="rql", params={},
+                          source="tuned", ms=1.0))
+    with open(path, encoding="utf-8") as fh:
+        assert key.token() in json.load(fh)["plans"]
+    assert not os.path.exists(lock)
+
+
+def test_store_clear_removes_lockfiles(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    key = plans.make_key(64)
+    path = plan_cache.store_path(key.device_kind)
+    plan_cache.store(Plan(key=key, variant="rql", params={},
+                          source="tuned", ms=1.0))
+    with open(f"{path}.lock", "w"):
+        pass
+    removed = plan_cache.clear(memory=False, disk=True)
+    assert path in removed
+    assert not os.path.exists(f"{path}.lock")
+
+
+# ----------------------------------------------- slomon hot-reload
+
+
+def _objectives_doc(target_ms):
+    return {"windows": [5, 60],
+            "objectives": [{"name": "fft-p99", "match": "fft",
+                            "p99_target_ms": target_ms,
+                            "error_budget": 0.01}]}
+
+
+def test_slomon_hot_reloads_on_mtime_change(tmp_path, obs_run):
+    from cs87project_msolano2_tpu.obs.slomon import (
+        SloMonitor,
+        load_objectives,
+    )
+
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(_objectives_doc(50)))
+    objectives, windows = load_objectives(str(path))
+    mon = SloMonitor(objectives, windows=windows)
+    mon.watch(str(path))
+    history = mon._samples["fft-p99"]
+
+    # unchanged mtime: nothing to do
+    assert mon.maybe_reload(now=1000.0) is False
+
+    path.write_text(json.dumps(_objectives_doc(25)))
+    os.utime(path, (1, 1))   # force a different mtime
+    assert mon.maybe_reload(now=2000.0) is True
+    assert mon.objectives[0].p99_target_ms == 25
+    # the surviving objective keeps its burn history — it is still
+    # valid evidence against the NEW target
+    assert mon._samples["fft-p99"] is history
+    assert metrics.counter_value("pifft_slo_reloads_total") == 1.0
+    reloads = [r for r in events.snapshot()
+               if r["kind"] == "slo_reload"]
+    assert len(reloads) == 1
+
+
+def test_slomon_reload_failure_warns_once_keeps_last_good(
+        tmp_path, monkeypatch, obs_run):
+    from cs87project_msolano2_tpu.obs.slomon import (
+        SloMonitor,
+        load_objectives,
+    )
+
+    warned = []
+    monkeypatch.setattr("cs87project_msolano2_tpu.plans.core.warn",
+                        lambda msg: warned.append(msg))
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(_objectives_doc(50)))
+    objectives, windows = load_objectives(str(path))
+    mon = SloMonitor(objectives, windows=windows)
+    mon.watch(str(path))
+
+    path.write_text("{not valid json at all")
+    os.utime(path, (1, 1))
+    assert mon.maybe_reload(now=1000.0) is False
+    assert mon.objectives[0].p99_target_ms == 50   # last good set
+    assert len(warned) == 1 and "keeping the last good set" in \
+        warned[0]
+
+    # the same broken file must not warn again every evaluation tick
+    os.utime(path, (2, 2))
+    assert mon.maybe_reload(now=2000.0) is False
+    assert len(warned) == 1
+
+    # a FIXED file reloads and re-arms the warning
+    path.write_text(json.dumps(_objectives_doc(30)))
+    os.utime(path, (3, 3))
+    assert mon.maybe_reload(now=3000.0) is True
+    assert mon.objectives[0].p99_target_ms == 30
+
+
+# ------------------------------------------------------ event schema
+
+
+def test_fleet_event_kinds_schema(obs_run):
+    events.emit("fleet_drift", shape="s", p_value=0.01,
+                live_p99_ms=5.0, baseline_p99_ms=1.0)
+    events.emit("fleet_canary", shape="s", promote=True, p_value=0.01)
+    events.emit("fleet_promote", token="t", variant="v", p_value=0.01,
+                epoch=1)
+    events.emit("fleet_rollback", token="t", epoch=1,
+                **{"from": "v2", "to": "v1", "kind": "quality",
+                   "reason": "p99 did not recover"})
+    events.emit("fleet_prewarm", shape="s", weight=3.2)
+    recs = events.snapshot()
+    assert len(recs) == 5
+    for rec in recs:
+        assert not events.validate_event(rec), rec
+    # a field-less fleet event is schema-INVALID, not silently fine
+    events.emit("fleet_promote", token="t")
+    bad = events.snapshot()[-1]
+    assert any("missing" in p for p in events.validate_event(bad))
+
+
+def test_fleet_cli_model(tmp_path, monkeypatch, capsys):
+    from cs87project_msolano2_tpu.cli import main
+
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path))
+    model = ArrivalModel(path=str(tmp_path / "fleet-arrivals.json"))
+    model.observe(GroupKey(n=64), now=1.0)
+    model.save(now=1.0)
+    assert main(["fleet", "model", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["hot"][0]["n"] == 64
+    assert main(["fleet", "model"]) == 0
+    assert "n=64" in capsys.readouterr().out

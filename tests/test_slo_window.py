@@ -1,0 +1,107 @@
+"""LatencyStats live-window behavior under sustained load
+(docs/OBSERVABILITY.md, "The live plane"; docs/FLEET.md feeds the
+drift detector from the same reservoir): the per-key reservoir stays
+bounded, old samples age out of summaries AND of the raw totals the
+fleet reads, and retired groups/devices stop leaking ``label@device``
+rows into the /slo table."""
+
+import pytest
+
+from cs87project_msolano2_tpu.serve import slo
+from cs87project_msolano2_tpu.serve.slo import LatencyStats
+
+
+@pytest.fixture
+def fake_clock(monkeypatch):
+    """A settable clock for the slo module: aging must be tested
+    against controlled time, not wall-clock sleeps."""
+    state = {"t": 1000.0}
+    monkeypatch.setattr(slo, "clock", lambda: state["t"])
+
+    def advance(dt):
+        state["t"] += dt
+        return state["t"]
+
+    return advance
+
+
+def test_reservoir_bounded_under_sustained_load(fake_clock):
+    stats = LatencyStats(window_s=60.0, window_max=32)
+    for i in range(10 * 32):
+        stats.record("256:natural:split3", 0.001, 0.002,
+                     device="vdev0")
+    totals = stats.window_totals()
+    assert len(totals["256:natural:split3@vdev0"]) == 32
+    # the cumulative tallies still saw every request
+    assert stats.summary()["256:natural:split3"]["requests"] == 320
+
+
+def test_reservoir_keeps_newest_when_full(fake_clock):
+    stats = LatencyStats(window_s=600.0, window_max=4)
+    for i in range(8):
+        stats.record("lbl", 0.0, float(i))
+        fake_clock(1.0)
+    # drop-oldest: only the last window_max compute values survive
+    assert stats.window_totals() == {"lbl": [4.0, 5.0, 6.0, 7.0]}
+
+
+def test_old_samples_age_out(fake_clock):
+    stats = LatencyStats(window_s=10.0)
+    stats.record("lbl", 0.001, 0.001)
+    fake_clock(5.0)
+    stats.record("lbl", 0.002, 0.002)
+    assert len(stats.window_totals()) == 1
+    assert len(stats.window_totals()["lbl"]) == 2
+    fake_clock(7.0)   # first sample now 12s old, second 7s old
+    assert stats.window_totals()["lbl"] == [0.004]
+    summary = stats.window_summary()
+    assert summary["lbl"]["requests"] == 1
+    fake_clock(20.0)  # everything aged out
+    assert stats.window_totals()["lbl"] == []
+    row = stats.window_summary()["lbl"]
+    # the key still reports a stable zero-count row (served, just not
+    # recently) — that is what retire() exists to remove
+    assert row["requests"] == 0
+    assert row["total_p99_ms"] is None
+    # narrower window override prunes the same way
+    stats.record("lbl", 0.001, 0.001)
+    fake_clock(2.0)
+    stats.record("lbl", 0.003, 0.003)
+    assert len(stats.window_totals(window_s=1.0)["lbl"]) == 1
+
+
+def test_retired_device_keys_do_not_leak(fake_clock):
+    stats = LatencyStats(window_s=60.0)
+    for dev in ("vdev0", "vdev1"):
+        stats.record("a", 0.001, 0.001, device=dev)
+        stats.record("b", 0.001, 0.001, device=dev)
+    stats.record("a", 0.001, 0.001)   # device-less key too
+    assert len(stats.window_summary()) == 5
+
+    removed = stats.retire(device="vdev1")
+    assert sorted(removed) == ["a@vdev1", "b@vdev1"]
+    assert sorted(stats.window_summary()) == ["a", "a@vdev0",
+                                             "b@vdev0"]
+
+    removed = stats.retire(label="a")
+    assert sorted(removed) == ["a", "a@vdev0"]
+    assert sorted(stats.window_summary()) == ["b@vdev0"]
+
+    # both-None is a no-op, not a table wipe
+    assert stats.retire() == []
+    assert sorted(stats.window_summary()) == ["b@vdev0"]
+
+    # retirement is a live-table statement: cumulative history stays
+    assert stats.summary()["a"]["requests"] == 3
+
+    # a retired pair can serve again and re-enter the live table
+    stats.record("a", 0.001, 0.001, device="vdev0")
+    assert "a@vdev0" in stats.window_summary()
+
+
+def test_retire_label_and_device_intersection(fake_clock):
+    stats = LatencyStats()
+    stats.record("a", 0.0, 0.001, device="vdev0")
+    stats.record("a", 0.0, 0.001, device="vdev1")
+    assert stats.retire(label="a", device="vdev0") == ["a@vdev0"]
+    assert sorted(stats.window_summary()) == ["a@vdev1"]
