@@ -9,7 +9,7 @@ SHELL := /bin/bash
 .PHONY: all clean recompile test bench bench-smoke bench-smoke-obs \
         bench-chaos serve-smoke serve-slo serve-mesh-smoke wire-smoke \
         rfft-smoke precision-smoke apps-smoke bluestein-smoke \
-        multichip-smoke fleet-smoke \
+        multichip-smoke fleet-smoke backend-smoke \
         obs-live-smoke replicate run-experiments \
         run-experiments-and-analyze-results analyze analyze-datasets \
         analyze-smoke check check-stats lint
@@ -437,6 +437,31 @@ fleet-smoke:
 	  assert p['E']['prewarmed'], p['E']; \
 	  assert r['events']['fleet'] == sorted(['fleet_canary', 'fleet_drift', 'fleet_prewarm', 'fleet_promote', 'fleet_rollback']), r['events']; \
 	  print('# fleet loop ok: drift -> promote (epoch %d) -> recover -> rollback -> prewarm %s' % (c['epoch'], p['E']['prewarmed']))"
+
+# the CI heterogeneous-backend check (docs/BACKENDS.md): the plan-key
+# backend axis end to end on a CPU-only host — schema-5 tokens with
+# per-backend cached winners and v4 refusal, `pifft hw probe` typed
+# inventory, distinct per-backend roofline ceilings, a two-tag virtual
+# mesh whose mid-run kill fails over ACROSS the backend boundary
+# (failover:backend:<tag> trail, zero drops), and the gpu / cpu-native
+# bench rows parsed back through the analyze loader's backend axis.
+# Self-provisions a throwaway plan cache; the tail re-asserts the
+# summary it printed.
+backend-smoke:
+	set -o pipefail; \
+	JAX_PLATFORMS=cpu \
+	  python3 -m cs87project_msolano2_tpu.hw.smoke \
+	  | tee /tmp/pifft-backend-smoke.json && \
+	python3 -c "import json; r = json.load(open('/tmp/pifft-backend-smoke.json')); \
+	  assert r['ok'], r; p = r['phases']; \
+	  assert p['A']['gpu_variant'].startswith('gpu'), p['A']; \
+	  assert p['B']['backend'] in ('tpu', 'gpu', 'cpu-interpret', 'cpu-native'), p['B']; \
+	  gbps = (p['C']['gpu_gbps'], p['C']['dram_gbps'], p['C']['tpu_v4_gbps']); \
+	  assert len(set(gbps)) == 3, p['C']; \
+	  assert p['D']['crossed'] >= 1 and p['D']['gpu_parity_relerr'] < 1e-4, p['D']; \
+	  assert set(p['E']['backends']) >= {'gpu', 'cpu-native', 'tpu'}, p['E']; \
+	  assert r['events']['failover'] >= 1, r['events']; \
+	  print('# backend plane ok: %s probe, %d cross-backend reroutes, bench rows %s' % (p['B']['backend'], p['D']['crossed'], ','.join(p['E']['backends'])))"
 
 # the CI live-telemetry check (docs/OBSERVABILITY.md, "The live
 # plane"): end-to-end request tracing + the streaming endpoints + the

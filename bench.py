@@ -98,6 +98,17 @@ LARGE_LOGNS = (22, 24, 25, 26, 27)
 SMOKE_N = 1 << 12
 SMOKE_LARGE_LOGNS = (13,)
 
+# the heterogeneous-backend rows (docs/BACKENDS.md): the same pi-layout
+# c2c shape planned under explicit gpu / cpu-native plan-key tokens, at
+# BOUNDED n in every tier — the gpu rung runs the portable Pallas rows
+# kernel in interpret mode on non-GPU hosts, so these rows exist to
+# keep the cross-backend plumbing (per-backend cache tokens, per-backend
+# roofline ceilings, the analyze loader's backend axis) exercised, not
+# to publish hero numbers
+BACKEND_ROW_LOGNS = (8, 10)
+BACKEND_ROW_BACKENDS = ("gpu", "cpu-native")
+BACKEND_ROW_PREFIX = {"gpu": "gpu", "cpu-native": "cpun"}
+
 # --serve-load: offered loads (requests/s) per served shape, open-loop
 # (serve/loadgen.py); the smoke tier is sized to finish in CI seconds
 SERVE_LOAD_NS = (1 << 16,)
@@ -347,7 +358,9 @@ def _row_fields(tag: str, nn: int, ms: float, plan,
     util, hbm_bytes = _metered_hbm_delta(
         lambda: roofline_utilization(nn, ms, plan.key.device_kind,
                                      passes or 0, domain=domain,
-                                     storage_bytes=plan.storage_bytes()))
+                                     storage_bytes=plan.storage_bytes(),
+                                     backend=getattr(plan.key, "backend",
+                                                     "tpu")))
     if hbm_bytes:
         # the METERED plan-declared traffic this cell charged — the
         # raw material of the rfft-smoke and precision-smoke
@@ -457,7 +470,8 @@ def measure_conv_row(logn: int, smoke: bool = False) -> dict:
         out[f"{tag}_hbm_bytes"] = hbm
     key = plans.make_key(nn, layout="natural", domain="r2c")
     util = spectral_roofline_utilization("conv", nn, ms,
-                                         key.device_kind)
+                                         key.device_kind,
+                                         backend=key.backend)
     if util is not None:
         out[f"{tag}_roofline_util"] = round(util, 3)
     if smoke:
@@ -540,7 +554,8 @@ def measure_conv_np_row(smoke: bool = False) -> dict:
         out[f"{tag}_pow2_hbm_bytes"] = hbm_pow2
     key = plans.make_key(nn, layout="natural", domain="r2c")
     util = spectral_roofline_utilization("conv", nn, ms,
-                                         key.device_kind)
+                                         key.device_kind,
+                                         backend=key.backend)
     if util is not None:
         out[f"{tag}_roofline_util"] = round(util, 3)
     if smoke:
@@ -740,6 +755,82 @@ def measure_large_n_row(logn: int, smoke: bool = False) -> dict:
         xla_ms = None
     if xla_ms is not None:
         out[f"{tag}_vs_xla"] = round(xla_ms / ms, 2)
+    return out
+
+
+def measure_backend_row(logn: int, backend: str,
+                        smoke: bool = False) -> dict:
+    """One heterogeneous-backend reach row (docs/BACKENDS.md): the same
+    pi-layout c2c shape the n2^K rows measure, planned under an
+    EXPLICIT backend plan-key token — ``gpu`` rows serve the portable
+    Pallas rows kernel (interpret mode on non-GPU hosts, which is why
+    these rows stay at BACKEND_ROW_LOGNS in every tier), ``cpu-native``
+    rows serve the ctypes pthreads harness when libpifft.so is present
+    and its numpy stand-in (ONE plans.warn) when it is not.  Timing is
+    single-shot: the row's value is the exercised plumbing — the
+    per-backend cache token, the backend-aware roofline ceiling, and
+    the gpu2^K_* / cpun2^K_* names the analyze loader maps back onto
+    Sample.backend — not the number.  Best-effort like every reach
+    row: a failed cell drops its fields, not the bench."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs87project_msolano2_tpu import plans
+    from cs87project_msolano2_tpu.resilience import classify, maybe_fault
+    from cs87project_msolano2_tpu.utils.roofline import (
+        backend_peak_bytes_per_s,
+        roofline_utilization,
+    )
+
+    nn = 1 << logn
+    tag = f"{BACKEND_ROW_PREFIX[backend]}2^{logn}"
+    try:
+        key = plans.make_key(nn, layout="pi", backend=backend)
+        plan = plans.get_plan(key)
+        k0 = jax.random.PRNGKey(13)
+        xr = jax.random.normal(k0, (nn,), jnp.float32)
+        xi = jax.random.normal(jax.random.fold_in(k0, 1), (nn,),
+                               jnp.float32)
+
+        def run_cell():
+            maybe_fault("bench")  # resilience injection site
+            return _smoke_ms(plan.fn, xr, xi)
+
+        ms = _retry(run_cell, smoke=True,
+                    label=f"{backend} row n={nn}")
+    except Exception as e:
+        plans.warn(f"{backend} 2^{logn} not measured "
+                   f"({classify(e).value} {type(e).__name__}: "
+                   f"{str(e)[:200]})")
+        return {}
+    out = {f"{tag}_ms": round(ms, 4),
+           f"{tag}_gflops": round(
+               5.0 * nn * np.log2(nn) / (ms * 1e-3) / 1e9, 3),
+           f"{tag}_plan": plan.describe(),
+           f"{tag}_backend": backend}
+    if plan.degraded:
+        out[f"{tag}_degraded"] = True
+    # the ceiling this row reads against is its OWN backend's — the
+    # `make backend-smoke` gate asserts the gpu and cpu-native rows
+    # carry DISTINCT peaks (the whole point of rule PIF122)
+    peak = backend_peak_bytes_per_s(backend, key.device_kind)
+    if peak is not None:
+        out[f"{tag}_peak_gbps"] = round(peak / 1e9, 1)
+    util = roofline_utilization(nn, ms, key.device_kind, 0,
+                                backend=backend)
+    if util is not None:
+        out[f"{tag}_roofline_util"] = round(util, 6)
+    if smoke:
+        from cs87project_msolano2_tpu.utils.verify import (
+            pi_layout_to_natural,
+        )
+
+        yr, yi = plan.execute(np.asarray(xr), np.asarray(xi))
+        got = pi_layout_to_natural(np.asarray(yr) + 1j * np.asarray(yi))
+        ref = np.fft.fft(np.asarray(xr, np.complex128)
+                         + 1j * np.asarray(xi, np.complex128))
+        out[f"{tag}_parity_relerr"] = float(
+            np.max(np.abs(got - ref)) / np.max(np.abs(ref)))
     return out
 
 
@@ -1193,7 +1284,8 @@ def main(argv=None) -> int:
     def flagship_cell():
         tpu_ms, plan = measure_tpu_ms(n, smoke=args.smoke)
         out = {"tpu_ms": tpu_ms, "plan": plan.describe(),
-               "device_kind": plan.key.device_kind}
+               "device_kind": plan.key.device_kind,
+               "backend": getattr(plan.key, "backend", "tpu")}
         if plan.degraded:
             out["degraded"] = True
         return out
@@ -1255,6 +1347,19 @@ def main(argv=None) -> int:
     large.update(cell("conv_np",
                       lambda: measure_conv_np_row(smoke=args.smoke),
                       probe_n=3 * (1 << (8 if args.smoke else 18))))
+    # the heterogeneous-backend rows (docs/BACKENDS.md): bounded n in
+    # EVERY tier — the gpu rung interprets on non-GPU hosts and the
+    # cpu-native rung is a correctness/plumbing rail, so hero sizes
+    # would measure the harness, not the backend
+    for logn in BACKEND_ROW_LOGNS:
+        for bk in BACKEND_ROW_BACKENDS:
+            btag = f"{BACKEND_ROW_PREFIX[bk]}2^{logn}"
+            brow = cell(btag,
+                        lambda logn=logn, bk=bk: measure_backend_row(
+                            logn, bk, smoke=args.smoke),
+                        probe_n=1 << logn)
+            degraded_rows |= bool(brow.get(f"{btag}_degraded"))
+            large.update(brow)
     if args.smoke:
         # the interpret-safe sixstep cell (docs/KERNELS.md): rides only
         # in smoke mode — on hardware the 2^25..2^27 rows above exercise
@@ -1309,7 +1414,8 @@ def main(argv=None) -> int:
     if ceil is not None:
         record["roofline_ceiling"] = round(ceil, 3)
     util = roofline_utilization(n, tpu_ms, flagship["device_kind"],
-                                passes or 0)
+                                passes or 0,
+                                backend=flagship.get("backend", "tpu"))
     if util is not None:
         record["roofline_util"] = round(util, 3)
         if ceil:

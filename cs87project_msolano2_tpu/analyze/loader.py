@@ -130,6 +130,11 @@ class Sample:
     #: sample (and every pre-mesh committed round) stays None
     device: Optional[str] = None
     protocol: str = "json"
+    #: the backend plan axis (docs/BACKENDS.md): per-backend rows
+    #: (``gpu2^K_*``, ``cpun2^K_*``) carry their tag, and every record
+    #: that predates the axis — the whole committed BENCH_r01-r06
+    #: trajectory — backfills "tpu", the only family those rounds ran
+    backend: str = "tpu"
 
 
 @dataclasses.dataclass
@@ -389,6 +394,14 @@ _OP_PREFIX = {"conv": "conv", "corr": "corr", "solve": "solve",
 #: per-protocol serve-load scalars (docs/SERVING.md "The wire"): the
 #: dialect rides the metric name exactly as the op does for op rows
 _SERVE_LOAD_METRIC = re.compile(r"^serve_load_([a-z0-9]+)_p99_ms$")
+#: per-backend row prefixes (docs/BACKENDS.md): bench emits one row
+#: set per non-default backend beside the TPU cells — ``gpu2^K_*``
+#: (backend "gpu") and ``cpun2^K_*`` (backend "cpu-native"); the tag
+#: rides the metric name exactly as the precision mode does, and no
+#: prefix collides with the existing patterns (``n``/``rfft``/op
+#: names/``bf16`` etc. share no leading token with ``gpu``/``cpun``)
+_BACKEND_METRIC = re.compile(r"^(gpu|cpun)2\^(\d+)_")
+_BACKEND_PREFIX = {"gpu": "gpu", "cpun": "cpu-native"}
 
 
 def bench_samples(rnd: BenchRound) -> list:
@@ -428,6 +441,7 @@ def bench_samples(rnd: BenchRound) -> list:
         domain = "c2c"
         precision = "split3"
         op = "fft"
+        backend = "tpu"
         m = _LOGN_METRIC.match(name)
         if m is None:
             m = _RFFT_METRIC.match(name)
@@ -461,13 +475,19 @@ def bench_samples(rnd: BenchRound) -> list:
                     op = _OP_PREFIX[om.group(1)]
                     domain = "r2c"
                     n = int(om.group(2))
+        if m is None and n is None:
+            bm = _BACKEND_METRIC.match(name)
+            if bm is not None:
+                backend = _BACKEND_PREFIX[bm.group(1)]
+                n = 1 << int(bm.group(2))
         values = val if isinstance(val, list) else [val]
         for rep, v in enumerate(values):
             out.append(Sample(
                 source="bench", metric=name, value=v, n=n,
                 rep=rep if isinstance(val, list) else None,
                 round_index=rnd.index, fingerprint=rnd.fingerprint,
-                domain=domain, precision=precision, op=op))
+                domain=domain, precision=precision, op=op,
+                backend=backend))
     # per-cell serve_load rows (docs/SERVING.md "The wire"): one
     # sample per (protocol, process, rps) SLO cell, dialect-tagged —
     # rows predating the protocol axis backfill "json"

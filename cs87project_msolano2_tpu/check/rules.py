@@ -847,6 +847,89 @@ class HardCodedDtypeCast(Rule):
         return name if target in dtypes else None
 
 
+@register
+class BackendUnawareCeiling(Rule):
+    id = "PIF122"
+    name = "backend-unaware-ceiling"
+    summary = ("roofline utilization computed without a backend= "
+               "kwarg (or against the raw TPU HBM table) on the "
+               "measurement/serving surface — a gpu or cpu-native "
+               "figure silently read against the TPU peak")
+    invariant = ("the roofline figure is the paper's honesty contract "
+                 "(docs/BACKENDS.md): utilization is achieved bytes/s "
+                 "over the ceiling of the hardware that SERVED the "
+                 "measurement.  With the backend plan axis, a call "
+                 "that defaults backend='tpu' — or reaches for "
+                 "hbm_peak_bytes_per_s directly — divides a gpu or "
+                 "cpu-native time by a TPU HBM peak, which inflates "
+                 "or deflates the figure by up to ~60x (3350 vs 50 "
+                 "GB/s) and no test fails: the number is merely "
+                 "wrong.  Every utilization call on the surfaces "
+                 "that PUBLISH figures must pass backend= "
+                 "explicitly; ceiling lookups go through "
+                 "backend_peak_bytes_per_s.  This rule is strict: "
+                 "a suppression must carry a reason (a reasonless "
+                 "noqa cannot vouch for a published number)")
+    #: strict noqa (the PIF503 discipline): blanket tags never silence
+    #: this rule and an explicit noqa[PIF122] only counts with a reason
+    blanket_suppressible = False
+    default_config = {
+        # an INCLUDE list like PIF107-111's: the surfaces that PUBLISH
+        # utilization figures — the bench, the harness sweeps, and the
+        # serving/fleet/analyze layers that would re-read them
+        "paths": ("*bench.py", "*/harness/*", "*/serve/*", "*/fleet/*",
+                  "*/analyze/*", "*/apps/*", "*/hw/*"),
+        # the model itself and the inventory's backend dispatch are
+        # the sanctioned users of the raw TPU table
+        "exempt": ("*utils/roofline.py", "*hw/inventory.py"),
+        # utilization entry points, matched by dotted-name suffix (the
+        # callers import them bare or module-qualified)
+        "util_suffixes": ("roofline_utilization",
+                          "spectral_roofline_utilization"),
+        # the raw TPU-table lookup callers must NOT touch (use
+        # backend_peak_bytes_per_s, which dispatches per tag)
+        "peak_suffixes": ("hbm_peak_bytes_per_s",),
+    }
+
+    def check(self, ctx: FileContext, config: dict) -> Iterator:
+        import fnmatch
+        import os
+
+        norm = os.path.abspath(ctx.path).replace(os.sep, "/")
+        if not any(fnmatch.fnmatch(norm, pat)
+                   for pat in config["paths"]):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve_call(node) or dotted_name(node.func) \
+                or ""
+            last = target.split(".")[-1]
+            if last in config["peak_suffixes"]:
+                yield self.finding(
+                    ctx, node,
+                    f"raw TPU-table lookup `{last}(...)` on the "
+                    f"measurement surface — go through "
+                    f"backend_peak_bytes_per_s(backend, device_kind) "
+                    f"so the ceiling follows the plan's backend axis "
+                    f"(docs/BACKENDS.md), or justify with a reasoned "
+                    f"# pifft: noqa[PIF122]: <why>")
+                continue
+            if last not in config["util_suffixes"]:
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs splat: not statically analyzable
+            if not any(kw.arg == "backend" for kw in node.keywords):
+                yield self.finding(
+                    ctx, node,
+                    f"`{last}(...)` without backend= — the figure "
+                    f"silently reads against the TPU HBM table even "
+                    f"when a gpu/cpu-native plan served the "
+                    f"measurement; pass backend=<key.backend> (or "
+                    f"justify with a reasoned "
+                    f"# pifft: noqa[PIF122]: <why>)")
+
+
 def _is_broad_handler(type_node, broad) -> bool:
     """Shared broad-handler predicate (PIF105 and PIF501)."""
     if type_node is None:
@@ -1034,9 +1117,13 @@ class PlanKeyFieldCoverage(Rule):
         # became load-bearing with the any-length ladder (an r2c and a
         # c2c plan at the same non-pow2 n dispatch DIFFERENT variants
         # — docs/PLANS.md "Arbitrary n"); a defaulted domain would
-        # alias them onto one cache entry
+        # alias them onto one cache entry.  "backend" joined with the
+        # heterogeneous backend plane (docs/BACKENDS.md): the same
+        # (n, layout) key dispatches DIFFERENT lowering families per
+        # backend tag, so a defaulted backend would hand a gpu mesh
+        # member a tpu-tuned winner
         "fields": ("device_kind", "n", "batch", "layout", "dtype",
-                   "precision", "domain"),
+                   "precision", "domain", "backend"),
     }
 
     def check(self, ctx: FileContext, config: dict) -> Iterator:

@@ -18,6 +18,7 @@
                                          [--smoke] [-n N]
     python -m cs87project_msolano2_tpu multichip smoke [-n N]
                                          [--deadline S] [--stall S]
+    python -m cs87project_msolano2_tpu hw probe [--json | -v | --cores]
 
 Non-test runs print one TSV row `n p total_ms funnel_ms tube_ms` (header
 unless -o) — the exact contract the harness and analysis layers consume
@@ -83,6 +84,13 @@ all_to_all on a simulated 8-device mesh and asserts the whole recovery
 loop — supervised abort, fallback consensus, the communication-free
 escape, a bit-identical result, schema-valid events — the second half
 of the `make multichip-smoke` CI gate.
+
+The `hw` subcommand fronts the hardware-inventory subsystem
+(docs/BACKENDS.md): `probe` reports the host's platform, backend tag,
+device kind/count, CPU cores, native per-`p` capacities and the
+bandwidth ceiling table — `--json` emits the schema'd DeviceInventory
+record the `make backend-smoke` gate validates; the bare form keeps
+the legacy `probes` module's human one-liner.
 """
 
 from __future__ import annotations
@@ -705,6 +713,21 @@ def wire_main(argv) -> int:
     return 0
 
 
+def hw_main(argv) -> int:
+    """``hw probe`` — the device-inventory front (docs/BACKENDS.md).
+    Delegates to :func:`hw.inventory.main`, the same entry point
+    ``python -m cs87project_msolano2_tpu.hw.inventory`` (and the
+    deprecated ``probes`` shim) serve, so the three spellings cannot
+    drift apart."""
+    if not argv or argv[0] != "probe":
+        print("usage: cs87project_msolano2_tpu hw probe "
+              "[--json | -v | --cores]", file=sys.stderr)
+        return 2
+    from .hw.inventory import main as inventory_main
+
+    return inventory_main(argv[1:])
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -716,6 +739,8 @@ def main(argv=None) -> int:
         return faults_main(argv[1:])
     if argv and argv[0] == "multichip":
         return multichip_main(argv[1:])
+    if argv and argv[0] == "hw":
+        return hw_main(argv[1:])
     if argv and argv[0] == "obs":
         return obs_main(argv[1:])
     if argv and argv[0] == "analyze":

@@ -252,6 +252,34 @@ class CanaryController:
                 warn(f"fleet: {outcome.reason}")
                 return outcome
 
+            # BACKEND GUARD (docs/BACKENDS.md): a winner raced on one
+            # backend family is meaningless on another — the variant
+            # namespaces are disjoint and the timings incomparable —
+            # so a canary whose device tag differs from the key's
+            # backend axis REFUSES the race outright, before any
+            # timing spends a cycle.  Same abort discipline as the
+            # injection probe above: announced, counted, promote=False.
+            canary_backend = (getattr(self.mesh.device(canary_id),
+                                      "backend", "tpu")
+                              if canary_id is not None else None)
+            key_backend = getattr(key, "backend", "tpu")
+            if canary_backend is not None \
+                    and canary_backend != key_backend:
+                outcome.reason = (
+                    f"canary race refused (backend_mismatch): canary "
+                    f"{canary_id} is {canary_backend!r} but the key's "
+                    f"backend axis is {key_backend!r} — a winner raced "
+                    f"there would be promoted onto hardware it was "
+                    f"never timed on")
+                metrics.inc("pifft_fleet_canary_aborted_total",
+                            kind="backend_mismatch")
+                events.emit("fleet_canary", cell={"n": key.n},
+                            shape=label, promote=False, p_value=1.0,
+                            aborted="backend_mismatch",
+                            device=canary_id)
+                warn(f"fleet: {outcome.reason}")
+                return outcome
+
             samples_out: list = []
             if timer is None:
                 planes = self._shadow_planes(key, group, mirror)

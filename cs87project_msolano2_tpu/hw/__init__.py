@@ -1,0 +1,20 @@
+"""The hardware plane (docs/BACKENDS.md): device discovery, per-backend
+bandwidth ceilings, and the non-TPU lowering families.
+
+The paper implements the same pi-FFT on three kinds of hardware behind
+one harness, with a capacity-probing layer per backend; this package is
+that layer reborn at plan-stack scale:
+
+* ``inventory`` — :class:`DeviceInventory`: one typed probe of platform,
+  device kind, core count, native capacities, and the per-backend
+  bandwidth table (absorbs the old top-level ``probes`` module).
+* ``lowering``  — the gpu / cpu-native candidate ladders, static
+  defaults, and executor builders ``plans.ladder`` dispatches to for
+  keys whose ``backend`` axis names a non-TPU family.
+* ``smoke``     — the CI gate: a two-backend virtual mesh serving mixed
+  traffic with a cross-backend failover mid-run (``make backend-smoke``).
+"""
+
+from __future__ import annotations
+
+from .inventory import DeviceInventory, probe  # noqa: F401

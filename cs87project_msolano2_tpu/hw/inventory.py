@@ -1,0 +1,201 @@
+"""Device inventory (L3 probes, unified) — the reference ships standalone
+probe executables (how-many-cpu-cores, cpu/pthreads/how-many-cpu-cores.c:
+19-32, and how-many-concurrent-blocks, gpu/cuda/how-many-concurrent-
+blocks.cu:34-176) whose output the harness uses to clip its p-sweep.
+This module is that layer grown into ONE typed answer per process: what
+hardware is here, which backend tag it serves (plans.core.BACKENDS), how
+many cores/devices, what the native dispatch table can absorb, and the
+per-backend memory-bandwidth ceiling the roofline model divides by.
+
+    python -m cs87project_msolano2_tpu.probes        # device count (shim)
+    pifft hw probe [--json]                          # the full inventory
+
+``utils.roofline`` reads its per-backend ceilings from here
+(``peak_bytes_per_s``); the legacy TPU table stays in roofline (the
+device_kind-matched HBM entries) and this module owns the gpu/cpu rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Optional
+
+#: schema version of the probe JSON (``pifft hw probe --json``) — bump
+#: on any field rename/removal; additions are compatible
+INVENTORY_SCHEMA = 1
+
+#: peak memory bandwidth by GPU device-kind substring (GB/s, datasheet
+#: sustained-HBM/GDDR figures) — matched longest-substring-first against
+#: the lowercased device kind, like roofline's TPU table; "default" is
+#: the unmatched-GPU fallback so util_of_ceiling is never silently a
+#: TPU number on a GPU (check rule PIF122)
+GPU_PEAK_GBPS = {
+    "h100": 3350,
+    "a100 80gb": 2039,
+    "a100": 1555,
+    "v100": 900,
+    "p100": 732,
+    "t4": 320,
+    "l4": 300,
+    "default": 900,
+}
+
+#: host DRAM ceiling for the cpu-native ctypes rung (GB/s) — a
+#: dual-channel DDR4/DDR5 ballpark; honest enough for the roofline's
+#: order-of-magnitude "are we memory-bound" question, and overridable
+#: per-machine via PIFFT_DRAM_GBPS when a real STREAM number is known
+DRAM_DEFAULT_GBPS = 50
+
+
+def how_many_tpu_devices(verbose: bool = False) -> int:
+    import jax
+
+    devs = jax.devices()
+    if verbose:
+        for d in devs:
+            print(f"device {d.id}: {d.device_kind} "
+                  f"(platform {d.platform}, process {d.process_index})")
+        print(f"addressable: {jax.local_device_count()}, "
+              f"global: {jax.device_count()}, "
+              f"processes: {jax.process_count()}")
+    return len(devs)
+
+
+def cpu_cores() -> int:
+    """Core count via the native probe when the C core is built, the
+    portable os.cpu_count otherwise — the reference's
+    how-many-cpu-cores, never an error."""
+    from ..backends.cpu import num_cores
+
+    return num_cores()
+
+
+def native_capacities() -> dict:
+    """variant -> max sensible p from the native dispatch table
+    (pifft_capacity), or {} when the C core is absent/unbuildable —
+    probing must never be the thing that crashes (the reference's
+    Makefiles degrade to a friendly message; so do we)."""
+    caps = {}
+    for variant in ("serial", "pthreads"):
+        try:
+            from ..backends.cpu import NativeBackend
+
+            caps[variant] = NativeBackend(variant).capacity()
+        except (RuntimeError, ValueError, OSError):
+            # no make/cc, or an unbuildable tree: the inventory simply
+            # has no native capacity rows
+            return {}
+    return caps
+
+
+def peak_bytes_per_s(backend: str,
+                     device_kind: str = "") -> Optional[float]:
+    """The memory-bandwidth ceiling (bytes/s) the roofline model divides
+    by for one backend tag, or None where timings are meaningless
+    (cpu-interpret) or the device kind is unknown (tpu with no table
+    row).  THE per-backend ceiling source — ``utils.roofline`` delegates
+    here for every non-default backend (docs/BACKENDS.md)."""
+    import os
+
+    if backend == "tpu":
+        from ..utils.roofline import hbm_peak_bytes_per_s
+
+        return hbm_peak_bytes_per_s(device_kind)
+    if backend == "gpu":
+        kind = device_kind.lower()
+        best = None
+        for name, gbps in GPU_PEAK_GBPS.items():
+            if name != "default" and name in kind:
+                if best is None or len(name) > len(best[0]):
+                    best = (name, gbps)
+        gbps = best[1] if best else GPU_PEAK_GBPS["default"]
+        return gbps * 1e9
+    if backend == "cpu-native":
+        env = os.environ.get("PIFFT_DRAM_GBPS", "").strip()
+        try:
+            gbps = float(env) if env else DRAM_DEFAULT_GBPS
+        except ValueError:
+            gbps = DRAM_DEFAULT_GBPS
+        return gbps * 1e9
+    return None  # cpu-interpret: timings are meaningless, so is a ceiling
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInventory:
+    """One process's answer to the paper's "what machine is this
+    really?" — the typed union of the old probe executables.
+
+    platform: jax.default_backend() verbatim; backend: the BACKENDS tag
+    plans.make_key would stamp (plans.core.current_backend); device_kind
+    the plan-cache identity; bandwidth: backend tag -> ceiling bytes/s
+    (None where unknowable), covering every tag so cross-backend
+    comparisons read from one table."""
+
+    platform: str
+    backend: str
+    device_kind: str
+    device_count: int
+    cpu_cores: int
+    capacities: dict
+    bandwidth: dict
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["schema"] = INVENTORY_SCHEMA
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, indent=1)
+
+
+def probe() -> DeviceInventory:
+    """Discover the current process's inventory.  Every sub-probe is
+    individually graceful: a missing C toolchain or an unreachable
+    accelerator yields empty/None rows, never an exception."""
+    import jax
+
+    from ..plans.core import current_backend, current_device_kind
+
+    kind = current_device_kind()
+    try:
+        count = len(jax.devices())
+    except RuntimeError:
+        count = 0
+    return DeviceInventory(
+        platform=jax.default_backend(),
+        backend=current_backend(),
+        device_kind=kind,
+        device_count=count,
+        cpu_cores=cpu_cores(),
+        capacities=native_capacities(),
+        bandwidth={b: peak_bytes_per_s(b, kind)
+                   for b in ("tpu", "gpu", "cpu-interpret", "cpu-native")},
+    )
+
+
+def main(argv=None) -> int:
+    """The probe CLI — serves both entry points: the legacy
+    ``python -m cs87project_msolano2_tpu.probes`` contract (-v,
+    --cores) and the full ``pifft hw probe [--json]`` inventory."""
+    ap = argparse.ArgumentParser(description="capacity probes")
+    ap.add_argument("-v", action="store_true", help="verbose device info")
+    ap.add_argument("--cores", action="store_true",
+                    help="print CPU core count (native probe) instead")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full typed inventory as JSON")
+    args = ap.parse_args(argv)
+    if args.json:
+        print(probe().to_json())
+        return 0
+    if args.cores:
+        print(cpu_cores())
+        return 0
+    print(how_many_tpu_devices(args.v))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

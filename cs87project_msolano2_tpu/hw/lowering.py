@@ -1,0 +1,361 @@
+"""Non-TPU lowerings of the pi-FFT family — the rungs behind the plan
+backend axis (docs/BACKENDS.md).  ``plans.ladder`` dispatches here for
+keys whose ``backend`` is "gpu" or "cpu-native"; the variant namespace
+is DISJOINT from the TPU ladder's, so a cross-backend cache entry can
+never hand either ladder a foreign variant.
+
+GPU family ("gpu" keys):
+
+* ``gpu-rows`` — a portable Pallas radix-2 DIF kernel over row blocks:
+  the whole log2(n)-stage transform unrolled in one kernel body with a
+  precomputed per-stage twiddle stack, pi-layout (bit-reversed) output
+  like every kernel-native path.  Uses only the backend-agnostic
+  ``pl.pallas_call``/``pl.BlockSpec`` surface (no TPU memory spaces),
+  so it lowers through Pallas-on-Triton/Mosaic-GPU where a GPU is
+  attached and runs in interpret mode on CPU-only CI — the same
+  keeps-CI-honest discipline as ops.pallas_fft's ``_use_interpret``.
+* ``gpu-jnp``  — the XLA stage path jitted for the gpu backend: the
+  universal fallback rung (any pow2 n, both layouts).
+
+CPU-native family ("cpu-native" keys):
+
+* ``cpu-native`` — the seed ctypes pthreads core (backends.cpu.
+  NativeBackend) wrapped as a REAL ladder rung via ``jax.pure_callback``
+  with the native per-run timers metered into the obs registry.  The
+  virtual-processor count ``p`` is the raced parameter — the paper's
+  p-sweep as a plan axis.  When the shared library is absent (no C
+  toolchain) the rung degrades to the numpy reference with ONE
+  ``plans.warn`` instead of an ImportError (docs/BACKENDS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+from ..plans.core import PlanKey, offline_kind
+
+#: above this n the static gpu default prefers the jnp stage rung — an
+#: interpret-mode unrolled kernel at multi-MB rows costs minutes on CI
+#: for nothing (a real GPU race can still pick gpu-rows past it)
+GPU_ROWS_STATIC_MAX_N = 1 << 14
+#: hard feasibility bound for the unrolled-stage kernel body
+GPU_ROWS_MAX_N = 1 << 18
+
+
+def _pow2(n: int) -> bool:
+    return n >= 1 and not (n & (n - 1))
+
+
+def _nrows(key: PlanKey) -> int:
+    return math.prod(key.batch) or 1
+
+
+def _gpu_attached() -> bool:
+    import jax
+
+    return jax.default_backend() in ("gpu", "cuda", "rocm")
+
+
+# ---------------------------------------------------------------- gpu
+
+def _twiddle_stack(n: int) -> tuple:
+    """(stages, n//2) float32 twiddle planes: row s holds W_m^j for
+    m = n >> s, j < m//2 (zero-padded past it) — the whole DIF
+    schedule's tables as two dense arrays the kernel indexes with
+    static slices."""
+    stages = n.bit_length() - 1
+    twr = np.zeros((stages, max(n // 2, 1)), dtype=np.float32)
+    twi = np.zeros((stages, max(n // 2, 1)), dtype=np.float32)
+    for s in range(stages):
+        m = n >> s
+        half = m // 2
+        j = np.arange(half)
+        w = np.exp(-2j * np.pi * j / m)
+        twr[s, :half] = w.real.astype(np.float32)
+        twi[s, :half] = w.imag.astype(np.float32)
+    return twr, twi
+
+
+def _radix2_kernel(n: int, rows: int):
+    """The unrolled radix-2 DIF body: every stage reshapes the row
+    block to (rows, n//m, m), butterflies the halves, and twists the
+    difference by the stage's twiddle row.  All shapes are static (n
+    and the stage schedule are Python ints), so the body is portable
+    jnp — Triton and interpret mode both lower it."""
+    import jax.numpy as jnp
+
+    stages = n.bit_length() - 1
+
+    def kernel(xr_ref, xi_ref, twr_ref, twi_ref, yr_ref, yi_ref):
+        ar = xr_ref[...]
+        ai = xi_ref[...]
+        m = n
+        for s in range(stages):
+            half = m // 2
+            ar = ar.reshape(rows, n // m, m)
+            ai = ai.reshape(rows, n // m, m)
+            er, eo = ar[:, :, :half], ar[:, :, half:]
+            fr, fo = ai[:, :, :half], ai[:, :, half:]
+            twr = twr_ref[s, :half]
+            twi = twi_ref[s, :half]
+            dr, di = er - eo, fr - fo
+            br = dr * twr - di * twi
+            bi = dr * twi + di * twr
+            ar = jnp.concatenate([er + eo, br], axis=-1).reshape(rows, n)
+            ai = jnp.concatenate([fr + fo, bi], axis=-1).reshape(rows, n)
+            m = half
+        yr_ref[...] = ar
+        yi_ref[...] = ai
+
+    return kernel
+
+
+def fft_rows_gpu(xr, xi, *, block_rows=None, interpret=None):
+    """pi-layout (bit-reversed) FFT of each trailing-axis row through
+    the portable Pallas kernel.  ``block_rows`` groups rows per grid
+    step (None = all rows in one step); ``interpret`` defaults to
+    "no GPU attached" so CPU-only CI exercises the real kernel body."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = not _gpu_attached()
+    shape = xr.shape
+    n = shape[-1]
+    if not _pow2(n) or n < 2:
+        raise ValueError(f"gpu-rows requires a power-of-two n >= 2, "
+                         f"got n={n}")
+    if n > GPU_ROWS_MAX_N:
+        raise ValueError(f"gpu-rows unrolled body bound exceeded "
+                         f"(n={n} > {GPU_ROWS_MAX_N})")
+    rows = math.prod(shape[:-1]) or 1
+    br = block_rows or rows
+    if rows % br:
+        raise ValueError(f"block_rows={br} does not divide rows={rows}")
+    xr2 = jnp.asarray(xr, jnp.float32).reshape(rows, n)
+    xi2 = jnp.asarray(xi, jnp.float32).reshape(rows, n)
+    twr, twi = _twiddle_stack(n)
+    stages, tw_n = twr.shape
+    row_spec = pl.BlockSpec((br, n), lambda i: (i, 0))
+    tw_spec = pl.BlockSpec((stages, tw_n), lambda i: (0, 0))
+    out = pl.pallas_call(
+        _radix2_kernel(n, br),
+        grid=(rows // br,),
+        in_specs=[row_spec, row_spec, tw_spec, tw_spec],
+        out_specs=[pl.BlockSpec((br, n), lambda i: (i, 0)),
+                   pl.BlockSpec((br, n), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, n), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, n), jnp.float32)],
+        interpret=interpret,
+    )(xr2, xi2, jnp.asarray(twr), jnp.asarray(twi))
+    return out[0].reshape(shape), out[1].reshape(shape)
+
+
+# --------------------------------------------------------- cpu-native
+
+#: once-per-process flag for the missing-.so degrade announcement
+_NATIVE_WARNED = [False]
+
+
+@functools.lru_cache(maxsize=1)
+def _native_or_none():
+    """The loaded NativeBackend, or None when the C core is absent —
+    resolved once, announced once (satellite contract: a missing
+    toolchain degrades with one plans.warn, never an ImportError)."""
+    try:
+        from ..backends.cpu import NativeBackend
+
+        b = NativeBackend("pthreads")
+        b.capacity()  # forces the load/build; raises when unbuildable
+        return b
+    except (RuntimeError, ValueError, OSError) as e:
+        if not _NATIVE_WARNED[0]:
+            _NATIVE_WARNED[0] = True
+            from ..plans.core import warn
+
+            warn(f"cpu-native: libpifft.so unavailable "
+                 f"({type(e).__name__}: {str(e)[:120]}); serving the "
+                 f"numpy reference fallback")
+        return None
+
+
+def _pi_permute(x: np.ndarray) -> np.ndarray:
+    """natural-order rows -> pi layout (bit reversal is an involution,
+    so the same gather serves both directions)."""
+    from ..ops.bits import bit_reverse_indices
+
+    return np.take(x, bit_reverse_indices(x.shape[-1]), axis=-1)
+
+
+def _native_rows(xr, xi, n: int, p: int, natural: bool):
+    """Host side of the cpu-native rung: each row through the native
+    pthreads core (pi-layout output, honest native timers metered as
+    pifft_hw_native_ms_total), numpy reference when the .so is absent."""
+    from ..obs import metrics
+
+    x = np.asarray(xr, dtype=np.float32).astype(np.complex64)
+    x.imag = np.asarray(xi, dtype=np.float32)
+    flat = np.ascontiguousarray(x.reshape(-1, n))
+    out = np.empty_like(flat)
+    native = _native_or_none()
+    if native is not None:
+        for i in range(flat.shape[0]):
+            res = native.run(flat[i], p, reps=1)
+            out[i] = res.out
+            metrics.observe("pifft_hw_native_ms", res.total_ms,
+                            backend="cpu-native")
+    else:
+        out = _pi_permute(np.fft.fft(flat, axis=-1).astype(np.complex64))
+    if natural:
+        out = _pi_permute(out)
+    shape = np.shape(xr)
+    return (np.ascontiguousarray(out.real).reshape(shape),
+            np.ascontiguousarray(out.imag).reshape(shape))
+
+
+def native_capacity_p(n: int) -> int:
+    """The largest sensible virtual-processor count for an n-point
+    native run: cores rounded down to a power of two, clipped by the
+    native capacity probe and by n itself — the reference's
+    probe-and-clip rule (run-experiments:42-50) as a plan bound."""
+    from .inventory import cpu_cores
+
+    cores = max(cpu_cores(), 1)
+    native = _native_or_none()
+    if native is not None:
+        cap = native.capacity()
+        if cap:
+            cores = min(cores, cap)
+    p = 1 << max(cores.bit_length() - 1, 0)
+    return max(min(p, n), 1)
+
+
+# ------------------------------------------------- the ladder surface
+
+def candidates(key: PlanKey) -> list:
+    """The ordered (variant, params) race for a gpu / cpu-native key —
+    plans.ladder.candidates delegates here on backend dispatch.  Real
+    even-n domains ride the half-length c2c sub-key exactly like the
+    TPU ladder (the pack wrap is backend-agnostic); non-pow2 n has no
+    entries in either family yet (the any-length variants are
+    TPU/interpret-ladder only — docs/BACKENDS.md)."""
+    from ..plans import ladder
+
+    if key.domain != "c2c" and key.n % 2 == 0:
+        return candidates(ladder.c2c_subkey(key))
+    if key.domain != "c2c" or not _pow2(key.n):
+        return []
+    if key.backend == "gpu":
+        cands = []
+        if 2 <= key.n <= GPU_ROWS_MAX_N:
+            rows = _nrows(key)
+            cands.append(("gpu-rows", {"block_rows": None}))
+            if rows % 8 == 0:
+                cands.append(("gpu-rows", {"block_rows": 8}))
+        if key.layout == "natural":
+            cands.append(("gpu-jnp", {}))
+        return cands
+    # cpu-native: the paper's p-sweep as the raced axis — capacity
+    # first (expected winner on a multicore host), then one halving,
+    # then the serial baseline so the record shows the margin
+    cap = native_capacity_p(key.n)
+    ps = sorted({cap, max(cap // 2, 1), 1}, reverse=True)
+    return [("cpu-native", {"p": p}) for p in ps]
+
+
+def static_default(key: PlanKey):
+    """Measured-good (variant, params) for a gpu / cpu-native key when
+    nothing is tuned/cached — mirrors plans.ladder.static_default's
+    contract (never serves a plan that raises on first execute)."""
+    from ..plans import ladder
+
+    if key.domain != "c2c" and key.n % 2 == 0:
+        return static_default(ladder.c2c_subkey(key))
+    if key.domain != "c2c" or not _pow2(key.n):
+        raise ValueError(
+            f"backend={key.backend!r} serves power-of-two c2c (and the "
+            f"even real domains riding it) only — any-length n={key.n} "
+            f"rides the tpu/cpu-interpret ladder (docs/BACKENDS.md)")
+    if key.backend == "cpu-native":
+        return "cpu-native", {"p": native_capacity_p(key.n)}
+    # gpu: the kernel rung at kernel-friendly sizes; offline (no GPU
+    # attached) the jnp stage rung keeps interpret cost off the static
+    # path at large n, same policy as the TPU ladder's offline branch
+    small = 2 <= key.n <= GPU_ROWS_STATIC_MAX_N
+    large_ok = (2 <= key.n <= GPU_ROWS_MAX_N
+                and not offline_kind(key.device_kind))
+    if small or large_ok or key.layout == "pi":
+        if not 2 <= key.n <= GPU_ROWS_MAX_N:
+            raise ValueError(
+                f"gpu-rows bound exceeded for pi layout (n={key.n} not "
+                f"in [2, {GPU_ROWS_MAX_N}]); no gpu rung serves it")
+        return "gpu-rows", {"block_rows": None}
+    return "gpu-jnp", {}
+
+
+def build_executor(key: PlanKey, variant: str, params: dict):
+    """The traceable (xr, xi) -> (yr, yi) executor for one gpu /
+    cpu-native ladder entry — plans.ladder.build_executor delegates
+    here on backend dispatch.  Even-n real domains wrap the
+    half-length c2c executor in the pack/Hermitian passes exactly like
+    the TPU ladder."""
+    if key.domain != "c2c" and key.n % 2 == 0:
+        from ..models import real as real_mod
+        from ..plans import ladder
+
+        inner = build_executor(ladder.c2c_subkey(key), variant, params)
+        if key.domain == "r2c":
+            return real_mod.rfft_executor(inner, key.n)
+        return real_mod.irfft_executor(inner, key.n)
+    natural = key.layout == "natural"
+    n = key.n
+
+    if variant == "gpu-jnp":
+        if not natural:
+            raise ValueError("the jnp stage path only produces natural "
+                             "order")
+        from ..models.fft import fft_planes
+
+        return fft_planes
+
+    if variant == "gpu-rows":
+        block_rows = params.get("block_rows")
+
+        def gpu_run(xr, xi):
+            yr, yi = fft_rows_gpu(xr, xi, block_rows=block_rows)
+            if not natural:
+                return yr, yi
+            import jax.numpy as jnp
+
+            from ..ops.bits import bit_reverse_indices
+
+            idx = jnp.asarray(bit_reverse_indices(n))
+            return jnp.take(yr, idx, axis=-1), jnp.take(yi, idx, axis=-1)
+
+        return gpu_run
+
+    if variant == "cpu-native":
+        if not _pow2(n):
+            raise ValueError(f"cpu-native requires a power-of-two n, "
+                             f"got n={n}")
+        p = int(params.get("p") or 1)
+
+        def native_run(xr, xi):
+            import jax
+            import jax.numpy as jnp
+
+            shape = jnp.shape(xr)
+            result_shape = (jax.ShapeDtypeStruct(shape, jnp.float32),
+                            jax.ShapeDtypeStruct(shape, jnp.float32))
+            return jax.pure_callback(
+                functools.partial(_native_rows, n=n, p=p,
+                                  natural=natural),
+                result_shape, xr, xi)
+
+        return native_run
+
+    raise ValueError(f"unknown {key.backend} plan variant {variant!r}")

@@ -1,0 +1,317 @@
+"""The heterogeneous-backend acceptance drive (``make backend-smoke``):
+the whole backend plane, end-to-end, on CPU (docs/BACKENDS.md).
+
+    python3 -m cs87project_msolano2_tpu.hw.smoke
+
+Phases — every transition asserted, not just exercised:
+
+A. PLAN-KEY AXIS — a v5 token round-trips through
+   ``PlanKey.from_token``; a v4 token (no backend field) is REFUSED;
+   a made-up backend tag is refused at construction; two plans that
+   differ only in backend land in the shared store under DISTINCT
+   tokens and each key reads ITS winner back (per-backend cached
+   winners); a v4 token hand-merged into the store is skipped with
+   the once-per-store warn, never served and never crashed on.
+B. INVENTORY — ``pifft hw probe --json`` (through the real CLI entry)
+   emits the schema'd DeviceInventory record: typed fields, a backend
+   tag from plans.core.BACKENDS, the per-backend bandwidth table.
+C. CEILINGS — the per-backend roofline peaks are DISTINCT: the gpu
+   table's figure is not the cpu-native DRAM figure, and neither is
+   silently the TPU HBM table (PIF122's whole point).
+D. MESH — a two-backend virtual mesh (cpu-interpret + gpu) serves
+   parity-checked answers from BOTH families; a mid-run device kill
+   re-routes across the backend boundary with zero drops, the
+   ``failover:backend:<tag>`` trail entry, ``degraded: true`` on the
+   re-routed responses, and the cross-backend failover metric/event.
+E. BENCH ROWS — ``bench.measure_backend_row`` emits gpu2^K_* and
+   cpun2^K_* rows (the cpu-native one degrading gracefully to its
+   numpy stand-in when libpifft.so is absent) and the analyze loader
+   parses them back onto Sample.backend, backfilling "tpu" for
+   legacy row names.
+
+Every event emitted across the run is schema-validated at the end.
+Prints a JSON summary; exit 0 only if every assertion held.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from .. import plans
+from ..obs import events, metrics
+from ..plans import cache
+from ..plans.core import BACKENDS, PlanKey
+from ..resilience import inject
+from ..serve.mesh import MeshConfig, MeshDispatcher
+from ..serve.shapes import ShapeSpec
+from ..utils.roofline import backend_peak_bytes_per_s
+
+#: the served shape: small enough that the gpu family's Pallas rows
+#: kernel compiles in interpret mode in CI seconds
+N = 256
+
+
+def _say(msg: str) -> None:
+    print(f"[backend-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def _phase_a() -> dict:
+    """Plan-key backend axis: v5 round-trip, v4 refusal, per-backend
+    winners under distinct tokens in ONE device-kind store."""
+    key_cpu = plans.make_key(N, layout="pi", backend="cpu-interpret")
+    key_gpu = plans.make_key(N, layout="pi", backend="gpu")
+
+    # v5 tokens round-trip and differ ONLY in the backend field
+    for key in (key_cpu, key_gpu):
+        assert PlanKey.from_token(key.token()) == key, key
+    assert key_cpu.token() != key_gpu.token()
+    assert json.loads(key_gpu.token())["v"] == 5
+
+    # a v4 token (the pre-backend schema) is refused, not misread
+    v4 = json.loads(key_cpu.token())
+    v4.pop("backend")
+    v4["v"] = 4
+    try:
+        PlanKey.from_token(json.dumps(v4, sort_keys=True))
+    except ValueError as e:
+        assert "schema 4" in str(e), e
+    else:
+        raise AssertionError("v4 token must be refused")
+
+    # an unknown backend tag is refused at construction
+    try:
+        plans.make_key(N, layout="pi", backend="phi")
+    except ValueError as e:
+        assert "phi" in str(e), e
+    else:
+        raise AssertionError("backend='phi' must be refused")
+
+    # per-backend winners: same n/layout, different backend => distinct
+    # store tokens, distinct lowering families, each read back intact
+    plan_cpu = plans.get_plan(key_cpu)
+    plan_gpu = plans.get_plan(key_gpu)
+    assert plan_gpu.variant.startswith("gpu"), plan_gpu.variant
+    assert plan_cpu.variant != plan_gpu.variant, \
+        (plan_cpu.variant, plan_gpu.variant)
+    cache.store(plan_cpu, persist=True)
+    cache.store(plan_gpu, persist=True)
+    entries = cache.disk_entries(key_cpu.device_kind)
+    assert key_cpu.token() in entries and key_gpu.token() in entries, \
+        sorted(entries)
+    cache.clear(memory=True, disk=False)
+    for key, variant in ((key_cpu, plan_cpu.variant),
+                        (key_gpu, plan_gpu.variant)):
+        hit = cache.lookup(key)
+        assert hit is not None and hit.variant == variant, (key, hit)
+
+    # a hand-merged v4 token in the store is SKIPPED (warned once),
+    # while every current entry still serves
+    path = cache.store_path(key_cpu.device_kind)
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    stale_token = json.dumps(v4, sort_keys=True, separators=(",", ":"))
+    data["plans"][stale_token] = {"variant": "rows", "params": {}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh)
+    cache.clear(memory=True, disk=False)
+    kept = cache.disk_entries(key_cpu.device_kind)
+    assert stale_token not in kept, "stale v4 token must be skipped"
+    assert key_gpu.token() in kept, "current tokens must survive"
+    return {"tokens": 2, "cpu_variant": plan_cpu.variant,
+            "gpu_variant": plan_gpu.variant}
+
+
+def _phase_b() -> dict:
+    """``pifft hw probe --json`` through the real CLI entry point,
+    schema-validated field by field."""
+    from ..cli import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["hw", "probe", "--json"])
+    assert rc == 0, f"hw probe rc={rc}"
+    rec = json.loads(buf.getvalue())
+    required = {"schema": int, "platform": str, "backend": str,
+                "device_kind": str, "device_count": int,
+                "cpu_cores": int, "capacities": dict,
+                "bandwidth": dict}
+    for field, typ in required.items():
+        assert isinstance(rec.get(field), typ), \
+            f"inventory field {field!r}: {rec.get(field)!r}"
+    assert rec["schema"] == 1
+    assert rec["backend"] in BACKENDS, rec["backend"]
+    assert rec["device_count"] >= 1 and rec["cpu_cores"] >= 1
+    assert set(rec["bandwidth"]) == set(BACKENDS), rec["bandwidth"]
+    return {"backend": rec["backend"], "platform": rec["platform"]}
+
+
+def _phase_c() -> dict:
+    """Distinct per-backend bandwidth ceilings (PIF122's raison
+    d'etre: a gpu or cpu-native figure must never silently read
+    against the TPU HBM table)."""
+    gpu = backend_peak_bytes_per_s("gpu", "")
+    dram = backend_peak_bytes_per_s("cpu-native", "")
+    tpu = backend_peak_bytes_per_s("tpu", "TPU v4")
+    assert gpu and dram and tpu, (gpu, dram, tpu)
+    assert len({gpu, dram, tpu}) == 3, \
+        f"backend ceilings must be distinct: {(gpu, dram, tpu)}"
+    # the gpu table resolves named parts above the default
+    assert backend_peak_bytes_per_s("gpu", "NVIDIA H100 80GB HBM3") \
+        > backend_peak_bytes_per_s("gpu", "unknown-part")
+    return {"gpu_gbps": gpu / 1e9, "dram_gbps": dram / 1e9,
+            "tpu_v4_gbps": tpu / 1e9}
+
+
+async def _phase_d() -> dict:
+    """Two-backend virtual mesh: parity on both families, then a
+    mid-run kill whose failover CROSSES the backend boundary —
+    zero drops, the backend trail entry, degraded responses."""
+    rng = np.random.default_rng(17)
+    xr = rng.standard_normal(N).astype(np.float32)
+    xi = rng.standard_normal(N).astype(np.float32)
+    ref = np.fft.fft(xr.astype(np.complex128)
+                     + 1j * xi.astype(np.complex128))
+
+    def check(resp):
+        got = np.asarray(resp.yr) + 1j * np.asarray(resp.yi)
+        err = float(np.max(np.abs(got - ref)) / np.max(np.abs(ref)))
+        assert err < 1e-4, f"parity {err} on {resp.device}"
+        return err
+
+    cfg = MeshConfig(devices=2, max_batch=2, max_wait_ms=2.0,
+                     queue_depth=256,
+                     backends=("cpu-interpret", "gpu"))
+    async with MeshDispatcher(cfg, [ShapeSpec(n=N)]) as mesh:
+        tags = {d.id: d.backend for d in mesh.devices}
+        assert set(tags.values()) == {"cpu-interpret", "gpu"}, tags
+        home = mesh.devices[0]
+        survivor = mesh.devices[1]
+        # warmth never crosses tags: the gpu member is COLD for the
+        # group the cpu member warmed
+        group = next(iter(home.warm_groups))
+        assert survivor.warmth(group) == 0, \
+            "warmth must be 0 across backend tags"
+        # parity on BOTH families (route around the home to prime the
+        # other — same idiom as the serve-mesh stall test)
+        check(await mesh.submit(xr, xi))
+        home.state = "draining"
+        gpu_resp = check(await mesh.submit(xr, xi))
+        home.state = "healthy"
+        served = {d.id: d.served for d in mesh.devices}
+        assert all(c >= 1 for c in served.values()), served
+        # mid-run kill: the cpu-interpret home dies under load and its
+        # requests land on the GPU-family survivor
+        with inject(home.site, "permanent", count=1):
+            results = await asyncio.gather(
+                *[mesh.submit(xr, xi) for _ in range(8)])
+        assert len(results) == 8, "zero drops"
+        assert home.state == "dead"
+        for r in results:
+            check(r)
+        crossed = [r for r in results
+                   if f"failover:backend:{survivor.backend}"
+                   in r.degrade]
+        assert crossed, \
+            f"no cross-backend trail: {[r.degrade for r in results]}"
+        for r in crossed:
+            assert f"failover:{home.id}" in r.degrade, r.degrade
+            assert r.degraded is True, r.to_record()
+            assert r.device == survivor.id, r.device
+    assert metrics.counter_value(
+        "pifft_serve_failover_cross_backend_total",
+        device=home.id) >= len(crossed)
+    return {"devices": tags, "killed": home.id,
+            "crossed": len(crossed), "gpu_parity_relerr": gpu_resp}
+
+
+def _phase_e() -> dict:
+    """Backend bench rows end to end: emit gpu + cpu-native rows (the
+    latter degrading gracefully without libpifft.so), then parse them
+    back through the analyze loader's backend axis."""
+    import bench
+
+    from ..analyze.loader import BenchRound, Fingerprint, bench_samples
+
+    gpu_row = bench.measure_backend_row(8, "gpu", smoke=True)
+    cpun_row = bench.measure_backend_row(8, "cpu-native", smoke=True)
+    assert gpu_row["gpu2^8_parity_relerr"] < 1e-4, gpu_row
+    assert cpun_row["cpun2^8_parity_relerr"] < 1e-4, cpun_row
+    assert gpu_row["gpu2^8_peak_gbps"] != cpun_row["cpun2^8_peak_gbps"]
+
+    rec = dict(gpu_row)
+    rec.update(cpun_row)
+    rec["n2^13_ms"] = 1.0          # a legacy-named row: backfills tpu
+    rnd = BenchRound(index=1, path="backend-smoke.json", metrics=rec,
+                     fingerprint=Fingerprint())
+    samples = bench_samples(rnd)
+    by_backend: dict = {}
+    for s in samples:
+        by_backend.setdefault(s.backend, []).append(s)
+    assert set(by_backend) >= {"gpu", "cpu-native", "tpu"}, \
+        sorted(by_backend)
+    assert all(s.n == 256 for s in by_backend["gpu"])
+    assert all(s.n == 256 for s in by_backend["cpu-native"])
+    assert all(s.n == 8192 for s in by_backend["tpu"])
+    return {"backends": sorted(by_backend),
+            "samples": len(samples)}
+
+
+def _main(tmp: str) -> dict:
+    summary: dict = {"phases": {}}
+    events_path = os.path.join(tmp, "events.jsonl")
+    events.enable(events_path, run_id="backend-smoke")
+
+    _say("phase A: plan-key backend axis")
+    summary["phases"]["A"] = _phase_a()
+    _say("phase B: inventory probe")
+    summary["phases"]["B"] = _phase_b()
+    _say("phase C: per-backend ceilings")
+    summary["phases"]["C"] = _phase_c()
+    _say("phase D: two-backend mesh + cross-backend failover")
+    summary["phases"]["D"] = asyncio.run(_phase_d())
+    _say("phase E: backend bench rows + loader axis")
+    summary["phases"]["E"] = _phase_e()
+
+    # ---- validate every event emitted across the run ------------
+    events.flush()
+    records, dropped = events.load_events(events_path)
+    assert dropped == 0, f"{dropped} malformed event lines"
+    bad = [(r.get("kind"), p) for r in records
+           for p in events.validate_event(r)]
+    assert not bad, f"schema-invalid events: {bad[:8]}"
+    failovers = [r for r in records
+                 if r.get("kind") == "serve_failover"]
+    assert any((r.get("payload") or {}).get("cross_backend")
+               for r in failovers), \
+        "serve_failover must carry the cross_backend count"
+    summary["events"] = {"total": len(records),
+                         "failover": len(failovers)}
+    summary["ok"] = True
+    events.disable()
+    return summary
+
+
+def main() -> int:
+    if not os.environ.get("PIFFT_PLAN_CACHE") \
+            or cache.cache_dir() is None:
+        # hermetic by default (the fleet-smoke policy): phase A writes
+        # winners into the store, so the smoke needs an ENABLED cache
+        # dir — but never the operator's real ~/.cache one
+        os.environ["PIFFT_PLAN_CACHE"] = tempfile.mkdtemp(
+            prefix="pifft-backend-cache-")
+    with tempfile.TemporaryDirectory(prefix="pifft-backend-") as tmp:
+        summary = _main(tmp)
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

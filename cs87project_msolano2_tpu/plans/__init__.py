@@ -44,9 +44,11 @@ from .autotune import (  # noqa: F401
     tune_sweep,
 )
 from .core import (  # noqa: F401
+    BACKENDS,
     CandidateResult,
     Plan,
     PlanKey,
+    current_backend,
     current_device_kind,
     device_is_tunable,
     warn,
@@ -57,13 +59,17 @@ def make_key(n: int, batch: tuple = (), layout: str = "natural",
              precision: str | None = None,
              device_kind: str | None = None,
              dtype: str = "float32",
-             domain: str = "c2c") -> PlanKey:
+             domain: str = "c2c",
+             backend: str | None = None) -> PlanKey:
     """PlanKey for an n-point transform over `batch` leading dims on the
     current (or given) device kind.  Every compile-relevant field is
     passed explicitly (PIF401): a defaulted field here would silently
     alias keys if the PlanKey default ever diverged.  `domain` picks
     c2c (default) or the half-spectrum real paths r2c/c2r — n is the
-    real-side length either way (docs/REAL.md)."""
+    real-side length either way (docs/REAL.md).  `backend` pins the
+    lowering family (docs/BACKENDS.md); None discovers the process's
+    backend tag ("tpu" on TPU/axon, "gpu" on any GPU flavor,
+    "cpu-interpret" otherwise — "cpu-native" is explicit opt-in only)."""
     return PlanKey(
         device_kind=device_kind or current_device_kind(),
         n=int(n),
@@ -72,6 +78,7 @@ def make_key(n: int, batch: tuple = (), layout: str = "natural",
         dtype=dtype,
         precision=precision or "split3",
         domain=domain,
+        backend=backend or current_backend(),
     )
 
 
@@ -189,16 +196,20 @@ def measured_ms(key: PlanKey, *, verbose: bool = True):
 
 
 def plan(n: int, batch: tuple = (), layout: str = "natural",
-         precision: str | None = None, domain: str = "c2c") -> Plan:
+         precision: str | None = None, domain: str = "c2c",
+         backend: str | None = None) -> Plan:
     """The single dispatch point: ``plan(n).execute(xr, xi)``."""
-    return get_plan(make_key(n, batch, layout, precision, domain=domain))
+    return get_plan(make_key(n, batch, layout, precision, domain=domain,
+                             backend=backend))
 
 
 def plan_for(shape, layout: str = "natural",
-             precision: str | None = None, domain: str = "c2c") -> Plan:
+             precision: str | None = None, domain: str = "c2c",
+             backend: str | None = None) -> Plan:
     """Plan for float-plane arrays of `shape` (trailing axis = transform
     length, leading axes = batch).  For every domain the shape is the
     SIGNAL-side shape (the real length n) — a c2r plan's executor
     consumes half-spectrum planes, but its key is still n."""
     shape = tuple(shape)
-    return plan(shape[-1], shape[:-1], layout, precision, domain=domain)
+    return plan(shape[-1], shape[:-1], layout, precision, domain=domain,
+                backend=backend)

@@ -33,6 +33,19 @@ from ..ops.precision import PRECISIONS  # noqa: E402,F401
 # and the c2c key at n describe the same served signal length
 DOMAINS = ("c2c", "r2c", "c2r")
 
+# the backend plan axis (docs/BACKENDS.md): WHICH lowering family a
+# plan belongs to — the paper implements the same pi-FFT on three
+# kinds of hardware behind one harness, and this axis is that choice
+# made first-class.  "tpu" is the Pallas/Mosaic kernel family (the
+# default — every pre-backend key was one); "gpu" the GPU-shaped
+# lowerings in hw/lowering (Pallas-on-Triton where a GPU is attached,
+# interpret mode on CPU CI); "cpu-native" the ctypes pthreads core
+# wrapped as a real ladder rung; "cpu-interpret" the explicit
+# interpret-mode CI identity.  Distinct backends tune, cache, and
+# serve independently: the token carries the tag, so per-backend
+# winners live under distinct tokens in the same store.
+BACKENDS = ("tpu", "gpu", "cpu-interpret", "cpu-native")
+
 # bump when PlanKey/Plan serialization or ladder parameter semantics
 # change incompatibly — stale disk stores are then ignored wholesale
 # (schema 2 added the `domain` field; schema 3 made precision a TUNED
@@ -47,8 +60,12 @@ DOMAINS = ("c2c", "r2c", "c2r")
 # path, and tuned params may carry a raced ``pad`` — a v3 store never
 # held non-pow2 keys, but its pow2 winners were raced without the
 # any-length entries in the field, so the same refuse-and-warn-once
-# policy applies)
-SCHEMA_VERSION = 4
+# policy applies; schema 5 made the BACKEND part of the key identity
+# (docs/BACKENDS.md): a v4 winner was raced with no backend axis in
+# the field — its variant namespace did not even contain the gpu/
+# cpu-native rungs — so v4 tokens take the same refuse-and-warn-once
+# migration the v2->v3 and v3->v4 bumps did)
+SCHEMA_VERSION = 5
 
 
 def warn(msg: str) -> None:
@@ -84,6 +101,24 @@ def current_device_kind() -> str:
             # (if coarser) plan-cache identity
             return backend
     return f"{backend}-interpret"
+
+
+def current_backend() -> str:
+    """The backend tag (BACKENDS) of the process's default jax backend
+    — the value ``plans.make_key`` stamps on keys when the caller does
+    not pin one.  TPU (attached or over the axon relay) is the Pallas
+    kernel family; any GPU flavor maps to the gpu lowering family; a
+    plain CPU process is the interpret identity (docs/BACKENDS.md).
+    The ``cpu-native`` tag is never inferred — the ctypes rung is an
+    explicit opt-in, not a discovery result."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend in ("tpu", "axon"):
+        return "tpu"
+    if backend in ("gpu", "cuda", "rocm"):
+        return "gpu"
+    return "cpu-interpret"
 
 
 def device_is_tunable() -> bool:
@@ -123,6 +158,12 @@ class PlanKey:
     out).  The real domains require natural layout (the half-spectrum
     has no pi order); EVEN n rides the c2c plan at n/2 via the pack
     trick, ODD n takes the direct any-length path (docs/REAL.md).
+    backend: WHICH lowering family serves this key (BACKENDS,
+    docs/BACKENDS.md) — "tpu" (Pallas/Mosaic, the historical default),
+    "gpu" (hw/lowering GPU-shaped rungs), "cpu-native" (the ctypes
+    pthreads core as a ladder rung), or "cpu-interpret".  Backends
+    tune independently: the tag is in the token, so each backend's
+    winner occupies its own cache entry.
     """
 
     device_kind: str
@@ -132,10 +173,14 @@ class PlanKey:
     dtype: str = "float32"
     precision: str = "split3"
     domain: str = "c2c"
+    backend: str = "tpu"
 
     def __post_init__(self):
         if self.layout not in LAYOUTS:
             raise ValueError(f"layout={self.layout!r} not in {LAYOUTS}")
+        if self.backend not in BACKENDS:
+            raise ValueError(
+                f"backend={self.backend!r} not in {BACKENDS}")
         if self.precision not in PRECISIONS:
             raise ValueError(
                 f"precision={self.precision!r} not in {PRECISIONS}")
@@ -182,6 +227,7 @@ class PlanKey:
                 "dtype": self.dtype,
                 "precision": self.precision,
                 "domain": self.domain,
+                "backend": self.backend,
             },
             sort_keys=True,
             separators=(",", ":"),
@@ -201,6 +247,7 @@ class PlanKey:
             dtype=d["dtype"],
             precision=d["precision"],
             domain=d["domain"],
+            backend=d["backend"],
         )
 
 

@@ -226,7 +226,16 @@ def candidates(key: PlanKey) -> list:
     docs/PLANS.md "Arbitrary n"): the routed-best variant's entries
     first (rader for large primes, mixedradix for small odd factors),
     then the Bluestein entries across the 2-3 nearest feasible pads —
-    the padded size is itself a raced axis, exactly like tile/cb."""
+    the padded size is itself a raced axis, exactly like tile/cb.
+
+    BACKEND dispatch (docs/BACKENDS.md): gpu and cpu-native keys race
+    the hw.lowering ladder instead — a disjoint variant namespace, so a
+    cross-backend cache hit can never hand this ladder a foreign
+    variant.  tpu and cpu-interpret keys keep the historical path."""
+    if key.backend in ("gpu", "cpu-native"):
+        from ..hw import lowering
+
+        return lowering.candidates(key)
     if key.domain != "c2c" and key.n % 2 == 0:
         return candidates(c2c_subkey(key))
     if key.domain != "c2c":
@@ -313,7 +322,12 @@ def static_default(key: PlanKey):
     shared, and build_executor adds the pack/Hermitian wrapping; odd
     real n and every non-pow2 c2c n route to the any-length ladder
     (ops.anylen.plan_variant picks rader/mixedradix/bluestein, the
-    cheapest feasible pad is the static pad choice)."""
+    cheapest feasible pad is the static pad choice).  gpu/cpu-native
+    keys take hw.lowering's static default (docs/BACKENDS.md)."""
+    if key.backend in ("gpu", "cpu-native"):
+        from ..hw import lowering
+
+        return lowering.static_default(key)
     if key.domain != "c2c" and key.n % 2 == 0:
         return static_default(c2c_subkey(key))
     if not _pow2(key.n):
@@ -418,7 +432,14 @@ def build_executor(key: PlanKey, variant: str, params: dict):
     ops.anylen around their own ladder-resolved subplans; odd-n real
     keys take the DIRECT any-length real executors there (no even/odd
     pack exists), even-n real keys wrap the half-length c2c executor
-    as before — n=1000 r2c rides a mixedradix c2c at 500."""
+    as before — n=1000 r2c rides a mixedradix c2c at 500.
+
+    gpu/cpu-native keys build through hw.lowering (docs/BACKENDS.md) —
+    their variants never reach the TPU-shaped builders below."""
+    if key.backend in ("gpu", "cpu-native"):
+        from ..hw import lowering
+
+        return lowering.build_executor(key, variant, params)
     if key.domain != "c2c" and key.n % 2:
         from ..ops import anylen
 

@@ -1,48 +1,28 @@
-"""Capacity probes (L3) — the reference ships standalone probe executables
-(how-many-cpu-cores, cpu/pthreads/how-many-cpu-cores.c:19-32, and
-how-many-concurrent-blocks, gpu/cuda/how-many-concurrent-blocks.cu:34-176)
-whose output the harness uses to clip its p-sweep.  TPU equivalents:
+"""DEPRECATED shim: the capacity probes moved into the hardware plane —
+import from ``cs87project_msolano2_tpu.hw.inventory`` instead, which
+unifies the device-count/core probes with the typed
+:class:`~cs87project_msolano2_tpu.hw.inventory.DeviceInventory`
+(docs/BACKENDS.md).
 
-    python -m cs87project_msolano2_tpu.probes            # device count
-    python -m cs87project_msolano2_tpu.probes -v         # verbose, like the
-                                                         # reference's -v
-    python -m cs87project_msolano2_tpu.probes --cores    # CPU cores (native)
-"""
+Kept so existing callers and the documented module invocation
+
+    python -m cs87project_msolano2_tpu.probes [-v] [--cores]
+
+keep working; new code should not import this path."""
 
 from __future__ import annotations
 
-import argparse
 import sys
+import warnings
 
+from .hw.inventory import how_many_tpu_devices, main  # noqa: F401
 
-def how_many_tpu_devices(verbose: bool = False) -> int:
-    import jax
-
-    devs = jax.devices()
-    if verbose:
-        for d in devs:
-            print(f"device {d.id}: {d.device_kind} "
-                  f"(platform {d.platform}, process {d.process_index})")
-        print(f"addressable: {jax.local_device_count()}, "
-              f"global: {jax.device_count()}, "
-              f"processes: {jax.process_count()}")
-    return len(devs)
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description="capacity probes")
-    ap.add_argument("-v", action="store_true", help="verbose device info")
-    ap.add_argument("--cores", action="store_true",
-                    help="print CPU core count (native probe) instead")
-    args = ap.parse_args(argv)
-    if args.cores:
-        from .backends.cpu import num_cores
-
-        print(num_cores())
-        return 0
-    print(how_many_tpu_devices(args.v))
-    return 0
-
+warnings.warn(
+    "cs87project_msolano2_tpu.probes moved to "
+    "cs87project_msolano2_tpu.hw.inventory; this shim will be removed",
+    DeprecationWarning,
+    stacklevel=2,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

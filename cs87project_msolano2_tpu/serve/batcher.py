@@ -110,8 +110,13 @@ class BatchRunner:
     jitted callable per (group, bucket, rung) so every batch after the
     first reuses the compiled program."""
 
-    def __init__(self, pool: Optional[BufferPool] = None):
+    def __init__(self, pool: Optional[BufferPool] = None,
+                 backend: Optional[str] = None):
         self.pool = pool or BufferPool()
+        #: the backend tag every plan key this runner builds carries
+        #: (plans.core.BACKENDS — docs/BACKENDS.md); None = discover
+        #: per process (plans.make_key's default)
+        self.backend = backend
         self._callables: dict = {}
 
     def cached_groups(self) -> set:
@@ -128,7 +133,15 @@ class BatchRunner:
         runner without displacing anything already here.  The jitted
         executables are process-global, so a drained device's compile
         investment moves to its successor instead of dying with it.
-        Returns how many entries were adopted."""
+        Returns how many entries were adopted.
+
+        CROSS-BACKEND handoff adopts NOTHING (returns 0): a callable
+        compiled for one backend tag embeds that family's lowering —
+        serving it under another tag would silently answer gpu traffic
+        with a tpu program.  A plan is cold across tags unless
+        explicitly cross-warmed (docs/BACKENDS.md)."""
+        if other.backend != self.backend:
+            return 0
         adopted = 0
         for key, val in list(other._callables.items()):
             if group is not None and key[0] != group:
@@ -143,7 +156,8 @@ class BatchRunner:
     def _plan_for(self, group: GroupKey, bucket: int):
         return plans.plan_for((bucket, group.n), layout=group.layout,
                               precision=group.precision,
-                              domain=group.domain)
+                              domain=group.domain,
+                              backend=self.backend)
 
     def _callable(self, group: GroupKey, bucket: int,
                   rung: Optional[str]):

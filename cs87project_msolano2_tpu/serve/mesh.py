@@ -106,6 +106,15 @@ class MeshConfig(ServeConfig):
     batch_abort_waits: int = 1
     #: journal path for warm-handoff drains (drain_device's default)
     handoff_journal: Optional[str] = None
+    #: per-device backend tags (plans.core.BACKENDS) for a
+    #: HETEROGENEOUS mesh (docs/BACKENDS.md): entry i tags device i,
+    #: devices past the tuple's length default to "tpu".  A device's
+    #: tag flows into its runner's plan keys, its warmth (plans are
+    #: COLD across tags unless explicitly cross-warmed), and the
+    #: failover trail (``failover:backend:<tag>`` when a re-route
+    #: crosses tags).  Empty (default) = the homogeneous mesh of
+    #: PRs 1-19.
+    backends: tuple = ()
 
 
 class MeshDevice:
@@ -114,7 +123,8 @@ class MeshDevice:
     ``draining`` (handoff in progress, router skips it) → ``drained``
     (clean exit), or → ``dead`` (failover evacuated it)."""
 
-    def __init__(self, index: int, prefix: str = "vdev"):
+    def __init__(self, index: int, prefix: str = "vdev",
+                 backend: Optional[str] = None):
         self.index = index
         self.id = f"{prefix}{index}"
         #: fault-injection site (docs/RESILIENCE.md): arm
@@ -122,7 +132,11 @@ class MeshDevice:
         #: ``device*:...`` to strike any device
         self.site = f"device{index}"
         self.state = "healthy"
-        self.runner = BatchRunner(BufferPool())
+        #: the device's backend tag (plans.core.BACKENDS — docs/
+        #: BACKENDS.md): flows into every plan key its runner builds,
+        #: so a heterogeneous mesh tunes/caches per device family
+        self.backend = backend or "tpu"
+        self.runner = BatchRunner(BufferPool(), backend=self.backend)
         self.queues: dict = {}     # GroupKey -> asyncio.Queue
         self.workers: dict = {}    # GroupKey -> worker task
         self.inflight: dict = {}   # batch token -> [Request] (un-acked)
@@ -165,6 +179,7 @@ class MeshDevice:
 
     def describe(self) -> dict:
         return {"device": self.id, "state": self.state,
+                "backend": self.backend,
                 "served": self.served, "load": self.load(),
                 "busy_s": round(self.busy_s, 6),
                 "warm_groups": sorted(g.label()
@@ -181,7 +196,10 @@ class MeshDispatcher(Dispatcher):
         config = config or MeshConfig()
         super().__init__(config, shape_specs)
         count = max(1, int(config.devices))
-        self.devices = [MeshDevice(i) for i in range(count)]
+        tags = tuple(config.backends or ())
+        self.devices = [
+            MeshDevice(i, backend=tags[i] if i < len(tags) else None)
+            for i in range(count)]
         self.router = Router(self.devices)
         self.admission = AdmissionController(quota=config.tenant_quota)
         self.t_open = clock()
@@ -500,12 +518,18 @@ class MeshDispatcher(Dispatcher):
         """Move admitted requests off `from_device` onto survivors.
         ``tag=True`` (failover) marks each request's degrade trail;
         a planned drain moves them untagged — the successor serves at
-        full quality.  Admitted requests are NOT re-admitted (their
-        slot moves with them); with no survivor left the future gets
-        a structured :class:`NoDeviceAvailable`."""
+        full quality.  A re-route that CROSSES backend tags (a gpu
+        device's queue landing on a cpu-native survivor) appends a
+        second trail entry, ``failover:backend:<target tag>``, so the
+        response says not just WHERE the request moved but onto WHICH
+        hardware family (docs/BACKENDS.md) — appended only after the
+        route succeeds, since the tag is the target's.  Admitted
+        requests are NOT re-admitted (their slot moves with them);
+        with no survivor left the future gets a structured
+        :class:`NoDeviceAvailable`."""
         if not requests:
             return
-        moved = stranded = 0
+        moved = stranded = crossed = 0
         t_move = clock()
         for req in requests:
             if req.future.done():
@@ -527,6 +551,12 @@ class MeshDispatcher(Dispatcher):
                 req.future.set_exception(e)
                 stranded += 1
                 continue
+            if tag and target.backend != from_device.backend:
+                req.trail.append(f"{reason}:backend:{target.backend}")
+                if req.trace.live:
+                    req.marks.append(
+                        (f"{reason}:backend:{target.backend}", t_move))
+                crossed += 1
             q = self._ensure_device_worker(target, req.group)
             q.put_nowait(req)
             moved += 1
@@ -537,9 +567,15 @@ class MeshDispatcher(Dispatcher):
             if moved:
                 metrics.inc("pifft_serve_failover_total",
                             value=float(moved), device=from_device.id)
+            if crossed:
+                metrics.inc("pifft_serve_failover_cross_backend_total",
+                            value=float(crossed),
+                            device=from_device.id)
             events.emit("serve_failover", device=from_device.id,
                         requests=moved,
                         **({"stranded": stranded} if stranded else {}),
+                        **({"cross_backend": crossed} if crossed
+                           else {}),
                         **({"epoch": epoch} if epoch is not None
                            else {}),
                         reason=reason)

@@ -104,6 +104,21 @@ def hbm_peak_bytes_per_s(device_kind: str) -> Optional[float]:
     return best[1] * 1e9 if best else None
 
 
+def backend_peak_bytes_per_s(backend: str,
+                             device_kind: str = "") -> Optional[float]:
+    """The memory-bandwidth ceiling for one BACKEND tag — the TPU table
+    above for "tpu", the hardware inventory's gpu/DRAM rows for
+    everything else (hw.inventory owns those — docs/BACKENDS.md).
+    Every utilization below divides by THIS, so a gpu or cpu-native
+    measurement is never silently read against a TPU peak (check rule
+    PIF122)."""
+    if backend == "tpu":
+        return hbm_peak_bytes_per_s(device_kind)
+    from ..hw.inventory import peak_bytes_per_s
+
+    return peak_bytes_per_s(backend, device_kind)
+
+
 def fft_min_hbm_bytes(n: int, domain: str = "c2c",
                       storage_bytes: int = 4) -> int:
     """The floor any n-point plane FFT must move through HBM, DTYPE-
@@ -226,17 +241,19 @@ def charge_spectral_traffic(op: str, n: int,
 
 def spectral_roofline_utilization(op: str, n: int, ms: float,
                                   device_kind: str,
-                                  storage_bytes: int = 4
+                                  storage_bytes: int = 4,
+                                  backend: str = "tpu"
                                   ) -> Optional[float]:
-    """Achieved fraction of the HBM roofline for one fused spectral
+    """Achieved fraction of the roofline for one fused spectral
     op measured at `ms` per call, charging the op's fused floor (the
     bench conv rows' utilization figure).  Does NOT meter — the op
     execution paths already charged their declared traffic through
-    :func:`charge_spectral_traffic`.  None when the device peak is
+    :func:`charge_spectral_traffic`.  `backend` selects the ceiling
+    table (backend_peak_bytes_per_s — PIF122).  None when the peak is
     unknown or the measurement degenerate."""
     from ..obs import metrics
 
-    peak = hbm_peak_bytes_per_s(device_kind)
+    peak = backend_peak_bytes_per_s(backend, device_kind)
     if peak is None or ms is None or ms <= 0.0:
         return None
     util = spectral_min_hbm_bytes(op, n, storage_bytes) \
@@ -260,7 +277,8 @@ def roofline_utilization(n: int, ms: float, device_kind: str,
                          carry_passes: int = 0,
                          domain: str = "c2c",
                          storage_bytes: int = 4,
-                         pad_n: Optional[int] = None) -> Optional[float]:
+                         pad_n: Optional[int] = None,
+                         backend: str = "tpu") -> Optional[float]:
     """Achieved fraction of the HBM roofline for an n-point transform
     measured at `ms` per call, charging the minimum traffic of the
     transform's DOMAIN and STORAGE dtype (see fft_min_hbm_bytes — the
@@ -269,8 +287,11 @@ def roofline_utilization(n: int, ms: float, device_kind: str,
     path's declared carry passes.  `pad_n` is an any-length plan's
     internal padded length (``params["pad"]``): the meter then charges
     the carries at the pad while the floor/utilization stay at the
-    actual n (see fft_hbm_bytes).  None when the device peak is
-    unknown or the measurement is degenerate."""
+    actual n (see fft_hbm_bytes).  `backend` selects WHICH ceiling the
+    figure reads against (backend_peak_bytes_per_s — a cpu-native or
+    gpu measurement against the TPU HBM table is exactly the lie check
+    rule PIF122 exists to flag).  None when the peak is unknown or the
+    measurement is degenerate."""
     from ..obs import metrics
 
     if ms is not None and ms > 0.0:
@@ -284,7 +305,7 @@ def roofline_utilization(n: int, ms: float, device_kind: str,
         metrics.inc("pifft_hbm_bytes_total",
                     fft_hbm_bytes(n, carry_passes, domain,
                                   storage_bytes, pad_n))
-    peak = hbm_peak_bytes_per_s(device_kind)
+    peak = backend_peak_bytes_per_s(backend, device_kind)
     if peak is None or ms is None or ms <= 0.0:
         return None
     util = fft_min_hbm_bytes(n, domain, storage_bytes) \

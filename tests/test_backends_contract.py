@@ -1,0 +1,122 @@
+"""Backend.run contract tests — the invariants every backend must hold.
+
+Complements test_cross_backend.py (which checks backends against EACH
+OTHER); here each backend is checked against the CONTRACT in
+backends/base.py: complex64 pi-layout output matching numpy's FFT,
+fetch=False returning a timing-only RunResult (out is None), timers
+composing (total == funnel + tube within float slack), input validation
+via check_run_args, and the degraded flag — False on healthy runs, True
+when the jax backend falls back from loop-slope to dispatch-inclusive
+timing (the PR-20 failover/telemetry plumbing keys off this bit).
+"""
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu.backends import base as backends_base
+from cs87project_msolano2_tpu.backends.registry import get_backend, list_backends
+from cs87project_msolano2_tpu.cli import make_input
+from cs87project_msolano2_tpu.utils.verify import pi_layout_to_natural, rel_err
+
+# "cpu" resolves to the native pthreads core (builds the C library on
+# first use), "jax" to the XLA path — the two families satellite 3 names.
+CONTRACT_BACKENDS = ("cpu", "jax")
+
+
+@pytest.fixture(params=CONTRACT_BACKENDS)
+def backend(request):
+    return get_backend(request.param)
+
+
+def test_registry_names_cover_contract_backends():
+    names = list_backends()
+    for name in CONTRACT_BACKENDS:
+        assert name in names
+
+
+@pytest.mark.parametrize("n,p", [(256, 1), (256, 8), (2048, 16)])
+def test_pi_layout_parity_vs_numpy(backend, n, p):
+    x = make_input(n, seed=20)
+    res = backend.run(x, p)
+    assert res.out is not None
+    assert res.out.dtype == np.complex64
+    assert res.out.shape == (n,)
+    ref = np.fft.fft(x.astype(np.complex128))
+    assert rel_err(pi_layout_to_natural(res.out), ref) < 1e-5
+
+
+def test_fetch_false_is_timing_only(backend):
+    x = make_input(512, seed=21)
+    res = backend.run(x, 4, fetch=False)
+    # native output is host-resident anyway (fetch is documented as
+    # ignored there); the jax path must NOT pay the D2H transfer
+    if backend.name == "jax":
+        assert res.out is None
+    assert np.isfinite(res.total_ms) and res.total_ms >= 0
+
+
+def test_timers_compose(backend):
+    x = make_input(1024, seed=22)
+    res = backend.run(x, 8, reps=2)
+    assert res.total_ms >= 0
+    assert res.funnel_ms >= 0 and res.tube_ms >= 0
+    # jax derives total := funnel + tube exactly; the native core's
+    # nested timers agree to clock slack
+    assert res.total_ms == pytest.approx(
+        res.funnel_ms + res.tube_ms, abs=0.5, rel=0.2
+    )
+
+
+def test_timers_false_skips_phase_timing():
+    """The verification fast path: output without timing honesty."""
+    x = make_input(256, seed=23)
+    res = get_backend("jax").run(x, 4, timers=False)
+    assert res.total_ms == 0.0 and res.funnel_ms == 0.0 and res.tube_ms == 0.0
+    assert res.out is not None and not res.degraded
+    ref = np.fft.fft(x.astype(np.complex128))
+    assert rel_err(pi_layout_to_natural(res.out), ref) < 1e-5
+
+
+def test_degraded_flag_false_on_healthy_runs(backend):
+    x = make_input(256, seed=24)
+    assert backend.run(x, 4).degraded is False
+
+
+def test_degraded_flag_set_on_loop_slope_fallback(monkeypatch):
+    """Force the relay-timing path and make the slope unresolvable: the
+    jax backend must fall back to dispatch-inclusive timing and SAY SO
+    via degraded=True (the bit bench/serve surface to operators)."""
+    from cs87project_msolano2_tpu.backends import jax_backend
+    from cs87project_msolano2_tpu.utils.timing import LoopSlopeUnresolved
+
+    def _unresolved(*a, **kw):
+        raise LoopSlopeUnresolved("forced by test")
+
+    monkeypatch.setattr(jax_backend, "needs_loop_slope", lambda: True)
+    monkeypatch.setattr(jax_backend, "loop_slope_ms", _unresolved)
+    x = make_input(256, seed=25)
+    res = get_backend("jax").run(x, 4)
+    assert res.degraded is True
+    assert res.out is not None
+    ref = np.fft.fft(x.astype(np.complex128))
+    assert rel_err(pi_layout_to_natural(res.out), ref) < 1e-5
+
+
+@pytest.mark.parametrize(
+    "n,p",
+    [(100, 4), (256, 3), (256, 512), (0, 1)],
+    ids=["n-not-pow2", "p-not-pow2", "p-gt-n", "n-zero"],
+)
+def test_check_run_args_rejections(backend, n, p):
+    x = np.zeros(n, dtype=np.complex64)
+    with pytest.raises(ValueError):
+        backend.run(x, p)
+
+
+def test_check_run_args_contiguity_and_dtype():
+    """check_run_args is the shared front door: complex64, contiguous."""
+    x = make_input(512, seed=26).astype(np.complex128)[::2]  # strided view
+    got = backends_base.check_run_args(x, 4)
+    assert got.dtype == np.complex64
+    assert got.flags["C_CONTIGUOUS"]
+    assert got.shape == (256,)

@@ -787,6 +787,131 @@ def test_pif109_metric_surface_is_clean():
             assert "noqa[PIF109]" not in open(path).read(), path
 
 
+# --------------------------------------- PIF122 backend-unaware ceiling
+
+
+NAKED_UTIL = """
+    from cs87project_msolano2_tpu.utils.roofline import (
+        roofline_utilization,
+    )
+
+    def row(n, ms, kind):
+        return roofline_utilization(n, ms, kind, 0)
+"""
+
+
+def test_pif122_flags_backendless_utilization_on_surface():
+    for path in (BENCH_PATH,
+                 os.path.join(PKG, "serve", "mesh.py"),
+                 os.path.join(PKG, "fleet", "canary.py")):
+        findings = run(NAKED_UTIL, "PIF122", path=path)
+        assert rule_ids(findings) == ["PIF122"], path
+        assert "backend=" in findings[0].message
+    spectral = """
+        from cs87project_msolano2_tpu.utils import roofline
+
+        def row(n, ms, kind):
+            return roofline.spectral_roofline_utilization(
+                "conv", n, ms, kind)
+    """
+    assert rule_ids(run(spectral, "PIF122", path=BENCH_PATH)) \
+        == ["PIF122"]
+
+
+def test_pif122_backend_kwarg_scope_and_splat_pass():
+    passed = """
+        from cs87project_msolano2_tpu.utils.roofline import (
+            roofline_utilization,
+        )
+
+        def row(n, ms, key):
+            return roofline_utilization(n, ms, key.device_kind, 0,
+                                        backend=key.backend)
+
+        def splat(n, ms, kind, **kw):
+            return roofline_utilization(n, ms, kind, 0, **kw)
+    """
+    assert run(passed, "PIF122", path=BENCH_PATH) == []
+    # off the publishing surface (tests, ops) is not this rule's
+    # business, and the model module itself is exempt
+    assert run(NAKED_UTIL, "PIF122", path="snippet.py") == []
+    assert run(NAKED_UTIL, "PIF122",
+               path=os.path.join(PKG, "utils", "roofline.py")) == []
+
+
+def test_pif122_raw_tpu_table_lookup_flagged():
+    raw = """
+        from cs87project_msolano2_tpu.utils.roofline import (
+            hbm_peak_bytes_per_s,
+        )
+
+        def ceiling(kind):
+            return hbm_peak_bytes_per_s(kind)
+    """
+    findings = run(raw, "PIF122", path=BENCH_PATH)
+    assert rule_ids(findings) == ["PIF122"]
+    assert "backend_peak_bytes_per_s" in findings[0].message
+    # the per-backend dispatcher is the sanctioned spelling
+    dispatched = """
+        from cs87project_msolano2_tpu.utils.roofline import (
+            backend_peak_bytes_per_s,
+        )
+
+        def ceiling(backend, kind):
+            return backend_peak_bytes_per_s(backend, kind)
+    """
+    assert run(dispatched, "PIF122", path=BENCH_PATH) == []
+
+
+def test_pif122_noqa_requires_a_reason():
+    """PIF122 is strict (blanket_suppressible=False): a blanket or
+    reasonless noqa cannot vouch for a published figure."""
+    reasonless = """
+        from cs87project_msolano2_tpu.utils.roofline import (
+            roofline_utilization,
+        )
+
+        u = roofline_utilization(n, ms, kind, 0)  # pifft: noqa[PIF122]
+    """
+    assert rule_ids(run(reasonless, "PIF122", path=BENCH_PATH)) \
+        == ["PIF122"]
+    blanket = """
+        from cs87project_msolano2_tpu.utils.roofline import (
+            roofline_utilization,
+        )
+
+        u = roofline_utilization(n, ms, kind, 0)  # pifft: noqa
+    """
+    assert rule_ids(run(blanket, "PIF122", path=BENCH_PATH)) \
+        == ["PIF122"]
+    reasoned = """
+        from cs87project_msolano2_tpu.utils.roofline import (
+            roofline_utilization,
+        )
+
+        u = roofline_utilization(n, ms, kind, 0)  # pifft: noqa[PIF122]: tpu-only diagnostic, never published
+    """
+    assert run(reasoned, "PIF122", path=BENCH_PATH) == []
+
+
+def test_pif122_publishing_surface_is_clean():
+    """The shipped figure-publishing surface satisfies its own rule
+    with ZERO suppressions — the mandated empty baseline: every
+    utilization call passes backend= (docs/BACKENDS.md)."""
+    surface = [BENCH_PATH,
+               os.path.join(PKG, "serve"), os.path.join(PKG, "fleet"),
+               os.path.join(PKG, "analyze"), os.path.join(PKG, "apps"),
+               os.path.join(PKG, "hw")]
+    findings = list(engine.check_paths(surface, rules=["PIF122"]))
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+    for root in surface:
+        files = [root] if root.endswith(".py") else [
+            os.path.join(root, nm) for nm in os.listdir(root)
+            if nm.endswith(".py")]
+        for path in files:
+            assert "noqa[PIF122]" not in open(path).read(), path
+
+
 # ------------------------------------------- PIF201 nonstatic shape arg
 
 
@@ -939,7 +1064,8 @@ def test_pif401_fully_specified_and_kwargs_splat():
         from cs87project_msolano2_tpu.plans import PlanKey
 
         a = PlanKey(device_kind="cpu-interpret", n=8, batch=(), \
-layout="pi", dtype="float32", precision="split3", domain="c2c")
+layout="pi", dtype="float32", precision="split3", domain="c2c", \
+backend="cpu-interpret")
         b = PlanKey(**base)  # not statically analyzable: skipped
     """
     assert run(code, "PIF401") == []

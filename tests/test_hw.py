@@ -1,0 +1,270 @@
+"""Unit tests for the heterogeneous backend plane (docs/BACKENDS.md):
+device inventory typing and per-backend bandwidth ceilings, the gpu /
+cpu-native lowering family (candidates, static defaults, executors),
+the PlanKey backend axis in the cache tokens (schema 5, v4 refusal),
+cross-backend mesh failover tagging, and the canary controller's
+backend-mismatch refusal.  The end-to-end composition of the same
+pieces runs in ``make backend-smoke`` (hw/smoke.py); these are the
+fast unit-level complements that ride tier-1.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from cs87project_msolano2_tpu import obs, plans, resilience
+from cs87project_msolano2_tpu.fleet import CanaryController
+from cs87project_msolano2_tpu.hw import inventory, lowering
+from cs87project_msolano2_tpu.obs import events as obs_events
+from cs87project_msolano2_tpu.obs import metrics
+from cs87project_msolano2_tpu.plans.core import BACKENDS, SCHEMA_VERSION, PlanKey
+from cs87project_msolano2_tpu.serve import GroupKey, MeshConfig, MeshDispatcher, ShapeSpec
+from cs87project_msolano2_tpu.utils.verify import pi_layout_to_natural, rel_err
+
+
+@pytest.fixture
+def plan_cache_tmp(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIFFT_PLAN_CACHE", str(tmp_path / "cache"))
+    plans.cache.clear(memory=True, disk=False)
+    yield tmp_path
+    plans.cache.clear(memory=True, disk=False)
+
+
+@pytest.fixture
+def obs_run():
+    obs.enable()
+    yield obs
+    obs.disable()
+
+
+def run_async(coro, timeout_s=180.0):
+    async def bounded():
+        return await asyncio.wait_for(coro, timeout=timeout_s)
+
+    return asyncio.run(bounded())
+
+
+# ------------------------------------------------------------ inventory
+
+
+def test_probe_returns_typed_inventory():
+    inv = inventory.probe()
+    d = inv.to_dict()
+    assert d["schema"] == inventory.INVENTORY_SCHEMA == 1
+    assert inv.backend in BACKENDS
+    assert inv.cpu_cores >= 1
+    assert inv.device_count >= 0
+    # the bandwidth table covers EVERY backend tag so cross-backend
+    # comparisons read from one table
+    assert set(inv.bandwidth) == set(BACKENDS)
+    assert json.loads(inv.to_json()) == d
+
+
+def test_peak_bytes_per_s_gpu_kind_match():
+    # longest-substring match against the GPU table; unknown falls to
+    # the conservative default row
+    h100 = inventory.peak_bytes_per_s("gpu", "NVIDIA H100 80GB")
+    assert h100 == inventory.GPU_PEAK_GBPS["h100"] * 1e9
+    default = inventory.peak_bytes_per_s("gpu", "mystery-accelerator")
+    assert default == inventory.GPU_PEAK_GBPS["default"] * 1e9
+    assert h100 > default
+
+
+def test_peak_bytes_per_s_cpu_native_env_override(monkeypatch):
+    monkeypatch.delenv("PIFFT_DRAM_GBPS", raising=False)
+    assert (inventory.peak_bytes_per_s("cpu-native")
+            == inventory.DRAM_DEFAULT_GBPS * 1e9)
+    monkeypatch.setenv("PIFFT_DRAM_GBPS", "123.5")
+    assert inventory.peak_bytes_per_s("cpu-native") == 123.5e9
+    monkeypatch.setenv("PIFFT_DRAM_GBPS", "not-a-number")
+    assert (inventory.peak_bytes_per_s("cpu-native")
+            == inventory.DRAM_DEFAULT_GBPS * 1e9)
+
+
+def test_peak_bytes_per_s_interpret_is_none_tpu_delegates():
+    from cs87project_msolano2_tpu.utils.roofline import hbm_peak_bytes_per_s
+
+    assert inventory.peak_bytes_per_s("cpu-interpret") is None
+    assert (inventory.peak_bytes_per_s("tpu", "tpu-v4")
+            == hbm_peak_bytes_per_s("tpu-v4"))
+
+
+# ------------------------------------------------------------- lowering
+
+
+def gpu_key(n=256, layout="pi", domain="c2c", batch=()):
+    return plans.make_key(n, layout=layout, domain=domain, batch=batch,
+                          backend="gpu")
+
+
+def cpun_key(n=256, layout="pi"):
+    return plans.make_key(n, layout=layout, backend="cpu-native")
+
+
+def test_gpu_candidates_rows_and_jnp():
+    cands = lowering.candidates(gpu_key(256))
+    assert ("gpu-rows", {"block_rows": None}) in cands
+    # pi layout: the jnp stage rung (natural-order only) must NOT race
+    assert all(v != "gpu-jnp" for v, _ in cands)
+    nat = lowering.candidates(gpu_key(256, layout="natural"))
+    assert ("gpu-jnp", {}) in nat
+    # batched rows divisible by 8 unlock the blocked kernel entry
+    batched = lowering.candidates(gpu_key(256, batch=(8,)))
+    assert ("gpu-rows", {"block_rows": 8}) in batched
+
+
+def test_cpu_native_candidates_sweep_p_capacity_first():
+    cands = lowering.candidates(cpun_key(1024))
+    assert cands and all(v == "cpu-native" for v, _ in cands)
+    ps = [prm["p"] for _, prm in cands]
+    assert ps == sorted(ps, reverse=True) and ps[-1] == 1
+    assert ps[0] == lowering.native_capacity_p(1024)
+
+
+def test_non_pow2_has_no_backend_rungs():
+    key = plans.make_key(100, backend="gpu")
+    assert lowering.candidates(key) == []
+    with pytest.raises(ValueError, match="power-of-two"):
+        lowering.static_default(key)
+
+
+def test_static_defaults():
+    v, prm = lowering.static_default(gpu_key(256))
+    assert v == "gpu-rows"
+    v, prm = lowering.static_default(cpun_key(1024))
+    assert v == "cpu-native" and prm["p"] == lowering.native_capacity_p(1024)
+
+
+def test_even_real_domain_rides_c2c_subkey():
+    # r2c at even n wraps the half-length c2c plan — same variant
+    # family as the direct c2c key at n/2
+    r2c = lowering.candidates(gpu_key(512, domain="r2c", layout="natural"))
+    c2c = lowering.candidates(gpu_key(256, layout="natural"))
+    assert [v for v, _ in r2c] == [v for v, _ in c2c]
+
+
+@pytest.mark.parametrize("backend", ["gpu", "cpu-native"])
+def test_backend_plan_executes_with_numpy_parity(backend, plan_cache_tmp):
+    n = 256
+    key = plans.make_key(n, layout="pi", backend=backend)
+    plan = plans.get_plan(key)
+    rng = np.random.default_rng(30)
+    xr = rng.standard_normal(n).astype(np.float32)
+    xi = rng.standard_normal(n).astype(np.float32)
+    yr, yi = plan.execute(xr, xi)
+    got = pi_layout_to_natural(np.asarray(yr) + 1j * np.asarray(yi))
+    ref = np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128))
+    assert rel_err(got, ref) < 1e-4
+
+
+# ------------------------------------------------- cache backend axis
+
+
+def test_backend_axis_token_roundtrip_and_distinct(plan_cache_tmp):
+    a = plans.make_key(256)
+    b = plans.make_key(256, backend="gpu")
+    assert a.backend in BACKENDS and b.backend == "gpu"
+    assert a.token() != b.token()
+    assert PlanKey.from_token(b.token()) == b
+    assert json.loads(b.token())["v"] == SCHEMA_VERSION == 5
+
+
+def test_v4_token_refused():
+    v4 = json.loads(plans.make_key(256).token())
+    v4.pop("backend")
+    v4["v"] = 4
+    with pytest.raises(ValueError, match="schema 4"):
+        PlanKey.from_token(json.dumps(v4, sort_keys=True,
+                                      separators=(",", ":")))
+
+
+def test_bogus_backend_refused():
+    with pytest.raises(ValueError):
+        plans.make_key(256, backend="phi")
+
+
+def test_per_backend_winners_cached_separately(plan_cache_tmp):
+    k_cpu = plans.make_key(256)
+    k_gpu = plans.make_key(256, backend="gpu")
+    p_cpu = plans.get_plan(k_cpu)
+    p_gpu = plans.get_plan(k_gpu)
+    plans.cache.store(p_cpu, persist=True)
+    plans.cache.store(p_gpu, persist=True)
+    tokens = set(plans.cache.disk_entries(k_cpu.device_kind))
+    assert {k_cpu.token(), k_gpu.token()} <= tokens
+    plans.cache.clear(memory=True, disk=False)
+    assert plans.cache.lookup(k_gpu).variant == p_gpu.variant
+    assert plans.cache.lookup(k_cpu).variant == p_cpu.variant
+
+
+# ----------------------------------------- cross-backend mesh failover
+
+
+def test_cross_backend_failover_tags_trail(obs_run, plan_cache_tmp):
+    """Kill the home device on a two-tag mesh: re-routes that CROSS the
+    backend boundary carry the second trail entry and bump the
+    cross-backend counter; answers stay numpy-correct."""
+    n = 256
+    rng = np.random.default_rng(31)
+    xr = rng.standard_normal(n).astype(np.float32)
+    xi = rng.standard_normal(n).astype(np.float32)
+
+    async def main():
+        cfg = MeshConfig(devices=2, max_batch=2, max_wait_ms=2.0,
+                         backends=("cpu-interpret", "gpu"))
+        async with MeshDispatcher(cfg, [ShapeSpec(n=n)]) as mesh:
+            home = mesh.router.route(GroupKey(n=n), record=False)
+            await mesh.submit(xr, xi)  # prime the home device
+            # prime the survivor too so failover lands on a warm body
+            home.state = "draining"
+            await mesh.submit(xr, xi)
+            home.state = "healthy"
+            with resilience.inject(home.site, "permanent", count=1):
+                results = await asyncio.gather(
+                    *[mesh.submit(xr, xi) for _ in range(6)])
+            return mesh, home, results
+
+    mesh, home, results = run_async(main())
+    survivor = next(d for d in mesh.router.devices if d.id != home.id)
+    assert home.backend != survivor.backend  # the two-tag premise
+    assert mesh.device(home.id).state == "dead"
+    assert len(results) == 6
+    crossed = [r for r in results
+               if f"failover:backend:{survivor.backend}" in r.degrade]
+    assert crossed and all(f"failover:{home.id}" in r.degrade
+                           for r in crossed)
+    assert all(r.degraded and r.device == survivor.id for r in crossed)
+    ref = np.fft.fft(xr.astype(np.complex128) + 1j * xi.astype(np.complex128))
+    for r in results:
+        got = np.asarray(r.yr) + 1j * np.asarray(r.yi)
+        assert rel_err(got, ref) < 1e-4
+    assert metrics.counter_value(
+        "pifft_serve_failover_cross_backend_total",
+        device=home.id) >= len(crossed)
+
+
+# ----------------------------------------------------- canary refusal
+
+
+def test_canary_refuses_cross_backend_promotion(obs_run, plan_cache_tmp):
+    """A canary whose device tag differs from the key's backend axis
+    refuses the race before any timing runs (docs/BACKENDS.md): a
+    winner raced on gpu would be promoted onto hardware it was never
+    timed on."""
+    cfg = MeshConfig(devices=2, backends=("cpu-interpret", "gpu"))
+    mesh = MeshDispatcher(cfg)
+    ctl = CanaryController(mesh=mesh)
+    key = plans.make_key(256)  # cpu-interpret on the CI host
+    # designate() reserves the highest-index healthy device — the gpu
+    assert mesh.router.devices[-1].backend == "gpu" != key.backend
+    out = ctl.race(key, [30.0] * 40)
+    assert not out.promoted and not out.rolled_back
+    assert "backend_mismatch" in out.reason
+    aborted = [r for r in obs_events.snapshot()
+               if r["kind"] == "fleet_canary"
+               and r["payload"].get("aborted") == "backend_mismatch"]
+    assert aborted
+    assert metrics.counter_value("pifft_fleet_canary_aborted_total",
+                                 kind="backend_mismatch") >= 1.0

@@ -385,7 +385,7 @@ def test_v2_token_refused_and_v3_round_trips():
     key = plans.make_key(1024, layout="pi", precision="bf16",
                          device_kind="TPU test-kind")
     assert plans.PlanKey.from_token(key.token()) == key
-    assert json.loads(key.token())["v"] == 4  # any-n bump (PLANS.md)
+    assert json.loads(key.token())["v"] == 5  # backend-axis bump (BACKENDS.md)
     v2 = json.dumps({
         "v": 2, "device_kind": "TPU test-kind", "n": 1024,
         "batch": [], "layout": "pi", "dtype": "float32",
